@@ -1,0 +1,180 @@
+"""Batched loss/gradient kernels — the TPU-native ``Gradient`` contract.
+
+The reference's ``Gradient`` plugin (spark-mllib 1.3.0, used per-example inside
+the ``treeAggregate`` seqOp at reference ``AcceleratedGradientDescent.scala:
+196-204``) computes one example's loss and accumulates its gradient in place.
+On TPU that per-example, in-place formulation is exactly wrong: the idiomatic
+kernel is a *batched* ``loss_and_grad(w, X, y) -> (loss_sum, grad_sum, n)``
+whose matmuls land on the MXU and whose elementwise tails XLA fuses into them.
+
+Every kernel here returns **sums**, not means — matching the seqOp/combOp
+accumulation of the reference; the mean (reference ``:207``) is applied by the
+caller after the cross-device reduction.  That split is load-bearing for the
+streaming path: macro-batch partial sums accumulate associatively before one
+global division.
+
+Numerical conventions follow the *pinned* spark-mllib 1.3.0 formulas (pin at
+reference ``build.sbt:7``) so the oracle-equivalence tests carry over:
+
+- ``LogisticGradient``  — binary; loss ``softplus(-x·w) - (1-y)(-x·w)``,
+  grad ``(sigmoid(x·w) - y)·x``  (labels in {0,1}).
+- ``LeastSquaresGradient`` — loss ``(x·w - y)^2`` (NOT halved — the 1.3
+  convention), grad ``2(x·w - y)·x``.
+- ``HingeGradient`` — labels {0,1} mapped to {-1,+1}; active when
+  ``s·(x·w) < 1``; loss ``1 - s(x·w)``, grad ``-s·x``.
+- ``SoftmaxGradient`` — NEW (Spark 1.3 had no multinomial): weight matrix
+  ``(D, K)``, loss ``-log softmax(x·W)[y]``, grad ``x ⊗ (softmax - onehot)``.
+- ``CustomGradient`` — any pytree-parameterised batch loss, differentiated
+  with ``jax.grad`` (the "custom Gradient for a two-layer MLP" path of
+  BASELINE config 5).
+
+All kernels are pure functions of ``(weights, X, y)`` and jit/vmap/shard_map
+safe.  Gradients are hand-derived closed forms (cheaper and explicit) and are
+unit-tested against ``jax.grad`` of the loss in ``tests/test_losses.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _count(X) -> Array:
+    """Batch example count, in the widest enabled integer dtype.
+
+    The reference accumulates counts as Long (``0L``, reference ``:196``);
+    here a single kernel call sees one in-memory batch (N < 2^31 by
+    construction), and the *global* count across devices/macro-batches is
+    accumulated by the reduce/streaming layer — in int64 under x64, and as
+    host Python ints on the streaming path — so billion-row totals never
+    wrap.
+    """
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.asarray(X.shape[0], dt)
+
+
+class Gradient:
+    """Protocol: batched smooth-loss plugin.
+
+    ``batch_loss_and_grad(weights, X, y) -> (loss_sum, grad_sum, count)``
+    where ``grad_sum`` has the same pytree structure as ``weights`` and
+    ``count`` is the number of examples in the batch (0-d array).
+
+    Equivalent of the spark-mllib ``Gradient`` abstract class as consumed at
+    reference ``AcceleratedGradientDescent.scala:198``, re-shaped from
+    per-example accumulation to one MXU-friendly batched evaluation.
+    """
+
+    def batch_loss_and_grad(self, weights, X, y):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Convenience: mean loss/grad over one in-memory batch (no mesh).
+    # ------------------------------------------------------------------
+    def mean_loss_and_grad(self, weights, X, y):
+        loss_sum, grad_sum, n = self.batch_loss_and_grad(weights, X, y)
+        from ..core import tvec
+
+        n = jnp.asarray(n, loss_sum.dtype)
+        return loss_sum / n, tvec.scale(1.0 / n, grad_sum)
+
+
+class LogisticGradient(Gradient):
+    """Binary logistic loss (labels in {0,1}).
+
+    Mirrors spark-mllib 1.3.0 ``LogisticGradient`` (binary-only in 1.3;
+    reference use-sites: Suite:39, :251).  Stable via ``softplus``.
+    """
+
+    def batch_loss_and_grad(self, weights, X, y):
+        margins = -(X @ weights)  # (N,)  — the only (N,D)·(D,) matmul
+        y = y.astype(margins.dtype)
+        # loss_i = softplus(margin) - (1 - y_i) * margin   (MLlib 1.3 form)
+        loss_sum = jnp.sum(jax.nn.softplus(margins) - (1.0 - y) * margins)
+        multipliers = jax.nn.sigmoid(-margins) - y  # sigmoid(x·w) - y
+        grad_sum = X.T @ multipliers
+        return loss_sum, grad_sum, _count(X)
+
+
+class LeastSquaresGradient(Gradient):
+    """Squared-error loss, 1.3 convention: ``diff^2`` / ``2·diff·x``.
+
+    (BASELINE config 2; not used in the reference's own tests but named by
+    SURVEY §2.2.)
+    """
+
+    def batch_loss_and_grad(self, weights, X, y):
+        diff = X @ weights - y.astype(weights.dtype)
+        loss_sum = jnp.sum(diff * diff)
+        grad_sum = 2.0 * (X.T @ diff)
+        return loss_sum, grad_sum, _count(X)
+
+
+class HingeGradient(Gradient):
+    """SVM hinge loss; {0,1} labels rescaled to {-1,+1} (BASELINE config 3)."""
+
+    def batch_loss_and_grad(self, weights, X, y):
+        dots = X @ weights
+        s = 2.0 * y.astype(weights.dtype) - 1.0
+        margin = 1.0 - s * dots
+        active = margin > 0.0
+        loss_sum = jnp.sum(jnp.where(active, margin, 0.0))
+        # grad_i = -s_i x_i where active, else 0  ==  X^T(-s * active)
+        grad_sum = X.T @ jnp.where(active, -s, 0.0)
+        return loss_sum, grad_sum, _count(X)
+
+
+class SoftmaxGradient(Gradient):
+    """Multinomial softmax regression with weight matrix ``(D, K)``.
+
+    New capability beyond spark-mllib 1.3 (which was binary-only — SURVEY
+    §2.2), required for BASELINE config 4 (MNIST-8M).  The ``(D, K)`` weight
+    matrix is the tensor-parallel target: shard K over the mesh ``model``
+    axis and the two matmuls below become sharded MXU ops with XLA inserting
+    the collectives.
+    """
+
+    def __init__(self, num_classes: int):
+        self.num_classes = int(num_classes)
+
+    def batch_loss_and_grad(self, weights, X, y):
+        logits = X @ weights  # (N, K)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)  # (N,)
+        picked = jnp.take_along_axis(
+            logits, y.astype(jnp.int32)[:, None], axis=-1
+        )[:, 0]
+        loss_sum = jnp.sum(logz - picked)
+        probs = jnp.exp(logits - logz[:, None])  # reuse logz; one pass
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), self.num_classes,
+                                dtype=weights.dtype)
+        grad_sum = X.T @ (probs - onehot)  # (D, K)
+        return loss_sum, grad_sum, _count(X)
+
+
+class CustomGradient(Gradient):
+    """Wrap any batch loss ``fn(weights_pytree, X, y) -> loss_sum``.
+
+    The gradient comes from ``jax.value_and_grad``; weights may be an
+    arbitrary pytree (MLP parameter trees — BASELINE config 5).  This is the
+    extension seam that replaces subclassing MLlib's ``Gradient``.
+    """
+
+    def __init__(self, loss_sum_fn: Callable[[Any, Array, Array], Array]):
+        self._vg = jax.value_and_grad(loss_sum_fn)
+
+    def batch_loss_and_grad(self, weights, X, y):
+        loss_sum, grad_sum = self._vg(weights, X, y)
+        return loss_sum, grad_sum, _count(X)
+
+
+# Registry for config/CLI surfaces.
+GRADIENTS = {
+    "logistic": LogisticGradient,
+    "least_squares": LeastSquaresGradient,
+    "hinge": HingeGradient,
+    "softmax": SoftmaxGradient,
+}

@@ -1,0 +1,60 @@
+"""Test fixtures: the TPU framework's answer to MLlibTestSparkContext.
+
+The reference unit-tests "distributed" code with no cluster by running a
+``local[2]`` threaded SparkContext (reference Suite:27,30 via
+``MLlibTestSparkContext``), and exercises real process isolation with
+``local-cluster`` mode (Suite:242).  The TPU-native analogue: force the host
+platform to expose 8 virtual CPU devices and build real ``jax.sharding.Mesh``
+meshes over them — real shardings, real collectives (XLA CPU emulates them
+faithfully), no hardware.
+
+x64 is enabled so oracle-parity tests can match the reference's
+Double-precision driver math bit-for-bit.
+
+NOTE: env vars (JAX_PLATFORMS / XLA_FLAGS) are too late by the time conftest
+runs — the container's sitecustomize.py (/root/.axon_site) imports jax at
+interpreter startup with JAX_PLATFORMS=axon (the tunneled real TPU chip).
+``jax.config.update`` still works because no backend has been instantiated
+yet, and ``jax_num_cpu_devices`` is the modern replacement for
+``--xla_force_host_platform_device_count``.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+def assert_rel(actual, expected, rel_tol, msg=""):
+    """Relative-tolerance assert, the ``TestingUtils.~=`` analogue
+    (reference Suite:28)."""
+    actual = float(actual)
+    expected = float(expected)
+    denom = max(abs(actual), abs(expected))
+    if denom == 0.0:
+        return
+    assert abs(actual - expected) / denom <= rel_tol, (
+        f"{msg}: {actual} !~= {expected} (relTol {rel_tol}, "
+        f"got {abs(actual - expected) / denom:.3e})"
+    )
+
+
+@pytest.fixture
+def rel_assert():
+    return assert_rel
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,179 @@
+"""Unit tests for the batched loss kernels (SURVEY §7 step 1).
+
+The reference has no kernel-level unit tests (its math is only tested
+end-to-end vs a GD oracle — SURVEY §4); these add the missing pyramid layer:
+each kernel vs (a) a direct NumPy closed form, (b) ``jax.grad`` of its own
+loss, (c) finite differences.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu.ops import losses
+
+
+def _fd_grad(f, w, eps=1e-6):
+    """Central finite-difference gradient of scalar f at flat vector w."""
+    w = np.asarray(w, dtype=np.float64)
+    g = np.zeros_like(w)
+    for i in range(w.size):
+        up = w.copy()
+        dn = w.copy()
+        up[i] += eps
+        dn[i] -= eps
+        g[i] = (f(up) - f(dn)) / (2 * eps)
+    return g
+
+
+@pytest.fixture
+def batch(rng):
+    N, D = 64, 5
+    X = rng.normal(size=(N, D))
+    w = rng.normal(size=(D,))
+    y01 = (rng.random(N) > 0.5).astype(np.float64)
+    yreal = rng.normal(size=(N,))
+    return X, w, y01, yreal
+
+
+class TestLogistic:
+    def test_closed_form_vs_numpy(self, batch):
+        X, w, y, _ = batch
+        loss, grad, n = losses.LogisticGradient().batch_loss_and_grad(
+            jnp.asarray(w), jnp.asarray(X), jnp.asarray(y))
+        # NumPy reference: sum_i log(1+exp(-x.w)) - (1-y)(-x.w)
+        m = -X @ w
+        expect = np.sum(np.log1p(np.exp(m)) - (1 - y) * m)
+        np.testing.assert_allclose(float(loss), expect, rtol=1e-12)
+        p = 1 / (1 + np.exp(-(X @ w)))
+        np.testing.assert_allclose(np.asarray(grad), X.T @ (p - y), rtol=1e-10)
+        assert int(n) == X.shape[0]
+
+    def test_grad_vs_autodiff_and_fd(self, batch):
+        X, w, y, _ = batch
+        g = losses.LogisticGradient()
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        _, grad, _ = g.batch_loss_and_grad(jnp.asarray(w), Xj, yj)
+        auto = jax.grad(lambda wv: g.batch_loss_and_grad(wv, Xj, yj)[0])(
+            jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(auto),
+                                   rtol=1e-10)
+        fd = _fd_grad(
+            lambda wv: float(g.batch_loss_and_grad(jnp.asarray(wv), Xj, yj)[0]),
+            w)
+        np.testing.assert_allclose(np.asarray(grad), fd, rtol=1e-5, atol=1e-7)
+
+    def test_stability_large_margin(self):
+        # softplus formulation must not overflow where naive log1p(exp) would.
+        X = jnp.array([[1000.0], [-1000.0]])
+        y = jnp.array([0.0, 1.0])
+        w = jnp.array([1.0])
+        loss, grad, _ = losses.LogisticGradient().batch_loss_and_grad(X=X, y=y,
+                                                                     weights=w)
+        assert np.isfinite(float(loss))
+        assert np.all(np.isfinite(np.asarray(grad)))
+        # both examples are maximally wrong: loss ~ 1000 each
+        np.testing.assert_allclose(float(loss), 2000.0, rtol=1e-6)
+
+
+class TestLeastSquares:
+    def test_closed_form(self, batch):
+        X, w, _, y = batch
+        loss, grad, n = losses.LeastSquaresGradient().batch_loss_and_grad(
+            jnp.asarray(w), jnp.asarray(X), jnp.asarray(y))
+        diff = X @ w - y
+        # 1.3 convention: diff^2 (not halved), grad 2*diff*x
+        np.testing.assert_allclose(float(loss), np.sum(diff**2), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(grad), 2 * X.T @ diff,
+                                   rtol=1e-10)
+
+    def test_grad_vs_autodiff(self, batch):
+        X, w, _, y = batch
+        g = losses.LeastSquaresGradient()
+        Xj, yj = jnp.asarray(X), jnp.asarray(y)
+        _, grad, _ = g.batch_loss_and_grad(jnp.asarray(w), Xj, yj)
+        auto = jax.grad(lambda wv: g.batch_loss_and_grad(wv, Xj, yj)[0])(
+            jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(auto),
+                                   rtol=1e-10)
+
+
+class TestHinge:
+    def test_closed_form(self, batch):
+        X, w, y, _ = batch
+        loss, grad, _ = losses.HingeGradient().batch_loss_and_grad(
+            jnp.asarray(w), jnp.asarray(X), jnp.asarray(y))
+        s = 2 * y - 1
+        margin = 1 - s * (X @ w)
+        active = margin > 0
+        np.testing.assert_allclose(float(loss), np.sum(margin[active]),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(grad), X[active].T @ (-s[active]), rtol=1e-10)
+
+    def test_inactive_everywhere_gives_zero(self):
+        # perfectly separated data with big margins: loss 0, grad 0
+        X = jnp.array([[10.0], [-10.0]])
+        y = jnp.array([1.0, 0.0])
+        w = jnp.array([1.0])
+        loss, grad, _ = losses.HingeGradient().batch_loss_and_grad(w, X, y)
+        assert float(loss) == 0.0
+        np.testing.assert_array_equal(np.asarray(grad), [0.0])
+
+
+class TestSoftmax:
+    def test_matches_binary_logistic(self, rng):
+        """2-class softmax with class-0 column frozen at 0 == binary logistic."""
+        N, D = 32, 4
+        X = rng.normal(size=(N, D))
+        w = rng.normal(size=(D,))
+        y = (rng.random(N) > 0.5).astype(np.int32)
+        W2 = np.stack([np.zeros(D), w], axis=1)  # (D, 2)
+        sm = losses.SoftmaxGradient(2)
+        lo = losses.LogisticGradient()
+        l_sm, g_sm, _ = sm.batch_loss_and_grad(jnp.asarray(W2), jnp.asarray(X),
+                                               jnp.asarray(y))
+        l_lo, g_lo, _ = lo.batch_loss_and_grad(jnp.asarray(w), jnp.asarray(X),
+                                               jnp.asarray(y.astype(np.float64)))
+        np.testing.assert_allclose(float(l_sm), float(l_lo), rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(g_sm)[:, 1], np.asarray(g_lo),
+                                   rtol=1e-9)
+
+    def test_grad_vs_autodiff(self, rng):
+        N, D, K = 16, 3, 5
+        X = jnp.asarray(rng.normal(size=(N, D)))
+        y = jnp.asarray(rng.integers(0, K, size=N))
+        W = jnp.asarray(rng.normal(size=(D, K)))
+        sm = losses.SoftmaxGradient(K)
+        _, grad, _ = sm.batch_loss_and_grad(W, X, y)
+        auto = jax.grad(lambda Wv: sm.batch_loss_and_grad(Wv, X, y)[0])(W)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(auto),
+                                   rtol=1e-9)
+
+
+class TestCustom:
+    def test_pytree_weights(self, rng):
+        """CustomGradient over an MLP-style pytree (config-5 seam)."""
+        N, D, H = 16, 4, 3
+        X = jnp.asarray(rng.normal(size=(N, D)))
+        y = jnp.asarray((rng.random(N) > 0.5).astype(np.float64))
+        params = {
+            "W1": jnp.asarray(rng.normal(size=(D, H))),
+            "b1": jnp.zeros(H),
+            "w2": jnp.asarray(rng.normal(size=(H,))),
+        }
+
+        def mlp_loss(p, X, y):
+            h = jnp.tanh(X @ p["W1"] + p["b1"])
+            logits = h @ p["w2"]
+            return jnp.sum(jax.nn.softplus(-logits) + (1 - y) * logits)
+
+        g = losses.CustomGradient(mlp_loss)
+        loss, grad, n = g.batch_loss_and_grad(params, X, y)
+        assert int(n) == N
+        assert set(grad.keys()) == {"W1", "b1", "w2"}
+        auto = jax.grad(mlp_loss)(params, X, y)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(grad[k]),
+                                       np.asarray(auto[k]), rtol=1e-10)

@@ -1,0 +1,172 @@
+"""Unit tests for the proximal operators (SURVEY §7 step 1).
+
+Pins the MLlib-1.3 conventions and the two API subtleties the reference
+relies on: no hidden step rescaling (reference passes iter=1, ``:218-219``)
+and the ``prox(w, g, 0) == (w, reg_value(w))`` identity (reference ``:305``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu.ops import prox
+from spark_agd_tpu.core import tvec
+
+
+@pytest.fixture
+def vecs(rng):
+    w = jnp.asarray(rng.normal(size=(7,)))
+    g = jnp.asarray(rng.normal(size=(7,)))
+    return w, g
+
+
+ALL_PROXES = [
+    prox.IdentityProx(),
+    prox.L2Prox(),
+    prox.MLlibSquaredL2Updater(),
+    prox.L1Prox(),
+    prox.ElasticNetProx(0.3),
+]
+
+
+class TestStepZeroIdentity:
+    """reference :305 — reg-value read via step=0 must not move weights."""
+
+    @pytest.mark.parametrize("p", ALL_PROXES, ids=lambda p: type(p).__name__)
+    def test_identity(self, p, vecs):
+        w, g = vecs
+        w_new, rv = p.prox(w, g, 0.0, 0.7)
+        np.testing.assert_array_equal(np.asarray(w_new), np.asarray(w))
+        np.testing.assert_allclose(float(rv), float(p.reg_value(w, 0.7)),
+                                   rtol=1e-12)
+
+
+class TestIdentityProx:
+    def test_plain_step(self, vecs):
+        w, g = vecs
+        w_new, rv = prox.IdentityProx().prox(w, g, 0.25, 0.0)
+        np.testing.assert_allclose(np.asarray(w_new),
+                                   np.asarray(w) - 0.25 * np.asarray(g),
+                                   rtol=1e-12)
+        assert float(rv) == 0.0
+
+
+class TestL2Prox:
+    def test_shrink_formula(self, vecs):
+        w, g = vecs
+        step, reg = 0.5, 0.2
+        w_new, rv = prox.L2Prox().prox(w, g, step, reg)
+        expect = (np.asarray(w) - step * np.asarray(g)) / (1 + step * reg)
+        np.testing.assert_allclose(np.asarray(w_new), expect, rtol=1e-12)
+        # MLlib convention: penalty evaluated at the NEW weights
+        np.testing.assert_allclose(float(rv),
+                                   0.5 * reg * np.sum(expect**2), rtol=1e-12)
+
+    def test_is_exact_prox(self, vecs):
+        """w' minimizes step*reg/2 ||u||^2 + 1/2 ||u - (w - step g)||^2 —
+        check first-order optimality."""
+        w, g = vecs
+        step, reg = 0.3, 0.4
+        w_new, _ = prox.L2Prox().prox(w, g, step, reg)
+        v = np.asarray(w) - step * np.asarray(g)
+        resid = step * reg * np.asarray(w_new) + (np.asarray(w_new) - v)
+        np.testing.assert_allclose(resid, 0.0, atol=1e-12)
+
+
+class TestMLlibSquaredL2Updater:
+    def test_linearized_formula(self, vecs):
+        """MLlib 1.3.0 is a linearized step, NOT the exact prox:
+        w' = (1 - step*reg)*w - step*g (see 1.3.0 SquaredL2Updater source
+        comment); this is what reference :215-220 actually executed."""
+        w, g = vecs
+        step, reg = 0.5, 0.2
+        w_new, rv = prox.MLlibSquaredL2Updater().prox(w, g, step, reg)
+        expect = (1 - step * reg) * np.asarray(w) - step * np.asarray(g)
+        np.testing.assert_allclose(np.asarray(w_new), expect, rtol=1e-12)
+        np.testing.assert_allclose(float(rv), 0.5 * reg * np.sum(expect**2),
+                                   rtol=1e-12)
+
+    def test_parity_alias_points_here(self):
+        assert prox.SquaredL2Updater is prox.MLlibSquaredL2Updater
+
+    def test_agrees_with_exact_prox_to_first_order(self, vecs):
+        w, g = vecs
+        reg = 0.3
+        for step in [1e-3, 1e-4]:
+            a, _ = prox.MLlibSquaredL2Updater().prox(w, g, step, reg)
+            b, _ = prox.L2Prox().prox(w, g, step, reg)
+            diff = np.linalg.norm(np.asarray(a) - np.asarray(b))
+            # exact decomposition, e = step*reg:
+            #   linearized - exact = -e^2/(1+e)·w - step·e/(1+e)·g
+            e = step * reg
+            bound = (e**2 * np.linalg.norm(np.asarray(w))
+                     + step * e * np.linalg.norm(np.asarray(g))) / (1 + e)
+            assert diff <= 1.01 * bound
+
+
+class TestL1Prox:
+    def test_soft_threshold(self):
+        w = jnp.asarray([3.0, -3.0, 0.05, -0.05, 0.0])
+        g = jnp.zeros(5)
+        step, reg = 1.0, 0.1
+        w_new, rv = prox.L1Prox().prox(w, g, step, reg)
+        np.testing.assert_allclose(np.asarray(w_new),
+                                   [2.9, -2.9, 0.0, 0.0, 0.0], atol=1e-12)
+        np.testing.assert_allclose(float(rv), 0.1 * 5.8, rtol=1e-12)
+
+    def test_sparsity_inducing(self, rng):
+        w = jnp.asarray(rng.normal(size=(100,)) * 0.01)
+        g = jnp.zeros(100)
+        w_new, _ = prox.L1Prox().prox(w, g, 1.0, 1.0)
+        assert np.all(np.asarray(w_new) == 0.0)
+
+
+class TestElasticNet:
+    def test_reduces_to_l1_and_l2(self, vecs):
+        w, g = vecs
+        step, reg = 0.5, 0.3
+        en1 = prox.ElasticNetProx(1.0).prox(w, g, step, reg)
+        l1 = prox.L1Prox().prox(w, g, step, reg)
+        np.testing.assert_allclose(np.asarray(en1[0]), np.asarray(l1[0]),
+                                   rtol=1e-12)
+        en0 = prox.ElasticNetProx(0.0).prox(w, g, step, reg)
+        l2 = prox.L2Prox().prox(w, g, step, reg)
+        np.testing.assert_allclose(np.asarray(en0[0]), np.asarray(l2[0]),
+                                   rtol=1e-12)
+
+
+class TestPytreeSupport:
+    def test_prox_over_pytree(self, rng):
+        p = {"W": jnp.asarray(rng.normal(size=(3, 4))),
+             "b": jnp.asarray(rng.normal(size=(4,)))}
+        gr = {"W": jnp.asarray(rng.normal(size=(3, 4))),
+              "b": jnp.asarray(rng.normal(size=(4,)))}
+        w_new, rv = prox.L2Prox().prox(p, gr, 0.1, 0.5)
+        assert set(w_new.keys()) == {"W", "b"}
+        flat_w = np.concatenate([np.asarray(p["W"]).ravel(),
+                                 np.asarray(p["b"])])
+        flat_g = np.concatenate([np.asarray(gr["W"]).ravel(),
+                                 np.asarray(gr["b"])])
+        flat_new = (flat_w - 0.1 * flat_g) / (1 + 0.1 * 0.5)
+        got = np.concatenate([np.asarray(w_new["W"]).ravel(),
+                              np.asarray(w_new["b"])])
+        np.testing.assert_allclose(got, flat_new, rtol=1e-12)
+        np.testing.assert_allclose(float(rv), 0.25 * np.sum(flat_new**2),
+                                   rtol=1e-12)
+
+
+class TestTvec:
+    def test_dot_norm_axpby(self, rng):
+        a = {"x": jnp.asarray(rng.normal(size=(5,))),
+             "y": jnp.asarray(rng.normal(size=(2, 3)))}
+        b = {"x": jnp.asarray(rng.normal(size=(5,))),
+             "y": jnp.asarray(rng.normal(size=(2, 3)))}
+        fa = np.concatenate([np.asarray(a["x"]), np.asarray(a["y"]).ravel()])
+        fb = np.concatenate([np.asarray(b["x"]), np.asarray(b["y"]).ravel()])
+        np.testing.assert_allclose(float(tvec.dot(a, b)), fa @ fb, rtol=1e-12)
+        np.testing.assert_allclose(float(tvec.norm(a)), np.linalg.norm(fa),
+                                   rtol=1e-12)
+        c = tvec.axpby(2.0, a, -0.5, b)
+        fc = np.concatenate([np.asarray(c["x"]), np.asarray(c["y"]).ravel()])
+        np.testing.assert_allclose(fc, 2 * fa - 0.5 * fb, rtol=1e-12)
+        assert tvec.size(a) == 11
