@@ -43,8 +43,9 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
-def _count(X) -> Array:
-    """Batch example count, in the widest enabled integer dtype.
+def _count(X, mask=None) -> Array:
+    """Batch example count (valid examples only, when masked), in the widest
+    enabled integer dtype.
 
     The reference accumulates counts as Long (``0L``, reference ``:196``);
     here a single kernel call sees one in-memory batch (N < 2^31 by
@@ -54,7 +55,20 @@ def _count(X) -> Array:
     wrap.
     """
     dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-    return jnp.asarray(X.shape[0], dt)
+    if mask is None:
+        return jnp.asarray(X.shape[0], dt)
+    return jnp.sum(mask > 0).astype(dt)
+
+
+def _as_mask(mask, dtype):
+    """Cast a {0,1} per-example mask to the compute dtype; returns None when
+    no mask was given (callers branch and skip the multiplies).  Masks exist
+    so the sharding/data layers can pad batches to equal per-device sizes
+    without perturbing the (loss, grad, count) sums — padding rows simply
+    carry mask 0."""
+    if mask is None:
+        return None
+    return jnp.asarray(mask).astype(dtype)
 
 
 class Gradient:
@@ -69,14 +83,17 @@ class Gradient:
     per-example accumulation to one MXU-friendly batched evaluation.
     """
 
-    def batch_loss_and_grad(self, weights, X, y):
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        """``mask`` (optional, (N,) of {0,1}): padding rows carry 0 and are
+        excluded from all three sums — the sharding layer's tool for
+        unequal shards."""
         raise NotImplementedError
 
     # ------------------------------------------------------------------
     # Convenience: mean loss/grad over one in-memory batch (no mesh).
     # ------------------------------------------------------------------
-    def mean_loss_and_grad(self, weights, X, y):
-        loss_sum, grad_sum, n = self.batch_loss_and_grad(weights, X, y)
+    def mean_loss_and_grad(self, weights, X, y, mask=None):
+        loss_sum, grad_sum, n = self.batch_loss_and_grad(weights, X, y, mask)
         from ..core import tvec
 
         n = jnp.asarray(n, loss_sum.dtype)
@@ -90,14 +107,19 @@ class LogisticGradient(Gradient):
     reference use-sites: Suite:39, :251).  Stable via ``softplus``.
     """
 
-    def batch_loss_and_grad(self, weights, X, y):
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
         margins = -(X @ weights)  # (N,)  — the only (N,D)·(D,) matmul
         y = y.astype(margins.dtype)
+        m = _as_mask(mask, margins.dtype)
         # loss_i = softplus(margin) - (1 - y_i) * margin   (MLlib 1.3 form)
-        loss_sum = jnp.sum(jax.nn.softplus(margins) - (1.0 - y) * margins)
+        per = jax.nn.softplus(margins) - (1.0 - y) * margins
         multipliers = jax.nn.sigmoid(-margins) - y  # sigmoid(x·w) - y
+        if m is not None:
+            per = per * m
+            multipliers = multipliers * m
+        loss_sum = jnp.sum(per)
         grad_sum = X.T @ multipliers
-        return loss_sum, grad_sum, _count(X)
+        return loss_sum, grad_sum, _count(X, mask)
 
 
 class LeastSquaresGradient(Gradient):
@@ -107,25 +129,35 @@ class LeastSquaresGradient(Gradient):
     SURVEY §2.2.)
     """
 
-    def batch_loss_and_grad(self, weights, X, y):
-        diff = X @ weights - y.astype(weights.dtype)
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        preds = X @ weights
+        diff = preds - y.astype(preds.dtype)  # cast to matmul-result dtype
+        m = _as_mask(mask, diff.dtype)
+        if m is not None:
+            diff = diff * m  # zeroes both the loss and the grad of pad rows
         loss_sum = jnp.sum(diff * diff)
         grad_sum = 2.0 * (X.T @ diff)
-        return loss_sum, grad_sum, _count(X)
+        return loss_sum, grad_sum, _count(X, mask)
 
 
 class HingeGradient(Gradient):
     """SVM hinge loss; {0,1} labels rescaled to {-1,+1} (BASELINE config 3)."""
 
-    def batch_loss_and_grad(self, weights, X, y):
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
         dots = X @ weights
-        s = 2.0 * y.astype(weights.dtype) - 1.0
+        s = 2.0 * y.astype(dots.dtype) - 1.0
         margin = 1.0 - s * dots
         active = margin > 0.0
-        loss_sum = jnp.sum(jnp.where(active, margin, 0.0))
+        m = _as_mask(mask, dots.dtype)
+        per = jnp.where(active, margin, 0.0)
+        mult = jnp.where(active, -s, 0.0)
+        if m is not None:
+            per = per * m
+            mult = mult * m
+        loss_sum = jnp.sum(per)
         # grad_i = -s_i x_i where active, else 0  ==  X^T(-s * active)
-        grad_sum = X.T @ jnp.where(active, -s, 0.0)
-        return loss_sum, grad_sum, _count(X)
+        grad_sum = X.T @ mult
+        return loss_sum, grad_sum, _count(X, mask)
 
 
 class SoftmaxGradient(Gradient):
@@ -141,18 +173,24 @@ class SoftmaxGradient(Gradient):
     def __init__(self, num_classes: int):
         self.num_classes = int(num_classes)
 
-    def batch_loss_and_grad(self, weights, X, y):
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
         logits = X @ weights  # (N, K)
         logz = jax.scipy.special.logsumexp(logits, axis=-1)  # (N,)
         picked = jnp.take_along_axis(
             logits, y.astype(jnp.int32)[:, None], axis=-1
         )[:, 0]
-        loss_sum = jnp.sum(logz - picked)
+        per = logz - picked
         probs = jnp.exp(logits - logz[:, None])  # reuse logz; one pass
         onehot = jax.nn.one_hot(y.astype(jnp.int32), self.num_classes,
-                                dtype=weights.dtype)
-        grad_sum = X.T @ (probs - onehot)  # (D, K)
-        return loss_sum, grad_sum, _count(X)
+                                dtype=logits.dtype)
+        resid = probs - onehot
+        m = _as_mask(mask, logits.dtype)
+        if m is not None:
+            per = per * m
+            resid = resid * m[:, None]
+        loss_sum = jnp.sum(per)
+        grad_sum = X.T @ resid  # (D, K)
+        return loss_sum, grad_sum, _count(X, mask)
 
 
 class CustomGradient(Gradient):
@@ -163,12 +201,25 @@ class CustomGradient(Gradient):
     extension seam that replaces subclassing MLlib's ``Gradient``.
     """
 
-    def __init__(self, loss_sum_fn: Callable[[Any, Array, Array], Array]):
+    def __init__(self, loss_sum_fn: Callable[..., Array],
+                 supports_mask: bool = False):
+        """``supports_mask=True`` declares that ``loss_sum_fn`` accepts a
+        fourth ``mask`` argument and masks its own per-example terms; without
+        it, masked calls are rejected rather than silently mis-summed."""
         self._vg = jax.value_and_grad(loss_sum_fn)
+        self._supports_mask = supports_mask
 
-    def batch_loss_and_grad(self, weights, X, y):
-        loss_sum, grad_sum = self._vg(weights, X, y)
-        return loss_sum, grad_sum, _count(X)
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        if mask is not None:
+            if not self._supports_mask:
+                raise ValueError(
+                    "this CustomGradient's loss_sum_fn does not take a mask; "
+                    "construct it with supports_mask=True and handle the "
+                    "mask argument in the loss")
+            loss_sum, grad_sum = self._vg(weights, X, y, mask)
+        else:
+            loss_sum, grad_sum = self._vg(weights, X, y)
+        return loss_sum, grad_sum, _count(X, mask)
 
 
 # Registry for config/CLI surfaces.

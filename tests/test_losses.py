@@ -152,6 +152,58 @@ class TestSoftmax:
                                    rtol=1e-9)
 
 
+class TestMasking:
+    """Padding rows (mask 0) must be invisible to all three sums — the
+    contract the sharding layer's pad-to-even-shards relies on."""
+
+    @pytest.mark.parametrize("kind", ["logistic", "least_squares", "hinge"])
+    def test_padded_equals_unpadded(self, rng, kind):
+        N, D, pad = 40, 4, 7
+        X = rng.normal(size=(N, D))
+        w = jnp.asarray(rng.normal(size=D))
+        if kind == "least_squares":
+            y = rng.normal(size=N)
+            g = losses.LeastSquaresGradient()
+        else:
+            y = (rng.random(N) > 0.5).astype(np.float64)
+            g = (losses.LogisticGradient() if kind == "logistic"
+                 else losses.HingeGradient())
+        Xp = np.concatenate([X, np.zeros((pad, D))])
+        yp = np.concatenate([y, np.zeros(pad)])
+        mask = np.concatenate([np.ones(N), np.zeros(pad)])
+        l0, g0, n0 = g.batch_loss_and_grad(w, jnp.asarray(X), jnp.asarray(y))
+        l1, g1, n1 = g.batch_loss_and_grad(
+            w, jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask))
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                                   rtol=1e-12)
+        assert int(n1) == int(n0) == N
+
+    def test_softmax_masked(self, rng):
+        N, D, K, pad = 24, 3, 4, 5
+        X = rng.normal(size=(N, D))
+        y = rng.integers(0, K, size=N)
+        W = jnp.asarray(rng.normal(size=(D, K)))
+        g = losses.SoftmaxGradient(K)
+        Xp = np.concatenate([X, np.zeros((pad, D))])
+        yp = np.concatenate([y, np.zeros(pad, dtype=y.dtype)])
+        mask = np.concatenate([np.ones(N), np.zeros(pad)])
+        l0, g0, n0 = g.batch_loss_and_grad(W, jnp.asarray(X), jnp.asarray(y))
+        l1, g1, n1 = g.batch_loss_and_grad(
+            W, jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(mask))
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                                   rtol=1e-12)
+        assert int(n1) == N
+
+    def test_custom_rejects_mask_unless_declared(self, rng):
+        g = losses.CustomGradient(lambda w, X, y: jnp.sum((X @ w - y) ** 2))
+        X = jnp.asarray(rng.normal(size=(4, 2)))
+        y = jnp.asarray(rng.normal(size=4))
+        with pytest.raises(ValueError, match="supports_mask"):
+            g.batch_loss_and_grad(jnp.zeros(2), X, y, jnp.ones(4))
+
+
 class TestCustom:
     def test_pytree_weights(self, rng):
         """CustomGradient over an MLP-style pytree (config-5 seam)."""
