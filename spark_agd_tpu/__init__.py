@@ -35,6 +35,14 @@ from .ops.losses import (  # noqa: F401
     SoftmaxGradient,
     CustomGradient,
 )
+from .api import (  # noqa: F401
+    AcceleratedGradientDescent,
+    run,
+    run_minibatch_agd,
+    run_minibatch_sgd,
+)
+from .core.agd import AGDConfig, AGDResult  # noqa: F401
+from .parallel.mesh import ShardedBatch, make_mesh, shard_batch  # noqa: F401
 from .ops.prox import (  # noqa: F401
     Prox,
     IdentityProx,
