@@ -1,0 +1,141 @@
+"""Host-orchestrated AGD: the streaming twin of the fused loop.
+
+Same recurrences as ``core.agd`` (and the same reference citations — see
+that module's docstring), but with the outer/inner loops in Python and only
+the math on device.  This is the driver shape the reference itself has
+(SURVEY §3.1), retained for exactly one reason: a *streamed* smooth
+function (``data.streaming``) contains a host loop and cannot live inside
+``lax.while_loop``.  Control scalars sync to the host once per trial — for
+macro-batch workloads the stream dominates, so the syncs are noise.
+
+Use ``core.agd.run_agd`` whenever the data fits on-device; this driver
+exists for the 1B-row regime.  Semantics parity between the two is pinned
+by ``tests/test_streaming.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, NamedTuple, Tuple
+
+import numpy as np
+
+from . import tvec
+from .agd import AGDConfig
+
+
+class HostAGDResult(NamedTuple):
+    weights: Any
+    loss_history: np.ndarray
+    num_iters: int
+    aborted_non_finite: bool
+    final_l: float
+    num_backtracks: int
+    num_restarts: int
+
+
+def run_agd_host(
+    smooth: Callable,
+    prox: Callable,
+    reg_value: Callable,
+    w0: Any,
+    config: AGDConfig,
+    *,
+    smooth_loss: Callable | None = None,
+) -> HostAGDResult:
+    cfg = config
+    x = w0
+    z = x
+    theta = math.inf
+    big_l = float(cfg.l0)
+    bts = True
+    loss_hist: List[float] = []
+    n_bt = 0
+    n_restart = 0
+    aborted = False
+    backtracking = cfg.beta < 1.0
+
+    for n_iter in range(1, cfg.num_iterations + 1):
+        x_old, z_old = x, z
+        l_old = big_l
+        big_l = big_l * cfg.alpha
+        theta_old = theta
+
+        f_y = 0.0
+        g_y = None
+        y = x
+        f_x_reuse = None
+        for _ in range(cfg.max_backtracks):
+            theta = 2.0 / (1.0 + math.sqrt(
+                1.0 + 4.0 * (big_l / l_old) / (theta_old * theta_old)))
+            y = tvec.axpby(1.0 - theta, x_old, theta, z_old)
+            f_y_d, g_y = smooth(y)
+            f_y = float(f_y_d)
+            step = 1.0 / (theta * big_l)
+            z = prox(z_old, g_y, step)[0]
+            x = tvec.axpby(1.0 - theta, x_old, theta, z)
+
+            if not backtracking:
+                f_x_reuse = None
+                break
+
+            xy = tvec.sub(x, y)
+            xy_sq = float(tvec.sq_norm(xy))
+            if xy_sq == 0.0 or not math.isfinite(f_y):
+                f_x_reuse = f_y  # x == y exactly (or aborting anyway)
+                break
+
+            f_x_d, g_x = smooth(x)
+            f_x = float(f_x_d)
+            f_x_reuse = f_x
+            if bts:
+                q_x = f_y + float(tvec.dot(xy, g_y)) + 0.5 * big_l * xy_sq
+                local_l = big_l + 2.0 * max(f_x - q_x, 0.0) / xy_sq
+                bts = (abs(f_y - f_x)
+                       >= cfg.backtrack_tol * max(abs(f_x), abs(f_y)))
+            else:
+                local_l = 2.0 * float(tvec.dot(xy, tvec.sub(g_x, g_y))) \
+                    / xy_sq
+
+            if local_l <= big_l or big_l >= cfg.l_exact:
+                break
+
+            n_bt += 1
+            if not math.isinf(local_l):
+                big_l = min(cfg.l_exact, local_l)
+            else:
+                local_l = big_l
+            big_l = min(cfg.l_exact, max(local_l, big_l / cfg.beta))
+
+        # loss history (same modes as the fused loop)
+        if cfg.loss_mode == "y":
+            loss_hist.append(f_y + float(reg_value(y)))
+        elif cfg.loss_mode == "x_strict":
+            loss_hist.append(float(smooth(x)[0]) + float(reg_value(x)))
+        else:  # 'x'
+            if f_x_reuse is None:
+                ls = smooth_loss or (lambda w: smooth(w)[0])
+                f_x_reuse = float(ls(x))
+            loss_hist.append(f_x_reuse + float(reg_value(x)))
+
+        if not math.isfinite(f_y):
+            aborted = True
+            break
+
+        norm_x = float(tvec.norm(x))
+        norm_dx = float(tvec.norm(tvec.sub(x, x_old)))
+        if norm_dx == 0.0 and n_iter > 1:
+            break
+        if norm_dx < cfg.convergence_tol * max(norm_x, 1.0):
+            break
+
+        if cfg.may_restart and float(tvec.dot(g_y, tvec.sub(x, x_old))) > 0:
+            z = x
+            theta = math.inf
+            bts = True
+            n_restart += 1
+
+    return HostAGDResult(
+        weights=x, loss_history=np.asarray(loss_hist),
+        num_iters=len(loss_hist), aborted_non_finite=aborted,
+        final_l=big_l, num_backtracks=n_bt, num_restarts=n_restart)

@@ -1,0 +1,124 @@
+"""Macro-batch streaming: full-batch AGD semantics on larger-than-HBM data.
+
+SURVEY §7 hard part 4: at the 1B-row north-star scale, the dataset cannot
+live in device memory, but AGD is a *full-batch* method — every
+``applySmooth`` must see every example.  The reference's treeAggregate
+seqOp/combOp split (reference ``:196-204``) maps exactly onto streaming:
+each macro-batch's jit-compiled kernel is the (vectorised) seqOp, and the
+host-side accumulation of ``(Σloss, Σgrad, n)`` across macro-batches is the
+combOp — associative sums, one division at the very end (reference ``:207``
+semantics preserved bit-for-bit up to summation order).
+
+The streamed smooth is a *host-level* callable (Python loop inside), so it
+pairs with ``core.host_agd.run_agd_host`` — the driver-orchestrated twin of
+the fused loop — rather than with ``lax.while_loop``.  Counts accumulate as
+Python ints (no 2^31 wrap at any scale; see ``ops.losses._count``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tvec
+from ..ops.losses import Gradient
+from ..parallel import mesh as mesh_lib
+
+
+def iter_array_batches(X, y, batch_rows: int,
+                       mask=None) -> Iterator[Tuple]:
+    """Slice in-memory arrays into macro-batches (testing / memmap use —
+    np.memmap slices lazily, so this also serves on-disk dense data)."""
+    n = X.shape[0]
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        yield X[s:e], y[s:e], None if mask is None else mask[s:e]
+
+
+class StreamingDataset:
+    """A re-iterable source of ``(X, y, mask)`` macro-batches.
+
+    ``factory`` is a zero-arg callable returning a fresh iterator — AGD
+    evaluates the smooth function 2-3 times per outer iteration, so one-shot
+    generators are a footgun this interface rules out.
+    """
+
+    def __init__(self, factory: Callable[[], Iterable[Tuple]],
+                 batch_rows: Optional[int] = None):
+        self._factory = factory
+        self.batch_rows = batch_rows
+
+    @classmethod
+    def from_arrays(cls, X, y, batch_rows: int, mask=None):
+        return cls(lambda: iter_array_batches(X, y, batch_rows, mask),
+                   batch_rows)
+
+    def __iter__(self):
+        return iter(self._factory())
+
+
+def make_streaming_smooth(
+    gradient: Gradient,
+    dataset: StreamingDataset,
+    *,
+    mesh=None,
+    pad_to: Optional[int] = None,
+):
+    """Build host-level ``(smooth, smooth_loss)`` that stream macro-batches.
+
+    Each batch is (optionally) padded to ``pad_to`` rows so XLA compiles ONE
+    kernel shape instead of one per ragged tail, then placed on ``mesh``
+    (sharded over its data axis) or the default device.  Returns means, like
+    every other smooth builder.
+    """
+
+    @jax.jit
+    def batch_sums(w, X, y, mask):
+        return gradient.batch_loss_and_grad(w, X, y, mask)
+
+    def _place(X, y, mask):
+        X = np.asarray(X)
+        y = np.asarray(y)
+        n = X.shape[0]
+        if pad_to is not None and n < pad_to:
+            base = np.ones(n, np.float32) if mask is None else \
+                np.asarray(mask, np.float32)
+            X = np.concatenate(
+                [X, np.zeros((pad_to - n,) + X.shape[1:], X.dtype)])
+            y = np.concatenate([y, np.zeros(pad_to - n, y.dtype)])
+            mask = np.concatenate([base, np.zeros(pad_to - n, np.float32)])
+        if mesh is not None:
+            return mesh_lib.shard_batch(mesh, X, y, mask)
+        m = None if mask is None else jnp.asarray(mask)
+        return jnp.asarray(X), jnp.asarray(y), m
+
+    def _accumulate(w):
+        acc_loss = None
+        acc_grad = None
+        acc_n = 0
+        for X, y, mask in dataset:
+            Xd, yd, md = _place(X, y, mask)
+            ls, gs, n = batch_sums(w, Xd, yd, md)
+            acc_n += int(n)  # host int: immune to integer wrap at 1B rows
+            if acc_loss is None:
+                acc_loss, acc_grad = ls, gs
+            else:
+                acc_loss = acc_loss + ls
+                acc_grad = tvec.add(acc_grad, gs)
+        if acc_loss is None:
+            raise ValueError("streaming dataset yielded no batches")
+        return acc_loss, acc_grad, acc_n
+
+    def smooth(w):
+        ls, gs, n = _accumulate(w)
+        nf = jnp.asarray(n, ls.dtype)
+        return ls / nf, tvec.scale(1.0 / nf, gs)
+
+    def smooth_loss(w):
+        ls, _, n = _accumulate(w)
+        return ls / jnp.asarray(n, ls.dtype)
+
+    return smooth, smooth_loss

@@ -1,0 +1,113 @@
+"""Native (C++) host-runtime components, loaded over ctypes.
+
+The reference's native layer is third-party (JNI BLAS under Breeze, Netty
+transport — SURVEY §2.4); its compute equivalent here is XLA-generated TPU
+code.  What remains genuinely host-side in the TPU runtime — bulk text
+ingest — is implemented in C++ (``libsvm_parser.cpp``) and loaded lazily
+here, compiled on first use with the in-tree Makefile.  Everything degrades
+gracefully: if no toolchain is available the callers fall back to the pure-
+Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+
+class _ParseResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("nnz", ctypes.c_int64),
+        ("max_index", ctypes.c_int32),
+        ("labels", ctypes.POINTER(ctypes.c_double)),
+        ("indptr", ctypes.POINTER(ctypes.c_int64)),
+        ("indices", ctypes.POINTER(ctypes.c_int32)),
+        ("values", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s", "libsvm_parser.so"], cwd=_DIR, check=True,
+            capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load_parser() -> Optional[ctypes.CDLL]:
+    """Return the native parser library, building it if needed; None if the
+    native path is unavailable (callers must fall back)."""
+    global _LIB, _LOAD_FAILED
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_FAILED:
+            return None
+        so = os.path.join(_DIR, "libsvm_parser.so")
+        # Always invoke make: its .cpp dependency makes this a no-op when
+        # the binary is fresh, and it rebuilds stale binaries after source
+        # edits.  A pre-existing .so still serves if the toolchain is gone.
+        if not _build() and not os.path.exists(so):
+            _LOAD_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.parse_libsvm.argtypes = [ctypes.c_char_p,
+                                         ctypes.POINTER(_ParseResult)]
+            lib.parse_libsvm.restype = ctypes.c_int
+            lib.free_parse_result.argtypes = [ctypes.POINTER(_ParseResult)]
+            lib.free_parse_result.restype = None
+            _LIB = lib
+            return lib
+        except OSError:
+            _LOAD_FAILED = True
+            return None
+
+
+def parse_libsvm_native(path: str):
+    """Parse with the C++ core.  Returns ``(labels, indptr, indices,
+    values, n_features)`` as NumPy arrays (copies — the C buffers are freed
+    before returning), or None when the native library is unavailable.
+    Raises ValueError on malformed input."""
+    import numpy as np
+
+    lib = load_parser()
+    if lib is None:
+        return None
+    res = _ParseResult()
+    rc = lib.parse_libsvm(os.fsencode(path), ctypes.byref(res))
+    if rc == -1:  # fopen failed
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        raise OSError(f"cannot open {path!r}")
+    if rc == -5:
+        raise MemoryError(f"native LIBSVM parser out of memory on {path!r}")
+    if rc == -6:
+        raise OSError(f"I/O error reading {path!r}")
+    if rc < 0:
+        raise ValueError(
+            f"malformed LIBSVM file {path!r} (native parser code {rc})")
+    try:
+        n, nnz = res.n_rows, res.nnz
+        n_features = int(res.max_index) + 1  # read before the free clears it
+        labels = np.ctypeslib.as_array(res.labels, (n,)).copy() if n else \
+            np.zeros(0)
+        indptr = np.ctypeslib.as_array(res.indptr, (n + 1,)).copy()
+        indices = (np.ctypeslib.as_array(res.indices, (nnz,)).copy()
+                   if nnz else np.zeros(0, np.int32))
+        values = (np.ctypeslib.as_array(res.values, (nnz,)).copy()
+                  if nnz else np.zeros(0, np.float32))
+    finally:
+        lib.free_parse_result(ctypes.byref(res))
+    return labels, indptr, indices, values, n_features

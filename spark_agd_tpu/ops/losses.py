@@ -40,6 +40,8 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+from .sparse import matvec, rmatvec
+
 Array = jax.Array
 
 
@@ -108,7 +110,7 @@ class LogisticGradient(Gradient):
     """
 
     def batch_loss_and_grad(self, weights, X, y, mask=None):
-        margins = -(X @ weights)  # (N,)  — the only (N,D)·(D,) matmul
+        margins = -matvec(X, weights)  # (N,) — the only (N,D)@(D,) product
         y = y.astype(margins.dtype)
         m = _as_mask(mask, margins.dtype)
         # loss_i = softplus(margin) - (1 - y_i) * margin   (MLlib 1.3 form)
@@ -118,7 +120,7 @@ class LogisticGradient(Gradient):
             per = per * m
             multipliers = multipliers * m
         loss_sum = jnp.sum(per)
-        grad_sum = X.T @ multipliers
+        grad_sum = rmatvec(X, multipliers)
         return loss_sum, grad_sum, _count(X, mask)
 
 
@@ -130,13 +132,13 @@ class LeastSquaresGradient(Gradient):
     """
 
     def batch_loss_and_grad(self, weights, X, y, mask=None):
-        preds = X @ weights
+        preds = matvec(X, weights)
         diff = preds - y.astype(preds.dtype)  # cast to matmul-result dtype
         m = _as_mask(mask, diff.dtype)
         if m is not None:
             diff = diff * m  # zeroes both the loss and the grad of pad rows
         loss_sum = jnp.sum(diff * diff)
-        grad_sum = 2.0 * (X.T @ diff)
+        grad_sum = 2.0 * rmatvec(X, diff)
         return loss_sum, grad_sum, _count(X, mask)
 
 
@@ -144,7 +146,7 @@ class HingeGradient(Gradient):
     """SVM hinge loss; {0,1} labels rescaled to {-1,+1} (BASELINE config 3)."""
 
     def batch_loss_and_grad(self, weights, X, y, mask=None):
-        dots = X @ weights
+        dots = matvec(X, weights)
         s = 2.0 * y.astype(dots.dtype) - 1.0
         margin = 1.0 - s * dots
         active = margin > 0.0
@@ -156,7 +158,7 @@ class HingeGradient(Gradient):
             mult = mult * m
         loss_sum = jnp.sum(per)
         # grad_i = -s_i x_i where active, else 0  ==  X^T(-s * active)
-        grad_sum = X.T @ mult
+        grad_sum = rmatvec(X, mult)
         return loss_sum, grad_sum, _count(X, mask)
 
 
@@ -174,7 +176,7 @@ class SoftmaxGradient(Gradient):
         self.num_classes = int(num_classes)
 
     def batch_loss_and_grad(self, weights, X, y, mask=None):
-        logits = X @ weights  # (N, K)
+        logits = matvec(X, weights)  # (N, K)
         logz = jax.scipy.special.logsumexp(logits, axis=-1)  # (N,)
         picked = jnp.take_along_axis(
             logits, y.astype(jnp.int32)[:, None], axis=-1
@@ -189,7 +191,7 @@ class SoftmaxGradient(Gradient):
             per = per * m
             resid = resid * m[:, None]
         loss_sum = jnp.sum(per)
-        grad_sum = X.T @ resid  # (D, K)
+        grad_sum = rmatvec(X, resid)  # (D, K)
         return loss_sum, grad_sum, _count(X, mask)
 
 

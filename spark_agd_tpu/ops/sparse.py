@@ -1,0 +1,115 @@
+"""CSR sparse matrix for TPU kernels (SURVEY §7 hard part 3).
+
+rcv1.binary (~47k features) and url_combined (~3.2M features) are far too
+sparse to densify at full scale.  The MXU cannot consume CSR directly, so
+the sparse path lowers to gather + ``segment_sum`` (matvec) and a scatter-
+add (rmatvec) — XLA compiles both to decent TPU code, and the row-id
+layout (COO-style, not indptr) is exactly what ``segment_sum`` wants and
+what shards cleanly by nnz ranges later.
+
+``CSRMatrix`` is a pytree (arrays are leaves, shape is static aux data) so
+it can close over jit/shard_map boundaries and ride inside the fused AGD
+loop like any dense operand.  The loss kernels dispatch on it through
+``ops.losses.matvec``/``rmatvec`` — the same ``Gradient`` classes serve
+dense and sparse data.
+
+Padding contract: ``nnz`` may include padding entries (value 0.0 pointing
+at row 0 / col 0) so nnz-sharded layouts can be rectangular; zero values
+contribute nothing to either product.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class CSRMatrix:
+    """Row-sparse matrix in COO-with-row-ids form.
+
+    ``row_ids``/``col_ids``/``values`` are (nnz,) arrays; ``shape`` is
+    static.  Build from scipy-style CSR via ``from_csr_arrays``.
+    """
+
+    def __init__(self, row_ids, col_ids, values, shape: Tuple[int, int]):
+        self.row_ids = row_ids
+        self.col_ids = col_ids
+        self.values = values
+        self.shape = tuple(shape)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.row_ids, self.col_ids, self.values), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, leaves):
+        return cls(*leaves, shape=shape)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_csr_arrays(cls, indptr, indices, values,
+                        n_features: int) -> "CSRMatrix":
+        indptr = np.asarray(indptr)
+        n_rows = len(indptr) - 1
+        counts = np.diff(indptr)
+        row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), counts)
+        return cls(jnp.asarray(row_ids), jnp.asarray(indices, jnp.int32),
+                   jnp.asarray(values), (n_rows, int(n_features)))
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    # -- products ----------------------------------------------------------
+    def matvec(self, w):
+        """``X @ w`` -> (n_rows,): gather + segment-sum over rows."""
+        prods = self.values * jnp.take(w, self.col_ids, axis=0)
+        return jax.ops.segment_sum(prods, self.row_ids,
+                                   num_segments=self.shape[0])
+
+    def rmatvec(self, v):
+        """``X.T @ v`` -> (n_features,): scatter-add into columns.  Output
+        dtype follows promotion rules, matching the dense ``X.T @ v``."""
+        contrib = self.values * jnp.take(v, self.row_ids, axis=0)
+        out_dt = jnp.result_type(self.values, v)
+        return jnp.zeros(self.shape[1], out_dt).at[self.col_ids].add(contrib)
+
+    def matmat(self, W):
+        """``X @ W`` for (D, K) dense W -> (n_rows, K)."""
+        prods = self.values[:, None] * jnp.take(W, self.col_ids, axis=0)
+        return jax.ops.segment_sum(prods, self.row_ids,
+                                   num_segments=self.shape[0])
+
+    def rmatmat(self, V):
+        """``X.T @ V`` for (n_rows, K) dense V -> (n_features, K)."""
+        contrib = self.values[:, None] * jnp.take(V, self.row_ids, axis=0)
+        out_dt = jnp.result_type(self.values, V)
+        return jnp.zeros((self.shape[1], V.shape[1]),
+                         out_dt).at[self.col_ids].add(contrib)
+
+
+def matvec(X, w):
+    """Polymorphic ``X @ w`` (dense array or CSRMatrix) used by the loss
+    kernels; 2-D ``w`` routes to matmat."""
+    if isinstance(X, CSRMatrix):
+        return X.matmat(w) if w.ndim == 2 else X.matvec(w)
+    return X @ w
+
+
+def rmatvec(X, v):
+    """Polymorphic ``X.T @ v``."""
+    if isinstance(X, CSRMatrix):
+        return X.rmatmat(v) if v.ndim == 2 else X.rmatvec(v)
+    return X.T @ v
+
+
+def n_rows(X) -> int:
+    return X.shape[0]
