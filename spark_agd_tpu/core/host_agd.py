@@ -44,6 +44,8 @@ def run_agd_host(
     smooth_loss: Callable | None = None,
 ) -> HostAGDResult:
     cfg = config
+    if cfg.loss_mode not in ("x", "x_strict", "y"):
+        raise ValueError(f"unknown loss_mode {cfg.loss_mode!r}")
     x = w0
     z = x
     theta = math.inf
@@ -65,7 +67,11 @@ def run_agd_host(
         g_y = None
         y = x
         f_x_reuse = None
-        for _ in range(cfg.max_backtracks):
+        # do-while, like the fused loop's unconditional body(init): the
+        # first trial always runs, and max_backtracks total trials run when
+        # every trial rejects — identical to core.agd's body(init) +
+        # ``while n_bt < max_backtracks`` structure.
+        for _ in range(max(1, cfg.max_backtracks)):
             theta = 2.0 / (1.0 + math.sqrt(
                 1.0 + 4.0 * (big_l / l_old) / (theta_old * theta_old)))
             y = tvec.axpby(1.0 - theta, x_old, theta, z_old)

@@ -79,6 +79,14 @@ def make_streaming_smooth(
     def batch_sums(w, X, y, mask):
         return gradient.batch_loss_and_grad(w, X, y, mask)
 
+    # Loss-only twin: the gradient is a jit *output* in batch_sums, so XLA
+    # cannot dead-code-eliminate it there — a separate kernel lets the
+    # rmatvec (size-D work per macro-batch) vanish entirely.
+    @jax.jit
+    def batch_loss_sums(w, X, y, mask):
+        ls, _, n = gradient.batch_loss_and_grad(w, X, y, mask)
+        return ls, n
+
     def _place(X, y, mask):
         X = np.asarray(X)
         y = np.asarray(y)
@@ -95,30 +103,31 @@ def make_streaming_smooth(
         m = None if mask is None else jnp.asarray(mask)
         return jnp.asarray(X), jnp.asarray(y), m
 
-    def _accumulate(w):
-        acc_loss = None
-        acc_grad = None
+    def _fold(kernel, combine, w):
+        """Stream the dataset through ``kernel(w, X, y, mask) -> (sums…, n)``,
+        combining device sums with ``combine`` and counts as host ints
+        (immune to integer wrap at 1B rows)."""
+        acc = None
         acc_n = 0
         for X, y, mask in dataset:
             Xd, yd, md = _place(X, y, mask)
-            ls, gs, n = batch_sums(w, Xd, yd, md)
-            acc_n += int(n)  # host int: immune to integer wrap at 1B rows
-            if acc_loss is None:
-                acc_loss, acc_grad = ls, gs
-            else:
-                acc_loss = acc_loss + ls
-                acc_grad = tvec.add(acc_grad, gs)
-        if acc_loss is None:
+            *sums, n = kernel(w, Xd, yd, md)
+            acc_n += int(n)
+            acc = sums if acc is None else combine(acc, sums)
+        if acc is None:
             raise ValueError("streaming dataset yielded no batches")
-        return acc_loss, acc_grad, acc_n
+        return acc, acc_n
 
     def smooth(w):
-        ls, gs, n = _accumulate(w)
+        (ls, gs), n = _fold(
+            batch_sums,
+            lambda a, b: [a[0] + b[0], tvec.add(a[1], b[1])], w)
         nf = jnp.asarray(n, ls.dtype)
         return ls / nf, tvec.scale(1.0 / nf, gs)
 
     def smooth_loss(w):
-        ls, _, n = _accumulate(w)
+        (ls,), n = _fold(
+            batch_loss_sums, lambda a, b: [a[0] + b[0]], w)
         return ls / jnp.asarray(n, ls.dtype)
 
     return smooth, smooth_loss

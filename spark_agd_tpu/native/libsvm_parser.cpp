@@ -71,10 +71,15 @@ static int parse_buffer(const char* p, const char* end, ParseResult* out) {
       continue;
     }
 
+    // NOTE on ERANGE: strtod sets it for values that overflow (-> +-inf)
+    // or underflow (-> denormal/0), but still returns the best-effort
+    // conversion — exactly what Python's float() yields for the same
+    // token.  Treating ERANGE as malformed would make the two parsers
+    // disagree on files containing e.g. `1:4.9e-324`; only a failed
+    // conversion (next == p) is a parse error.
     char* next = nullptr;
-    errno = 0;
     double label = std::strtod(p, &next);
-    if (next == p || errno == ERANGE) return -2;  // malformed label
+    if (next == p) return -2;  // malformed label
     p = next;
 
     while (p < end && *p != '\n' && *p != '#') {
@@ -86,9 +91,8 @@ static int parse_buffer(const char* p, const char* end, ParseResult* out) {
           idx > INT32_MAX)
         return -3;  // malformed index
       p = next + 1;
-      errno = 0;
       double v = std::strtod(p, &next);
-      if (next == p || errno == ERANGE) return -4;  // malformed value
+      if (next == p) return -4;  // malformed value (ERANGE ok, see label)
       p = next;
       int32_t zero_based = static_cast<int32_t>(idx - 1);
       if (zero_based > max_index) max_index = zero_based;

@@ -110,6 +110,3 @@ def rmatvec(X, v):
         return X.rmatmat(v) if v.ndim == 2 else X.rmatvec(v)
     return X.T @ v
 
-
-def n_rows(X) -> int:
-    return X.shape[0]
