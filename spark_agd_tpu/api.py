@@ -29,6 +29,7 @@ import numpy as np
 from .core import agd, gd, smooth as smooth_lib
 from .ops.losses import Gradient
 from .ops.prox import Prox
+from .ops.sparse import CSRMatrix
 from .parallel import dist_smooth, mesh as mesh_lib
 
 Data = Union[Tuple, "mesh_lib.ShardedBatch"]
@@ -67,7 +68,9 @@ def _build_smooth(gradient, data, mesh, dist_mode):
             X, y, mask = data
         else:
             X, y, mask = data
-            X, y = jnp.asarray(X), jnp.asarray(y)
+            if not isinstance(X, CSRMatrix):
+                X = jnp.asarray(X)
+            y = jnp.asarray(y)
             mask = None if mask is None else jnp.asarray(mask)
         return (smooth_lib.make_smooth(gradient, X, y, mask),
                 smooth_lib.make_smooth_loss(gradient, X, y, mask))
@@ -115,6 +118,16 @@ def run(
             raise ValueError(
                 "explicit mesh differs from the ShardedBatch's mesh; "
                 "re-shard the batch or drop the mesh argument")
+    if (not isinstance(data, mesh_lib.ShardedBatch)
+            and isinstance(data[0], CSRMatrix)):
+        # CSR batches are not mesh-shardable yet (nnz-range sharding is a
+        # separate layout problem); run them single-device unless the caller
+        # explicitly asked for a mesh.
+        if mesh not in (None, False):
+            raise NotImplementedError(
+                "mesh-sharded CSRMatrix data is not supported yet; "
+                "densify or pre-shard by rows")
+        mesh = False
     m = _resolve_mesh(mesh)
     sm, sl = _build_smooth(gradient, data, m, dist_mode)
     px, rv = smooth_lib.make_prox(updater, reg_param)

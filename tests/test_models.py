@@ -1,0 +1,177 @@
+"""Model-layer tests: the GeneralizedLinearAlgorithm-style trainers (glm.py)
+and the config-5 MLP custom gradient (mlp.py).
+
+The reference has no model layer of its own — it plugs into MLlib's (class
+doc, reference ``AcceleratedGradientDescent.scala:31-39``) — so these tests
+pin the *workflow* parity: a configurable ``.optimizer`` field, train →
+typed model → predict, intercept handling matching the reference suite's
+manual 1.0-column (Suite:47-49).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import models
+from spark_agd_tpu.data import synthetic
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.ops import sparse
+from spark_agd_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def logistic_data():
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 2000, 42)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+class TestLogisticRegression:
+    def test_train_predict(self, logistic_data):
+        X, y = logistic_data
+        lr = models.LogisticRegressionWithAGD(reg_param=0.01)
+        lr.optimizer.set_num_iterations(30)
+        model = lr.train(X, y)
+        acc = float(np.mean(np.asarray(model.predict(X)) == y))
+        assert acc > 0.7, f"accuracy {acc}"
+        # generating model: intercept +2.0, slope -1.5 → signs must match
+        assert model.intercept > 0
+        assert float(model.weights[0]) < 0
+
+    def test_threshold_semantics(self, logistic_data):
+        X, y = logistic_data
+        lr = models.LogisticRegressionWithAGD()
+        lr.optimizer.set_num_iterations(5)
+        model = lr.train(X, y)
+        hard = np.asarray(model.predict(X))
+        assert set(np.unique(hard)) <= {0.0, 1.0}
+        soft = np.asarray(model.clear_threshold().predict(X))
+        assert np.all((soft >= 0) & (soft <= 1))
+        assert len(np.unique(soft)) > 2  # raw probabilities now
+
+    def test_csr_matches_dense(self, logistic_data):
+        X, y = logistic_data
+        # CSR-ify the dense 1-column matrix; same training answer expected.
+        indptr = np.arange(X.shape[0] + 1)
+        indices = np.zeros(X.shape[0], np.int32)
+        Xs = sparse.CSRMatrix.from_csr_arrays(
+            indptr, indices, X[:, 0].astype(np.float32), 1)
+        for csr in (False, True):
+            lr = models.LogisticRegressionWithAGD(reg_param=0.1)
+            lr.optimizer.set_num_iterations(10)
+            m = lr.train(Xs if csr else X, y)
+            if csr:
+                got = (np.asarray(m.weights), m.intercept)
+            else:
+                want = (np.asarray(m.weights), m.intercept)
+        np.testing.assert_allclose(got[0], want[0], rtol=1e-5)
+        assert got[1] == pytest.approx(want[1], rel=1e-5)
+
+
+class TestLinearRegression:
+    def test_recovers_weights(self):
+        w_true = np.array([1.5, -2.0, 0.5])
+        X, y = synthetic.generate_linear_input(w_true, 4000, 7, noise=0.01)
+        X, y = X.astype(np.float32), y.astype(np.float32)
+        lin = models.LinearRegressionWithAGD()
+        lin.optimizer.set_num_iterations(100).set_convergence_tol(1e-8)
+        model = lin.train(X, y)
+        np.testing.assert_allclose(
+            np.asarray(model.weights), w_true, atol=0.03)
+        assert abs(model.intercept) < 0.03
+        pred = np.asarray(model.predict(X))
+        assert float(np.mean((pred - y) ** 2)) < 0.01
+
+
+class TestSVM:
+    def test_separable(self, rng):
+        n = 1000
+        X = rng.normal(size=(n, 2)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        svm = models.SVMWithAGD(reg_param=0.001)
+        svm.optimizer.set_num_iterations(50)
+        model = svm.train(X, y)
+        acc = float(np.mean(np.asarray(model.predict(X)) == y))
+        assert acc > 0.95, f"accuracy {acc}"
+        raw = np.asarray(model.clear_threshold().predict(X))
+        assert not set(np.unique(raw)) <= {0.0, 1.0}  # raw margins
+
+
+class TestSoftmaxRegression:
+    def test_multiclass(self):
+        X, y = synthetic.generate_multiclass_input(800, 10, 4, 3)
+        X = X.astype(np.float32)
+        sm = models.SoftmaxRegressionWithAGD(num_classes=4, reg_param=0.01)
+        sm.optimizer.set_num_iterations(40)
+        model = sm.train(X, y)
+        assert model.weights.shape == (10, 4)
+        assert model.num_classes == 4
+        acc = float(np.mean(np.asarray(model.predict(X)) == y))
+        assert acc > 0.7, f"accuracy {acc}"
+        probs = np.asarray(model.predict_proba(X))
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_mesh_matches_local(self, cpu_devices):
+        X, y = synthetic.generate_multiclass_input(404, 6, 3, 5)  # pads: 404 % 8 != 0
+        X = X.astype(np.float32)
+        got = {}
+        for name, mesh in (("local", False),
+                           ("dp", mesh_lib.make_mesh({"data": 8}))):
+            sm = models.SoftmaxRegressionWithAGD(
+                num_classes=3, reg_param=0.1,
+                mesh=mesh if name != "local" else None)
+            if name == "local":
+                sm.optimizer.set_mesh(False)
+            sm.optimizer.set_num_iterations(8)
+            got[name] = np.asarray(sm.train(X, y).weights)
+        np.testing.assert_allclose(got["dp"], got["local"], rtol=2e-5,
+                                   atol=1e-7)
+
+
+class TestMLP:
+    def test_learns_xor(self, rng):
+        base = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+        labels = np.array([0, 1, 1, 0], np.int32)
+        reps = 100
+        X = np.tile(base, (reps, 1)) + 0.05 * rng.normal(
+            size=(4 * reps, 2)).astype(np.float32)
+        y = np.tile(labels, reps)
+        clf = models.MLPClassifierWithAGD(hidden_units=8, num_classes=2,
+                                          seed=1)
+        clf.optimizer.set_num_iterations(150).set_convergence_tol(0.0)
+        model = clf.train(X, y)
+        acc = float(np.mean(np.asarray(model.predict(X)) == y))
+        assert acc > 0.95, f"XOR accuracy {acc} (non-convex AGD)"
+
+    def test_zero_init_is_stuck(self):
+        # documents why init_mlp_params is random: zero init is a symmetric
+        # saddle — training cannot split the hidden units.
+        X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+        y = np.array([0, 1, 1, 0], np.int32)
+        clf = models.MLPClassifierWithAGD(hidden_units=4, num_classes=2)
+        clf.optimizer.set_num_iterations(20)
+        zeros = {k: jnp.zeros_like(v) for k, v in
+                 models.init_mlp_params(2, 4, 2).items()}
+        model = clf.train(X, y, initial_params=zeros)
+        W1 = np.asarray(model.params["W1"])
+        np.testing.assert_allclose(W1[:, 0], W1[:, 1])  # units never split
+
+    def test_gradient_matches_finite_difference(self, rng):
+        X = rng.normal(size=(16, 3)).astype(np.float64)
+        y = rng.integers(0, 2, 16)
+        params = {k: v.astype(jnp.float64) for k, v in
+                  models.init_mlp_params(3, 5, 2, seed=2).items()}
+        g = models.mlp_gradient("tanh")
+        loss, grads, n = g.batch_loss_and_grad(params, X, y)
+        assert int(n) == 16
+        loss_fn = models.make_mlp_loss_sum()
+        eps = 1e-6
+        for key in ("W1", "b2"):
+            flat = np.asarray(params[key], np.float64).ravel()
+            idx = 1 % flat.size
+            bump = np.zeros_like(flat)
+            bump[idx] = eps
+            p_plus = dict(params)
+            p_plus[key] = params[key] + bump.reshape(params[key].shape)
+            fd = (float(loss_fn(p_plus, X, y)) - float(loss)) / eps
+            got = float(np.asarray(grads[key]).ravel()[idx])
+            assert fd == pytest.approx(got, rel=1e-3, abs=1e-6)
