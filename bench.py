@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 import time
 
@@ -30,10 +31,12 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-N_ROWS = 1 << 19
-N_FEATURES = 512
-NUM_ITERS_TPU = 40
-NUM_ITERS_CPU = 5
+# Overridable for off-TPU smoke runs (e.g. BENCH_ROWS=4096 on CPU); the
+# defaults are the measured configuration.
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1 << 19))
+N_FEATURES = int(os.environ.get("BENCH_FEATURES", 512))
+NUM_ITERS_TPU = int(os.environ.get("BENCH_ITERS_TPU", 40))
+NUM_ITERS_CPU = int(os.environ.get("BENCH_ITERS_CPU", 5))
 REG = 0.1
 
 
@@ -128,7 +131,7 @@ def main():
                 "vs_baseline would compare different computations")
     log(f"loss-trajectory parity ok over {k} iterations")
     print(json.dumps({
-        "metric": "agd_iterations_per_sec_logistic_524288x512",
+        "metric": f"agd_iterations_per_sec_logistic_{N_ROWS}x{N_FEATURES}",
         "value": round(tpu_ips, 2),
         "unit": "iters/sec",
         "vs_baseline": round(tpu_ips / cpu_ips, 2),

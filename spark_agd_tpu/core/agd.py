@@ -77,6 +77,29 @@ class AGDConfig:
     loss_mode: str = "x"  # 'x' | 'x_strict' | 'y'
 
 
+class AGDWarmState(NamedTuple):
+    """The complete inter-iteration carry of the optimizer — what SURVEY §5
+    calls "2 vectors + 3 scalars" (plus the estimator-switch flag): enough
+    to continue a run exactly where it stopped.  ``prior_iters`` feeds the
+    ``nIter > 1`` gate on exact-zero steps (reference ``:317-321``) so a
+    resumed run makes the same stop decisions as an uninterrupted one."""
+
+    x: Any
+    z: Any
+    theta: Any
+    big_l: Any
+    bts: Any
+    prior_iters: Any
+
+    @classmethod
+    def initial(cls, w0: Any, config: "AGDConfig") -> "AGDWarmState":
+        """The iteration-zero carry (reference init ``:224-235``): the ONE
+        definition all three drivers (fused, host, checkpointed) expand, so
+        cold start and resume-from-zero cannot drift apart."""
+        return cls(x=w0, z=w0, theta=math.inf, big_l=float(config.l0),
+                   bts=True, prior_iters=0)
+
+
 class AGDResult(NamedTuple):
     weights: Any
     loss_history: jax.Array  # (num_iterations,), NaN-padded past num_iters
@@ -85,6 +108,11 @@ class AGDResult(NamedTuple):
     final_l: jax.Array  # Lipschitz estimate at exit
     num_backtracks: jax.Array
     num_restarts: jax.Array
+    # the carry needed to continue this run (checkpoint/resume; utils/)
+    final_z: Any
+    final_theta: jax.Array
+    final_bts: jax.Array
+    converged: jax.Array  # stopped by its own criteria (not the iter cap)
     # per-iteration diagnostics (NaN/0-padded): the values the reference
     # computes and discards (SURVEY §5 metrics gap)
     diag_l: jax.Array
@@ -133,6 +161,7 @@ def run_agd(
     config: AGDConfig,
     *,
     smooth_loss: LossFn | None = None,
+    warm: AGDWarmState | None = None,
 ) -> AGDResult:
     """Pure, trace-compatible AGD.  Wrap in ``jax.jit`` (the API layer does).
 
@@ -142,6 +171,11 @@ def run_agd(
     reference's ``step = 0`` prox trick (reference ``:305``).
     ``smooth_loss(w) -> mean_loss`` is an optional loss-only evaluation used
     by ``loss_mode='x'`` when backtracking is disabled (``beta >= 1``).
+
+    ``warm`` resumes from a saved ``AGDWarmState`` (``w0`` is then ignored
+    except as the structure template): the run continues bit-exactly where
+    the checkpointed one stopped, executing up to ``config.num_iterations``
+    *further* iterations.
     """
     cfg = config
     if cfg.loss_mode not in ("x", "x_strict", "y"):
@@ -253,7 +287,8 @@ def run_agd(
         aborted = ~jnp.isfinite(t.f_y)  # NaN guard, reference :309-312
         norm_x = tvec.norm(t.x)
         norm_dx = tvec.norm(tvec.sub(t.x, x_old))
-        done_zero = jnp.logical_and(norm_dx == 0.0, it_new > 1)
+        done_zero = jnp.logical_and(norm_dx == 0.0,
+                                    it_new + prior_iters > 1)
         done_tol = norm_dx < tol * jnp.maximum(norm_x, 1.0)
         done = aborted | done_zero | done_tol
 
@@ -282,9 +317,15 @@ def run_agd(
         return jnp.logical_and(o.it < cfg.num_iterations, ~o.done)
 
     n = cfg.num_iterations
+    if warm is None:
+        warm = AGDWarmState.initial(w0, cfg)
+    x0, z0 = warm.x, warm.z
+    theta0, l_init = s(warm.theta), s(warm.big_l)
+    bts0 = jnp.asarray(warm.bts, jnp.bool_)
+    prior_iters = jnp.asarray(warm.prior_iters, jnp.int32)
     init = _Outer(
-        x=w0, z=w0,
-        theta=s(jnp.inf), big_l=s(cfg.l0), bts=jnp.asarray(True),
+        x=x0, z=z0,
+        theta=theta0, big_l=l_init, bts=bts0,
         it=jnp.zeros((), jnp.int32), done=jnp.asarray(False),
         aborted=jnp.asarray(False),
         loss_hist=jnp.full((n,), jnp.nan, dt),
@@ -300,6 +341,8 @@ def run_agd(
         weights=o.x, loss_history=o.loss_hist, num_iters=o.it,
         aborted_non_finite=o.aborted, final_l=o.big_l,
         num_backtracks=o.n_bt, num_restarts=o.n_restart,
+        final_z=o.z, final_theta=o.theta, final_bts=o.bts,
+        converged=o.done,
         diag_l=o.diag_l, diag_theta=o.diag_theta, diag_step=o.diag_step,
         diag_restarted=o.diag_restarted,
     )

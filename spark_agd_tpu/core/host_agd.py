@@ -21,7 +21,7 @@ from typing import Any, Callable, List, NamedTuple, Tuple
 import numpy as np
 
 from . import tvec
-from .agd import AGDConfig
+from .agd import AGDConfig, AGDWarmState
 
 
 class HostAGDResult(NamedTuple):
@@ -32,6 +32,10 @@ class HostAGDResult(NamedTuple):
     final_l: float
     num_backtracks: int
     num_restarts: int
+    # continuation carry (mirrors core.agd.AGDResult; utils.checkpoint)
+    final_z: Any = None
+    final_theta: float = math.inf
+    final_bts: bool = True
 
 
 def run_agd_host(
@@ -42,22 +46,30 @@ def run_agd_host(
     config: AGDConfig,
     *,
     smooth_loss: Callable | None = None,
+    warm=None,
+    on_iteration: Callable | None = None,
 ) -> HostAGDResult:
+    """``warm`` is a ``core.agd.AGDWarmState`` (or any object with the same
+    fields) to continue a checkpointed run; ``on_iteration(state_dict)`` is
+    called after every outer iteration with the full continuation carry plus
+    that iteration's loss — the checkpoint/metrics hook (SURVEY §5)."""
     cfg = config
     if cfg.loss_mode not in ("x", "x_strict", "y"):
         raise ValueError(f"unknown loss_mode {cfg.loss_mode!r}")
-    x = w0
-    z = x
-    theta = math.inf
-    big_l = float(cfg.l0)
-    bts = True
+    if warm is None:
+        warm = AGDWarmState.initial(w0, cfg)
+    x, z = warm.x, warm.z
+    theta = float(warm.theta)
+    big_l = float(warm.big_l)
+    bts = bool(warm.bts)
+    prior_iters = int(warm.prior_iters)
     loss_hist: List[float] = []
     n_bt = 0
     n_restart = 0
     aborted = False
     backtracking = cfg.beta < 1.0
 
-    for n_iter in range(1, cfg.num_iterations + 1):
+    for n_iter in range(prior_iters + 1, prior_iters + cfg.num_iterations + 1):
         x_old, z_old = x, z
         l_old = big_l
         big_l = big_l * cfg.alpha
@@ -126,22 +138,44 @@ def run_agd_host(
 
         if not math.isfinite(f_y):
             aborted = True
+            if on_iteration is not None:
+                on_iteration(_carry(x, z, theta, big_l, bts, n_iter,
+                                    loss_hist[-1], aborted=True,
+                                    stopped=True))
             break
 
+        stop = False
         norm_x = float(tvec.norm(x))
         norm_dx = float(tvec.norm(tvec.sub(x, x_old)))
         if norm_dx == 0.0 and n_iter > 1:
-            break
-        if norm_dx < cfg.convergence_tol * max(norm_x, 1.0):
-            break
-
-        if cfg.may_restart and float(tvec.dot(g_y, tvec.sub(x, x_old))) > 0:
+            stop = True
+        elif norm_dx < cfg.convergence_tol * max(norm_x, 1.0):
+            stop = True
+        elif cfg.may_restart \
+                and float(tvec.dot(g_y, tvec.sub(x, x_old))) > 0:
             z = x
             theta = math.inf
             bts = True
             n_restart += 1
 
+        if on_iteration is not None:
+            on_iteration(_carry(x, z, theta, big_l, bts, n_iter,
+                                loss_hist[-1], stopped=stop))
+        if stop:
+            break
+
     return HostAGDResult(
         weights=x, loss_history=np.asarray(loss_hist),
         num_iters=len(loss_hist), aborted_non_finite=aborted,
-        final_l=big_l, num_backtracks=n_bt, num_restarts=n_restart)
+        final_l=big_l, num_backtracks=n_bt, num_restarts=n_restart,
+        final_z=z, final_theta=theta, final_bts=bts)
+
+
+def _carry(x, z, theta, big_l, bts, n_iter, loss, aborted=False,
+           stopped=False) -> dict:
+    """The on_iteration payload: the exact continuation carry + metrics.
+    ``stopped`` marks the converged final iteration; ``aborted`` the
+    non-finite one (which also stops)."""
+    return dict(x=x, z=z, theta=theta, big_l=big_l, bts=bts,
+                prior_iters=n_iter, loss=loss, aborted=aborted,
+                stopped=stopped or aborted)

@@ -1,0 +1,191 @@
+"""Checkpoint / resume — elastic restart for AGD runs.
+
+The reference persists nothing (SURVEY §5 "Checkpoint / resume: none") and
+delegates within-run fault tolerance to Spark task retry.  The TPU runtime
+has no lineage recomputation, so the equivalent robustness story is the one
+SURVEY §5 sketches: the *entire* optimizer state is two weight pytrees plus
+three scalars (``core.agd.AGDWarmState``), so re-runnable outer segments +
+tiny checkpoints give elastic restart almost for free.
+
+Format: one ``.npz`` per checkpoint (atomic rename), holding the flattened
+``x``/``z`` pytree leaves, the scalar carry, and the cumulative loss
+history.  Loading needs a *template* pytree (normally ``w0``) to rebuild the
+tree structure — the file stores leaves positionally, not a pickled treedef,
+so checkpoints are plain data (no code execution on load).
+
+``run_agd_checkpointed`` drives the fused ``core.agd.run_agd`` in segments
+of ``segment_iters`` compiled iterations, checkpointing between segments and
+resuming from ``path`` if a checkpoint exists.  Segment boundaries are
+invisible to the math: the warm carry is exact (including the ``nIter > 1``
+zero-step gate via ``prior_iters``), pinned by the parity tests in
+``tests/test_checkpoint.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import agd
+from ..core.agd import AGDConfig, AGDWarmState
+
+
+def _flat(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
+                    *, converged: bool = False,
+                    aborted: bool = False) -> None:
+    """Atomically write the continuation carry (+ cumulative loss history).
+
+    ``converged``/``aborted`` mark a *terminal* checkpoint: the run stopped
+    by its own criteria, and resuming must be a no-op rather than extra
+    iterations (or, for abort, a resume from non-finite weights)."""
+    payload = {}
+    for name, tree in (("x", warm.x), ("z", warm.z)):
+        for i, leaf in enumerate(_flat(tree)):
+            payload[f"{name}_{i}"] = np.asarray(leaf)
+    payload["theta"] = np.asarray(float(warm.theta))
+    payload["big_l"] = np.asarray(float(warm.big_l))
+    payload["bts"] = np.asarray(bool(warm.bts))
+    payload["prior_iters"] = np.asarray(int(warm.prior_iters))
+    payload["converged"] = np.asarray(bool(converged))
+    payload["aborted"] = np.asarray(bool(aborted))
+    payload["loss_history"] = (np.zeros(0) if loss_history is None
+                               else np.asarray(loss_history))
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class LoadedCheckpoint(NamedTuple):
+    warm: AGDWarmState
+    loss_history: np.ndarray
+    converged: bool
+    aborted: bool
+
+
+def load_checkpoint(path: str, template: Any) -> Optional[LoadedCheckpoint]:
+    """Rebuild a checkpoint from ``path``; None if the file does not exist.
+    ``template`` supplies the pytree structure (and therefore leaf order)
+    of the weights — normally ``w0``."""
+    if not os.path.exists(path):
+        return None
+    treedef = jax.tree_util.tree_structure(template)
+    n = treedef.num_leaves
+    with np.load(path) as data:
+        def tree(name):
+            leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        warm = AGDWarmState(
+            x=tree("x"), z=tree("z"),
+            theta=float(data["theta"]), big_l=float(data["big_l"]),
+            bts=bool(data["bts"]), prior_iters=int(data["prior_iters"]))
+        hist = np.asarray(data["loss_history"])
+        converged = bool(data["converged"]) if "converged" in data else False
+        aborted = bool(data["aborted"]) if "aborted" in data else False
+    return LoadedCheckpoint(warm, hist, converged, aborted)
+
+
+# The iteration-zero carry is defined ONCE, in core.agd (all drivers expand
+# it); re-exported here for checkpoint-facing code.
+fresh_warm_state = AGDWarmState.initial
+
+
+def warm_from_result(res, prior_iters: int) -> AGDWarmState:
+    """Continuation carry out of an ``AGDResult`` / ``HostAGDResult``."""
+    return AGDWarmState(
+        x=res.weights, z=res.final_z, theta=float(res.final_theta),
+        big_l=float(res.final_l), bts=bool(res.final_bts),
+        prior_iters=int(prior_iters))
+
+
+class CheckpointedResult(NamedTuple):
+    weights: Any
+    loss_history: np.ndarray
+    num_iters: int  # total outer iterations across all runs of this path
+    aborted_non_finite: bool
+    resumed_from: int  # iterations already in the checkpoint at startup
+
+
+def run_agd_checkpointed(
+    smooth,
+    prox,
+    reg_value,
+    w0: Any,
+    config: AGDConfig,
+    *,
+    path: str,
+    segment_iters: int = 10,
+    smooth_loss=None,
+) -> CheckpointedResult:
+    """Fused AGD with periodic checkpoints: compile once per segment shape,
+    run ``segment_iters`` device-side iterations per launch, persist the
+    carry after each.  Kill the process at any point; rerunning the same
+    call continues from the last completed segment."""
+    if segment_iters <= 0:
+        raise ValueError("segment_iters must be positive")
+    loaded = load_checkpoint(path, w0)
+    if loaded is not None:
+        warm = loaded.warm
+        hist = list(np.asarray(loaded.loss_history))
+        if loaded.converged or loaded.aborted:
+            # terminal checkpoint: the run already stopped by its own
+            # criteria — rerunning must not execute further iterations
+            return CheckpointedResult(
+                weights=warm.x, loss_history=np.asarray(hist),
+                num_iters=int(warm.prior_iters),
+                aborted_non_finite=loaded.aborted,
+                resumed_from=int(warm.prior_iters))
+    else:
+        warm = AGDWarmState.initial(w0, config)
+        hist = []
+    resumed_from = int(warm.prior_iters)
+
+    # One jitted function per distinct segment length (at most two: the
+    # full segment and the final remainder).
+    seg_fns = {}
+
+    def run_segment(warm_state, k):
+        if k not in seg_fns:
+            cfg_k = dataclasses.replace(config, num_iterations=k)
+            seg_fns[k] = jax.jit(
+                lambda ws: agd.run_agd(
+                    smooth, prox, reg_value, ws.x, cfg_k,
+                    smooth_loss=smooth_loss, warm=ws))
+        return seg_fns[k](warm_state)
+
+    total = config.num_iterations
+    aborted = False
+    while int(warm.prior_iters) < total:
+        k = min(segment_iters, total - int(warm.prior_iters))
+        res = run_segment(warm, k)
+        done = int(res.num_iters)
+        hist.extend(np.asarray(res.loss_history)[:done].tolist())
+        warm = warm_from_result(res, int(warm.prior_iters) + done)
+        aborted = bool(res.aborted_non_finite)
+        save_checkpoint(path, warm, np.asarray(hist),
+                        converged=bool(res.converged), aborted=aborted)
+        if bool(res.converged) or done == 0:
+            break
+
+    return CheckpointedResult(
+        weights=warm.x, loss_history=np.asarray(hist),
+        num_iters=int(warm.prior_iters), aborted_non_finite=aborted,
+        resumed_from=resumed_from)

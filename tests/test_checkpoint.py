@@ -1,0 +1,203 @@
+"""Checkpoint/resume parity: segment boundaries must be invisible to the
+math (utils/checkpoint.py), and the warm-start carry must continue a run
+exactly (core.agd ``warm=``, host_agd ``warm=``)."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import utils
+from spark_agd_tpu.core import agd, host_agd, smooth as smooth_lib
+from spark_agd_tpu.data import synthetic
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture(scope="module")
+def problem():
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 500, 42)
+    X = synthetic.with_intercept_column(X).astype(np.float64)
+    y = y.astype(np.float64)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    sm = smooth_lib.make_smooth(LogisticGradient(), Xd, yd)
+    sl = smooth_lib.make_smooth_loss(LogisticGradient(), Xd, yd)
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    w0 = jnp.zeros(2, jnp.float64)
+    return sm, sl, px, rv, w0
+
+
+def _run(problem, num_iterations, warm=None, tol=0.0):
+    sm, sl, px, rv, w0 = problem
+    cfg = agd.AGDConfig(convergence_tol=tol, num_iterations=num_iterations)
+    return agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl, warm=warm)
+
+
+class TestWarmStart:
+    def test_fresh_warm_state_is_identity(self, problem):
+        cold = _run(problem, 8)
+        cfg = agd.AGDConfig(num_iterations=8)
+        warm = ckpt.fresh_warm_state(problem[4], cfg)
+        warmed = _run(problem, 8, warm=warm)
+        np.testing.assert_array_equal(np.asarray(cold.weights),
+                                      np.asarray(warmed.weights))
+        np.testing.assert_array_equal(np.asarray(cold.loss_history),
+                                      np.asarray(warmed.loss_history))
+
+    def test_split_run_matches_single_run(self, problem):
+        single = _run(problem, 12)
+        first = _run(problem, 5)
+        warm = ckpt.warm_from_result(first, 5)
+        second = _run(problem, 7, warm=warm)
+        np.testing.assert_allclose(
+            np.asarray(single.weights), np.asarray(second.weights),
+            rtol=0, atol=0)
+        hist = np.concatenate([
+            np.asarray(first.loss_history)[:5],
+            np.asarray(second.loss_history)[:7]])
+        np.testing.assert_array_equal(
+            np.asarray(single.loss_history)[:12], hist)
+
+    def test_host_warm_matches(self, problem):
+        sm, sl, px, rv, w0 = problem
+
+        def np_ify(fn):
+            return lambda w: fn(jnp.asarray(w))
+
+        cfg12 = agd.AGDConfig(convergence_tol=0.0, num_iterations=12)
+        single = host_agd.run_agd_host(sm, px, rv, w0, cfg12,
+                                       smooth_loss=sl)
+        cfg5 = agd.AGDConfig(convergence_tol=0.0, num_iterations=5)
+        first = host_agd.run_agd_host(sm, px, rv, w0, cfg5, smooth_loss=sl)
+        warm = ckpt.warm_from_result(first, 5)
+        cfg7 = agd.AGDConfig(convergence_tol=0.0, num_iterations=7)
+        second = host_agd.run_agd_host(sm, px, rv, w0, cfg7,
+                                       smooth_loss=sl, warm=warm)
+        np.testing.assert_allclose(
+            np.asarray(single.weights), np.asarray(second.weights),
+            rtol=1e-12)
+
+
+class TestSaveLoad:
+    def test_roundtrip(self, tmp_path, problem):
+        res = _run(problem, 4)
+        warm = ckpt.warm_from_result(res, 4)
+        p = str(tmp_path / "ck.npz")
+        hist = np.asarray(res.loss_history)[:4]
+        ckpt.save_checkpoint(p, warm, hist)
+        ck = ckpt.load_checkpoint(p, problem[4])
+        loaded = ck.warm
+        np.testing.assert_array_equal(np.asarray(loaded.x),
+                                      np.asarray(warm.x))
+        np.testing.assert_array_equal(np.asarray(loaded.z),
+                                      np.asarray(warm.z))
+        assert loaded.theta == pytest.approx(float(warm.theta))
+        assert loaded.big_l == pytest.approx(float(warm.big_l))
+        assert loaded.bts == bool(warm.bts)
+        assert loaded.prior_iters == 4
+        assert not ck.converged and not ck.aborted
+        np.testing.assert_array_equal(ck.loss_history, hist)
+
+    def test_missing_returns_none(self, tmp_path, problem):
+        assert ckpt.load_checkpoint(str(tmp_path / "nope.npz"),
+                                    problem[4]) is None
+
+    def test_pytree_weights(self, tmp_path):
+        tree = {"W": jnp.ones((3, 2)), "b": jnp.arange(2.0)}
+        warm = agd.AGDWarmState(x=tree, z=tree, theta=np.inf, big_l=1.0,
+                                bts=True, prior_iters=0)
+        p = str(tmp_path / "tree.npz")
+        ckpt.save_checkpoint(p, warm)
+        loaded = ckpt.load_checkpoint(p, tree).warm
+        assert set(loaded.x) == {"W", "b"}
+        np.testing.assert_array_equal(np.asarray(loaded.x["W"]),
+                                      np.ones((3, 2)))
+        assert loaded.theta == np.inf
+
+
+class TestCheckpointedDriver:
+    def test_matches_single_run_and_resumes(self, tmp_path, problem):
+        sm, sl, px, rv, w0 = problem
+        single = _run(problem, 12)
+        p = str(tmp_path / "run.npz")
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=12)
+        out = ckpt.run_agd_checkpointed(
+            sm, px, rv, w0, cfg, path=p, segment_iters=5, smooth_loss=sl)
+        assert out.num_iters == 12
+        assert out.resumed_from == 0
+        # warm carry enters each segment as a jit *argument* (vs a fused
+        # constant in the single run), so allow 1-ulp fusion differences
+        np.testing.assert_allclose(np.asarray(single.weights),
+                                   np.asarray(out.weights), rtol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(single.loss_history)[:12], out.loss_history,
+            rtol=1e-12)
+        # rerun: everything already done, must be a no-op resume
+        again = ckpt.run_agd_checkpointed(
+            sm, px, rv, w0, cfg, path=p, segment_iters=5, smooth_loss=sl)
+        assert again.resumed_from == 12
+        assert again.num_iters == 12
+        np.testing.assert_array_equal(np.asarray(again.weights),
+                                      np.asarray(out.weights))
+
+    def test_kill_and_resume(self, tmp_path, problem):
+        sm, sl, px, rv, w0 = problem
+        p = str(tmp_path / "killed.npz")
+        cfg6 = agd.AGDConfig(convergence_tol=0.0, num_iterations=6)
+        # "crash" after 6 of 12 iterations
+        ckpt.run_agd_checkpointed(
+            sm, px, rv, w0, cfg6, path=p, segment_iters=3, smooth_loss=sl)
+        cfg12 = agd.AGDConfig(convergence_tol=0.0, num_iterations=12)
+        out = ckpt.run_agd_checkpointed(
+            sm, px, rv, w0, cfg12, path=p, segment_iters=3, smooth_loss=sl)
+        assert out.resumed_from == 6
+        single = _run(problem, 12)
+        np.testing.assert_allclose(np.asarray(single.weights),
+                                   np.asarray(out.weights), rtol=1e-12)
+
+    def test_convergence_stops_segments(self, tmp_path, problem):
+        sm, sl, px, rv, w0 = problem
+        p = str(tmp_path / "conv.npz")
+        cfg = agd.AGDConfig(convergence_tol=1e-3, num_iterations=100)
+        out = ckpt.run_agd_checkpointed(
+            sm, px, rv, w0, cfg, path=p, segment_iters=10, smooth_loss=sl)
+        assert out.num_iters < 100
+        single = _run(problem, 100, tol=1e-3)
+        assert out.num_iters == int(single.num_iters)
+        # terminal checkpoint: rerunning a converged run is a strict no-op
+        again = ckpt.run_agd_checkpointed(
+            sm, px, rv, w0, cfg, path=p, segment_iters=10, smooth_loss=sl)
+        assert again.num_iters == out.num_iters
+        assert again.resumed_from == out.num_iters
+        np.testing.assert_array_equal(np.asarray(again.weights),
+                                      np.asarray(out.weights))
+        np.testing.assert_array_equal(again.loss_history, out.loss_history)
+
+
+class TestLoggingUtils:
+    def test_iteration_records_and_log(self, problem, caplog):
+        res = _run(problem, 6)
+        recs = utils.iteration_records(res)
+        assert len(recs) == int(res.num_iters)
+        assert recs[0]["iter"] == 1
+        assert all(np.isfinite(r["loss"]) for r in recs)
+        assert all(r["L"] > 0 and r["step"] > 0 for r in recs)
+        with caplog.at_level(logging.INFO, logger="spark_agd_tpu"):
+            utils.log_result(res)
+        assert "Last 10 losses" in caplog.text
+        assert "iter=1 " in caplog.text
+
+    def test_host_logger_callback(self, problem, caplog):
+        sm, sl, px, rv, w0 = problem
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=5)
+        with caplog.at_level(logging.INFO, logger="spark_agd_tpu"):
+            host_agd.run_agd_host(
+                sm, px, rv, w0, cfg, smooth_loss=sl,
+                on_iteration=utils.make_host_logger(every=2))
+        # iterations 2 and 4 logged (every=2)
+        assert "iter=2 " in caplog.text
+        assert "iter=4 " in caplog.text
+        assert "iter=3 " not in caplog.text
