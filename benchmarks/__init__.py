@@ -1,0 +1,14 @@
+"""Benchmark harness for the five BASELINE.md configs.
+
+The driver's headline metric stays in the repo-root ``bench.py``; this
+package is the *coverage* harness: one runnable spec per BASELINE config,
+measuring the metrics BASELINE.json names ("iters/sec + wall-clock-to-eps")
+plus the reference's own implicit headline, the AGD-vs-GD
+iteration-efficiency ratio (reference Suite:60,:77 — 10 vs 50 iterations).
+
+This environment has zero egress, so the real datasets (rcv1.binary,
+url_combined, MNIST-8M, Criteo) cannot be fetched; each config runs on a
+synthetic stand-in matching the real dataset's shape and sparsity (row
+count scaled by ``--scale``).  Swap in the real LIBSVM files via
+``data.libsvm`` when they are available on disk.
+"""

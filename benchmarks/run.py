@@ -1,0 +1,185 @@
+"""Runner for the five BASELINE configs.
+
+Per config it reports, as one JSON line each:
+- ``iters_per_sec`` — sustained fused-loop outer iterations/sec (steady
+  state: second invocation of the compiled program),
+- ``wall_to_eps_s`` — wall-clock to reach within ``eps`` (relative) of the
+  run's best loss, derived from the per-iteration history and the measured
+  sec/iter,
+- ``agd_vs_gd_iters`` — iteration-efficiency ratio: GD-oracle iterations
+  needed to reach AGD's final loss, divided by AGD's iterations (the
+  reference's implicit 5x headline, Suite:60,:77),
+- ``final_loss`` for reproducibility.
+
+Usage::
+
+    python -m benchmarks.run                  # all configs, tiny scale
+    python -m benchmarks.run --config 1 --scale 0.01 --iters 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from spark_agd_tpu import api
+from spark_agd_tpu.models import mlp as mlp_lib
+from spark_agd_tpu.ops import losses, prox
+from spark_agd_tpu.utils.profiling import timed
+
+from . import datasets
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+@dataclass(frozen=True)
+class BenchConfig:
+    idx: int
+    name: str
+    make_data: Callable
+    gradient: Callable  # () -> Gradient
+    updater: Callable  # () -> Prox
+    reg_param: float
+    make_w0: Callable  # (X) -> initial weights
+    gd_step_size: float = 1.0  # oracle step size
+
+
+def _glm_w0(X):
+    return np.zeros(X.shape[1], np.float32)
+
+
+CONFIGS = [
+    BenchConfig(1, "logistic_l2_rcv1like", datasets.rcv1_like,
+                losses.LogisticGradient, prox.SquaredL2Updater,
+                1e-4, _glm_w0),
+    BenchConfig(2, "linreg_dense", datasets.dense_linreg,
+                losses.LeastSquaresGradient, prox.IdentityProx,
+                0.0, _glm_w0, gd_step_size=0.1),
+    BenchConfig(3, "svm_l1_urllike", datasets.url_like,
+                losses.HingeGradient, prox.L1Updater,
+                1e-5, _glm_w0),
+    BenchConfig(4, "softmax_mnist8mlike", datasets.mnist8m_like,
+                lambda: losses.SoftmaxGradient(10), prox.SquaredL2Updater,
+                1e-4, lambda X: np.zeros((X.shape[1], 10), np.float32)),
+    BenchConfig(5, "mlp_criteolike", datasets.criteo_like,
+                lambda: mlp_lib.mlp_gradient("tanh"), prox.SquaredL2Updater,
+                1e-5,
+                lambda X: mlp_lib.init_mlp_params(X.shape[1], 32, 2, 0)),
+]
+
+
+def wall_to_eps(hist: np.ndarray, sec_per_iter: float,
+                eps: float = 1e-3) -> Optional[float]:
+    """Seconds until loss first comes within eps (relative) of the best."""
+    best = float(np.min(hist))
+    target = best + eps * abs(best)
+    hits = np.nonzero(hist <= target)[0]
+    if len(hits) == 0:
+        return None
+    return float((hits[0] + 1) * sec_per_iter)
+
+
+def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
+                      cap: int):
+    """GD-oracle iterations to reach AGD's final loss (the reference's
+    oracle-equivalence framing, Suite:78-86).  Returns ``(iters, matched)``;
+    when the cap is hit, ``iters == cap`` is a lower bound."""
+    _, hist = api.run_minibatch_sgd(
+        data, config.gradient(), config.updater(),
+        step_size=config.gd_step_size, num_iterations=cap,
+        reg_param=config.reg_param, initial_weights=w0)
+    hits = np.nonzero(np.asarray(hist) <= target_loss * (1 + 1e-6))[0]
+    if len(hits):
+        return int(hits[0] + 1), True
+    return cap, False
+
+
+def run_config(config: BenchConfig, scale: float, iters: int,
+               gd_cap: int = 0, eps: float = 1e-3) -> dict:
+    import jax
+
+    t0 = time.perf_counter()
+    X, y = config.make_data(scale)
+    gen_s = time.perf_counter() - t0
+    n = X.shape[0]
+    log(f"[{config.name}] data {X.shape} generated in {gen_s:.1f}s")
+
+    w0 = config.make_w0(X)
+    data = (X, y)
+
+    def fit(w):
+        return api.run(data, config.gradient(), config.updater(),
+                       convergence_tol=0.0, num_iterations=iters,
+                       reg_param=config.reg_param, initial_weights=w,
+                       return_result=True)
+
+    # first call compiles; time the second (steady state)
+    t0 = time.perf_counter()
+    _, hist, res = fit(w0)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _, hist, res = fit(w0)
+    run_s = time.perf_counter() - t0
+
+    n_iters = int(res.num_iters)
+    sec_per_iter = run_s / max(1, n_iters)
+    ips = n_iters / run_s
+    final_loss = float(hist[-1])
+    w2e = wall_to_eps(np.asarray(hist), sec_per_iter, eps)
+
+    ratio, ratio_is_lb = None, False
+    if gd_cap:
+        gd_iters, matched = gd_iters_to_match(config, data, w0, final_loss,
+                                              gd_cap)
+        ratio = gd_iters / n_iters
+        ratio_is_lb = not matched
+
+    rec = {
+        "config": config.idx,
+        "name": config.name,
+        "rows": int(n),
+        "platform": jax.devices()[0].platform,
+        "iters": n_iters,
+        "compile_s": round(compile_s - run_s, 2),
+        "iters_per_sec": round(ips, 2),
+        "wall_to_eps_s": None if w2e is None else round(w2e, 4),
+        "agd_vs_gd_iters": None if ratio is None else round(ratio, 1),
+        "agd_vs_gd_is_lower_bound": ratio_is_lb,
+        "final_loss": round(final_loss, 6),
+        "backtracks": int(res.num_backtracks),
+        "restarts": int(res.num_restarts),
+    }
+    return rec
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", type=int, default=0,
+                   help="config index 1-5; 0 = all")
+    p.add_argument("--scale", type=float, default=0.002,
+                   help="row-count scale vs the real dataset")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--gd-cap", type=int, default=0,
+                   help="if >0, run the GD oracle up to this many "
+                        "iterations for the iteration-efficiency ratio")
+    args = p.parse_args(argv)
+
+    selected = [c for c in CONFIGS
+                if args.config in (0, c.idx)]
+    if not selected:
+        p.error(f"unknown config {args.config}")
+    for cfg in selected:
+        rec = run_config(cfg, args.scale, args.iters, gd_cap=args.gd_cap)
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -296,7 +296,9 @@ def run_minibatch_sgd(
     if initial_weights is None:
         raise ValueError("initial_weights is required")
     X, y, mask = _normalize_data(data)
-    X, y = jnp.asarray(X), jnp.asarray(y)
+    if not isinstance(X, CSRMatrix):
+        X = jnp.asarray(X)
+    y = jnp.asarray(y)
     mask = None if mask is None else jnp.asarray(mask)
     w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
     res = jax.jit(

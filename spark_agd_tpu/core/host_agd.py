@@ -141,7 +141,7 @@ def run_agd_host(
             if on_iteration is not None:
                 on_iteration(_carry(x, z, theta, big_l, bts, n_iter,
                                     loss_hist[-1], aborted=True,
-                                    stopped=True))
+                                    stopped=True, last=True))
             break
 
         stop = False
@@ -159,8 +159,9 @@ def run_agd_host(
             n_restart += 1
 
         if on_iteration is not None:
+            last = n_iter == prior_iters + cfg.num_iterations
             on_iteration(_carry(x, z, theta, big_l, bts, n_iter,
-                                loss_hist[-1], stopped=stop))
+                                loss_hist[-1], stopped=stop, last=last))
         if stop:
             break
 
@@ -172,10 +173,11 @@ def run_agd_host(
 
 
 def _carry(x, z, theta, big_l, bts, n_iter, loss, aborted=False,
-           stopped=False) -> dict:
+           stopped=False, last=False) -> dict:
     """The on_iteration payload: the exact continuation carry + metrics.
     ``stopped`` marks the converged final iteration; ``aborted`` the
-    non-finite one (which also stops)."""
+    non-finite one (which also stops); ``last`` the iteration-cap exit —
+    one of the three is always true on a run's final callback."""
     return dict(x=x, z=z, theta=theta, big_l=big_l, bts=bts,
                 prior_iters=n_iter, loss=loss, aborted=aborted,
-                stopped=stopped or aborted)
+                stopped=stopped or aborted, last=last or aborted)

@@ -40,9 +40,25 @@ def _flat(tree):
     return jax.tree_util.tree_leaves(tree)
 
 
+def problem_fingerprint(w0: Any, config: AGDConfig) -> str:
+    """A stable id of what a checkpoint continues: the weight pytree's
+    structure/shapes/dtypes plus every config field except
+    ``num_iterations`` (which legitimately differs between the killed run
+    and its resume).  Guards against a stale file at a reused path silently
+    hijacking a different problem.  The smooth/prox closures cannot be
+    fingerprinted (they are code); changing those while keeping the same
+    path is on the caller."""
+    leaves, treedef = jax.tree_util.tree_flatten(w0)
+    shapes = ";".join(
+        f"{np.asarray(l).shape}:{np.asarray(l).dtype}" for l in leaves)
+    cfg = dataclasses.asdict(config)
+    cfg.pop("num_iterations")
+    return f"{treedef}|{shapes}|{sorted(cfg.items())}"
+
+
 def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
-                    *, converged: bool = False,
-                    aborted: bool = False) -> None:
+                    *, converged: bool = False, aborted: bool = False,
+                    fingerprint: Optional[str] = None) -> None:
     """Atomically write the continuation carry (+ cumulative loss history).
 
     ``converged``/``aborted`` mark a *terminal* checkpoint: the run stopped
@@ -58,6 +74,8 @@ def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
     payload["prior_iters"] = np.asarray(int(warm.prior_iters))
     payload["converged"] = np.asarray(bool(converged))
     payload["aborted"] = np.asarray(bool(aborted))
+    if fingerprint is not None:
+        payload["fingerprint"] = np.asarray(fingerprint)
     payload["loss_history"] = (np.zeros(0) if loss_history is None
                                else np.asarray(loss_history))
     d = os.path.dirname(os.path.abspath(path))
@@ -78,17 +96,29 @@ class LoadedCheckpoint(NamedTuple):
     loss_history: np.ndarray
     converged: bool
     aborted: bool
+    fingerprint: Optional[str]
 
 
-def load_checkpoint(path: str, template: Any) -> Optional[LoadedCheckpoint]:
+def load_checkpoint(path: str, template: Any,
+                    expect_fingerprint: Optional[str] = None,
+                    ) -> Optional[LoadedCheckpoint]:
     """Rebuild a checkpoint from ``path``; None if the file does not exist.
     ``template`` supplies the pytree structure (and therefore leaf order)
-    of the weights — normally ``w0``."""
+    of the weights — normally ``w0``.  If ``expect_fingerprint`` is given
+    and the file carries a different one, raises ValueError rather than
+    resuming the wrong problem."""
     if not os.path.exists(path):
         return None
     treedef = jax.tree_util.tree_structure(template)
     n = treedef.num_leaves
     with np.load(path) as data:
+        fp = str(data["fingerprint"]) if "fingerprint" in data else None
+        if (expect_fingerprint is not None and fp is not None
+                and fp != expect_fingerprint):
+            raise ValueError(
+                f"checkpoint at {path!r} belongs to a different problem "
+                "(weight structure or config changed); delete it or use "
+                "a different path")
         def tree(name):
             leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
             return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -100,7 +130,7 @@ def load_checkpoint(path: str, template: Any) -> Optional[LoadedCheckpoint]:
         hist = np.asarray(data["loss_history"])
         converged = bool(data["converged"]) if "converged" in data else False
         aborted = bool(data["aborted"]) if "aborted" in data else False
-    return LoadedCheckpoint(warm, hist, converged, aborted)
+    return LoadedCheckpoint(warm, hist, converged, aborted, fp)
 
 
 # The iteration-zero carry is defined ONCE, in core.agd (all drivers expand
@@ -141,7 +171,8 @@ def run_agd_checkpointed(
     call continues from the last completed segment."""
     if segment_iters <= 0:
         raise ValueError("segment_iters must be positive")
-    loaded = load_checkpoint(path, w0)
+    fp = problem_fingerprint(w0, config)
+    loaded = load_checkpoint(path, w0, expect_fingerprint=fp)
     if loaded is not None:
         warm = loaded.warm
         hist = list(np.asarray(loaded.loss_history))
@@ -181,7 +212,8 @@ def run_agd_checkpointed(
         warm = warm_from_result(res, int(warm.prior_iters) + done)
         aborted = bool(res.aborted_non_finite)
         save_checkpoint(path, warm, np.asarray(hist),
-                        converged=bool(res.converged), aborted=aborted)
+                        converged=bool(res.converged), aborted=aborted,
+                        fingerprint=fp)
         if bool(res.converged) or done == 0:
             break
 

@@ -74,16 +74,19 @@ def make_host_logger(*, log: Optional[logging.Logger] = None,
 
     def on_iteration(carry: dict):
         it = int(carry["prior_iters"])
-        # the stopping iteration (converged OR aborted) always logs — an
-        # operator tailing the stream must be able to tell "finished" from
-        # "hung" regardless of `every`
-        if it % every and not carry.get("stopped"):
+        # a run's final callback (converged, aborted, or iteration-cap)
+        # always logs — an operator tailing the stream must be able to
+        # tell "finished" from "hung" regardless of `every`
+        final = carry.get("stopped") or carry.get("last")
+        if it % every and not final:
             return
         suffix = ""
         if carry.get("aborted"):
             suffix = " ABORTED-nonfinite"
         elif carry.get("stopped"):
             suffix = " converged"
+        elif carry.get("last"):
+            suffix = " done(iteration cap)"
         log.info("iter=%d loss=%.6g L=%.4g theta=%.4g%s",
                  it, float(carry["loss"]), float(carry["big_l"]),
                  float(carry["theta"]), suffix)

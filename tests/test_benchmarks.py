@@ -1,0 +1,36 @@
+"""Smoke tests: every BASELINE config runs end-to-end at tiny scale and
+reports sane metrics (the harness itself is part of the deliverable —
+SURVEY §7 L5)."""
+
+import numpy as np
+import pytest
+
+from benchmarks import datasets, run as bench_run
+
+
+class TestDatasets:
+    def test_sparse_geometry(self):
+        X, y = datasets.rcv1_like(scale=0.0001)
+        assert X.shape[1] == 47_236
+        assert X.nnz == X.shape[0] * 74
+        assert set(np.unique(y)) <= {0.0, 1.0}
+        # planted model ⇒ labels correlate with margins (not pure noise)
+        assert 0.2 < float(y.mean()) < 0.8
+
+    def test_multiclass_geometry(self):
+        X, y = datasets.mnist8m_like(scale=0.0001)
+        assert X.shape[1] == 784
+        assert set(np.unique(y)) <= set(range(10))
+
+
+@pytest.mark.parametrize("idx", [1, 2, 3, 4, 5])
+def test_config_runs(idx):
+    cfg = bench_run.CONFIGS[idx - 1]
+    assert cfg.idx == idx
+    rec = bench_run.run_config(cfg, scale=2e-4, iters=3,
+                               gd_cap=5 if idx == 2 else 0)
+    assert rec["iters"] >= 1
+    assert rec["iters_per_sec"] > 0
+    assert np.isfinite(rec["final_loss"])
+    if rec["wall_to_eps_s"] is not None:
+        assert rec["wall_to_eps_s"] > 0

@@ -105,6 +105,24 @@ class TestSaveLoad:
         assert ckpt.load_checkpoint(str(tmp_path / "nope.npz"),
                                     problem[4]) is None
 
+    def test_fingerprint_mismatch_raises(self, tmp_path, problem):
+        sm, sl, px, rv, w0 = problem
+        p = str(tmp_path / "fp.npz")
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=4)
+        ckpt.run_agd_checkpointed(sm, px, rv, w0, cfg, path=p,
+                                  segment_iters=2, smooth_loss=sl)
+        # changed problem config (not num_iterations) at the same path
+        cfg2 = agd.AGDConfig(convergence_tol=0.0, num_iterations=8,
+                             l0=2.0)
+        with pytest.raises(ValueError, match="different problem"):
+            ckpt.run_agd_checkpointed(sm, px, rv, w0, cfg2, path=p,
+                                      segment_iters=2, smooth_loss=sl)
+        # more iterations on the SAME problem is a legitimate resume
+        cfg3 = agd.AGDConfig(convergence_tol=0.0, num_iterations=8)
+        out = ckpt.run_agd_checkpointed(sm, px, rv, w0, cfg3, path=p,
+                                        segment_iters=2, smooth_loss=sl)
+        assert out.resumed_from == 4 and out.num_iters == 8
+
     def test_pytree_weights(self, tmp_path):
         tree = {"W": jnp.ones((3, 2)), "b": jnp.arange(2.0)}
         warm = agd.AGDWarmState(x=tree, z=tree, theta=np.inf, big_l=1.0,
@@ -197,7 +215,20 @@ class TestLoggingUtils:
             host_agd.run_agd_host(
                 sm, px, rv, w0, cfg, smooth_loss=sl,
                 on_iteration=utils.make_host_logger(every=2))
-        # iterations 2 and 4 logged (every=2)
+        # iterations 2 and 4 logged (every=2); 5 is the cap exit — always
+        # logged so the stream shows the run finished
         assert "iter=2 " in caplog.text
         assert "iter=4 " in caplog.text
         assert "iter=3 " not in caplog.text
+        assert "iter=5 " in caplog.text
+        assert "done(iteration cap)" in caplog.text
+
+    def test_host_logger_logs_convergence(self, problem, caplog):
+        sm, sl, px, rv, w0 = problem
+        cfg = agd.AGDConfig(convergence_tol=1e-3, num_iterations=100)
+        with caplog.at_level(logging.INFO, logger="spark_agd_tpu"):
+            res = host_agd.run_agd_host(
+                sm, px, rv, w0, cfg, smooth_loss=sl,
+                on_iteration=utils.make_host_logger(every=1000))
+        assert res.num_iters < 100
+        assert "converged" in caplog.text
