@@ -56,12 +56,20 @@ def bench_tpu(X, y):
 
     from spark_agd_tpu.core import agd, smooth as smooth_lib
     from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.pallas_kernels import PallasLogisticGradient
     from spark_agd_tpu.ops.prox import L2Prox
+
+    # BENCH_GRADIENT=pallas uses the fused single-HBM-pass Pallas kernel
+    # (ops/pallas_kernels.py) instead of the XLA two-pass lowering.
+    if os.environ.get("BENCH_GRADIENT") == "pallas":
+        gradient = PallasLogisticGradient()
+    else:
+        gradient = LogisticGradient()
 
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
     w0 = jnp.zeros(X.shape[1], jnp.float32)
-    sm = smooth_lib.make_smooth(LogisticGradient(), Xd, yd, None)
-    sl = smooth_lib.make_smooth_loss(LogisticGradient(), Xd, yd, None)
+    sm = smooth_lib.make_smooth(gradient, Xd, yd, None)
+    sl = smooth_lib.make_smooth_loss(gradient, Xd, yd, None)
     px, rv = smooth_lib.make_prox(L2Prox(), REG)
     cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=NUM_ITERS_TPU)
 

@@ -25,10 +25,11 @@ def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
     col_ids = rng.integers(0, n_features, nnz).astype(np.int32)
     row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), nnz_per_row)
     values = rng.standard_normal(nnz).astype(np.float32)
-    # planted weights touch a dense low-index block so the signal survives
-    w = np.zeros(n_features, np.float32)
-    hot = min(n_features, 4096)
-    w[:hot] = rng.standard_normal(hot).astype(np.float32) / np.sqrt(hot)
+    # planted weights over ALL features, scaled so each row's margin has
+    # unit variance (sum of nnz_per_row products of two unit normals) —
+    # every row carries signal, none is a coin flip
+    w = (rng.standard_normal(n_features).astype(np.float32)
+         / np.sqrt(nnz_per_row))
     margins = np.zeros(n_rows, np.float32)
     np.add.at(margins, row_ids, values * w[col_ids])
     p = 1.0 / (1.0 + np.exp(-margins))

@@ -31,7 +31,6 @@ import numpy as np
 from spark_agd_tpu import api
 from spark_agd_tpu.models import mlp as mlp_lib
 from spark_agd_tpu.ops import losses, prox
-from spark_agd_tpu.utils.profiling import timed
 
 from . import datasets
 
@@ -78,12 +77,14 @@ CONFIGS = [
 
 def wall_to_eps(hist: np.ndarray, sec_per_iter: float,
                 eps: float = 1e-3) -> Optional[float]:
-    """Seconds until loss first comes within eps (relative) of the best."""
-    best = float(np.min(hist))
+    """Seconds until loss first comes within eps (relative) of the run's
+    best.  None only for an aborted (non-finite) run — the best entry of a
+    finite history always meets its own target."""
+    best = float(np.nanmin(hist))
+    if not np.isfinite(best):
+        return None
     target = best + eps * abs(best)
     hits = np.nonzero(hist <= target)[0]
-    if len(hits) == 0:
-        return None
     return float((hits[0] + 1) * sec_per_iter)
 
 
@@ -96,9 +97,13 @@ def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
         data, config.gradient(), config.updater(),
         step_size=config.gd_step_size, num_iterations=cap,
         reg_param=config.reg_param, initial_weights=w0)
+    # gd.py history semantics: hist[k] is the loss at the PRE-update weights
+    # of iteration k+1, i.e. the loss achieved after k updates — so the
+    # first index meeting the target IS the update count (0 if w0 already
+    # meets it).
     hits = np.nonzero(np.asarray(hist) <= target_loss * (1 + 1e-6))[0]
     if len(hits):
-        return int(hits[0] + 1), True
+        return int(hits[0]), True
     return cap, False
 
 
