@@ -102,64 +102,67 @@ class Gradient:
         return loss_sum / n, tvec.scale(1.0 / n, grad_sum)
 
 
-class LogisticGradient(Gradient):
+class MarginGradient(Gradient):
+    """A GLM loss that is a per-row function of the margin ``x·w``.
+
+    Subclasses define ``dots_loss_and_mult(dots, y) -> (per, mult)`` with
+    ``per`` the per-example loss and ``mult`` the per-example gradient
+    multiplier (``grad = X.T @ mult``).  This is the seam the
+    feature-sharded path needs: with D sharded over the mesh, the margin is
+    assembled by a psum *between* the two products (parallel/
+    feature_sharded.py), so the elementwise middle must be callable on its
+    own.  The row-sharded kernels below also use it, so the two layouts
+    cannot drift numerically.
+    """
+
+    def dots_loss_and_mult(self, dots, y):
+        raise NotImplementedError
+
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        dots = matvec(X, weights)
+        per, mult = self.dots_loss_and_mult(dots, y.astype(dots.dtype))
+        m = _as_mask(mask, dots.dtype)
+        if m is not None:
+            per = per * m
+            mult = mult * m
+        return jnp.sum(per), rmatvec(X, mult), _count(X, mask)
+
+
+class LogisticGradient(MarginGradient):
     """Binary logistic loss (labels in {0,1}).
 
     Mirrors spark-mllib 1.3.0 ``LogisticGradient`` (binary-only in 1.3;
     reference use-sites: Suite:39, :251).  Stable via ``softplus``.
     """
 
-    def batch_loss_and_grad(self, weights, X, y, mask=None):
-        margins = -matvec(X, weights)  # (N,) — the only (N,D)@(D,) product
-        y = y.astype(margins.dtype)
-        m = _as_mask(mask, margins.dtype)
-        # loss_i = softplus(margin) - (1 - y_i) * margin   (MLlib 1.3 form)
+    def dots_loss_and_mult(self, dots, y):
+        margins = -dots
         per = jax.nn.softplus(margins) - (1.0 - y) * margins
-        multipliers = jax.nn.sigmoid(-margins) - y  # sigmoid(x·w) - y
-        if m is not None:
-            per = per * m
-            multipliers = multipliers * m
-        loss_sum = jnp.sum(per)
-        grad_sum = rmatvec(X, multipliers)
-        return loss_sum, grad_sum, _count(X, mask)
+        mult = jax.nn.sigmoid(-margins) - y
+        return per, mult
 
 
-class LeastSquaresGradient(Gradient):
+class LeastSquaresGradient(MarginGradient):
     """Squared-error loss, 1.3 convention: ``diff^2`` / ``2·diff·x``.
 
     (BASELINE config 2; not used in the reference's own tests but named by
     SURVEY §2.2.)
     """
 
-    def batch_loss_and_grad(self, weights, X, y, mask=None):
-        preds = matvec(X, weights)
-        diff = preds - y.astype(preds.dtype)  # cast to matmul-result dtype
-        m = _as_mask(mask, diff.dtype)
-        if m is not None:
-            diff = diff * m  # zeroes both the loss and the grad of pad rows
-        loss_sum = jnp.sum(diff * diff)
-        grad_sum = 2.0 * rmatvec(X, diff)
-        return loss_sum, grad_sum, _count(X, mask)
+    def dots_loss_and_mult(self, dots, y):
+        diff = dots - y
+        return diff * diff, 2.0 * diff
 
 
-class HingeGradient(Gradient):
+class HingeGradient(MarginGradient):
     """SVM hinge loss; {0,1} labels rescaled to {-1,+1} (BASELINE config 3)."""
 
-    def batch_loss_and_grad(self, weights, X, y, mask=None):
-        dots = matvec(X, weights)
-        s = 2.0 * y.astype(dots.dtype) - 1.0
+    def dots_loss_and_mult(self, dots, y):
+        s = 2.0 * y - 1.0
         margin = 1.0 - s * dots
         active = margin > 0.0
-        m = _as_mask(mask, dots.dtype)
-        per = jnp.where(active, margin, 0.0)
-        mult = jnp.where(active, -s, 0.0)
-        if m is not None:
-            per = per * m
-            mult = mult * m
-        loss_sum = jnp.sum(per)
         # grad_i = -s_i x_i where active, else 0  ==  X^T(-s * active)
-        grad_sum = rmatvec(X, mult)
-        return loss_sum, grad_sum, _count(X, mask)
+        return jnp.where(active, margin, 0.0), jnp.where(active, -s, 0.0)
 
 
 class SoftmaxGradient(Gradient):

@@ -1,0 +1,170 @@
+"""Feature-dim (D-axis) sharding parity: the column-sharded smooth must
+agree with the single-device CSR path, and the whole AGD loop must run on
+D-sharded state (parallel/feature_sharded.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.core import agd, smooth as smooth_lib
+from spark_agd_tpu.ops import sparse
+from spark_agd_tpu.ops.losses import (
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+)
+from spark_agd_tpu.ops.prox import L1Prox, L2Prox
+from spark_agd_tpu.parallel import feature_sharded as fs, mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def csr_problem():
+    """Sparse problem with D deliberately not divisible by 8 shards."""
+    rng = np.random.default_rng(9)
+    n, d, nnz_row = 300, 203, 7
+    indptr = np.arange(n + 1) * nnz_row
+    indices = np.concatenate(
+        [rng.choice(d, nnz_row, replace=False) for _ in range(n)]
+    ).astype(np.int32)
+    values = rng.standard_normal(n * nnz_row).astype(np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32) / np.sqrt(nnz_row)
+    margins = np.zeros(n, np.float32)
+    np.add.at(margins, np.repeat(np.arange(n), nnz_row),
+              values * w_true[indices])
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
+    return indptr, indices, values, d, y, w
+
+
+@pytest.fixture(scope="module")
+def model_mesh(cpu_devices):
+    return mesh_lib.make_mesh({mesh_lib.MODEL_AXIS: 8})
+
+
+class TestFeatureShardedSmooth:
+    @pytest.mark.parametrize("grad_cls", [LogisticGradient,
+                                          LeastSquaresGradient,
+                                          HingeGradient])
+    def test_matches_csr_path(self, csr_problem, model_mesh, grad_cls):
+        indptr, indices, values, d, y, w = csr_problem
+        X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+        g = grad_cls()
+        ref = smooth_lib.make_smooth(g, X, jnp.asarray(y))(jnp.asarray(w))
+
+        batch = fs.shard_csr_by_columns(indptr, indices, values, d, y,
+                                        model_mesh)
+        smooth, smooth_loss = fs.make_feature_sharded_smooth(
+            g, batch, mesh=model_mesh)
+        ws = fs.shard_weights(w, batch, model_mesh)
+        loss, grad = smooth(ws)
+        assert float(loss) == pytest.approx(float(ref[0]), rel=1e-5)
+        np.testing.assert_allclose(
+            fs.unshard_weights(grad, batch), np.asarray(ref[1]),
+            rtol=1e-4, atol=1e-6)
+        assert float(smooth_loss(ws)) == pytest.approx(float(loss),
+                                                       rel=1e-6)
+
+    def test_mask_excludes_rows(self, csr_problem, model_mesh):
+        indptr, indices, values, d, y, w = csr_problem
+        n = len(y)
+        rng = np.random.default_rng(1)
+        mask = (rng.random(n) < 0.6).astype(np.float32)
+        X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+        g = LogisticGradient()
+        ref = g.mean_loss_and_grad(jnp.asarray(w), X, jnp.asarray(y),
+                                   jnp.asarray(mask))
+        batch = fs.shard_csr_by_columns(indptr, indices, values, d, y,
+                                        model_mesh, mask=mask)
+        smooth, _ = fs.make_feature_sharded_smooth(g, batch,
+                                                   mesh=model_mesh)
+        loss, grad = smooth(fs.shard_weights(w, batch, model_mesh))
+        assert float(loss) == pytest.approx(float(ref[0]), rel=1e-5)
+        np.testing.assert_allclose(
+            fs.unshard_weights(grad, batch), np.asarray(ref[1]),
+            rtol=1e-4, atol=1e-6)
+
+    def test_padding_positions_stay_zero_through_agd(self, csr_problem,
+                                                     model_mesh):
+        """D=203 pads to 8*26=208; the 5 unused positions must stay
+        exactly 0 through prox steps and AT recurrences."""
+        indptr, indices, values, d, y, w = csr_problem
+        batch = fs.shard_csr_by_columns(indptr, indices, values, d, y,
+                                        model_mesh)
+        g = LogisticGradient()
+        smooth, sl = fs.make_feature_sharded_smooth(g, batch,
+                                                    mesh=model_mesh)
+        px, rv = smooth_lib.make_prox(L1Prox(), 0.05)
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=6)
+        w0 = fs.shard_weights(np.zeros(d, np.float32), batch, model_mesh)
+        res = jax.jit(
+            lambda ws: agd.run_agd(smooth, px, rv, ws, cfg,
+                                   smooth_loss=sl))(w0)
+        full = np.asarray(res.weights)
+        assert full.shape[0] == 8 * batch.d_local
+        unused = np.ones(full.shape[0], bool)
+        unused[batch.positions] = False
+        assert unused.sum() == full.shape[0] - d
+        np.testing.assert_array_equal(full[unused], 0.0)
+
+    def test_nnz_balanced_on_power_law(self, model_mesh):
+        """Power-law column occupancy (the url_combined regime) must not
+        pile most entries onto one shard."""
+        rng = np.random.default_rng(2)
+        n, d = 2000, 500
+        # zipf-ish: column j drawn with prob ~ 1/(j+1)
+        p = 1.0 / np.arange(1, d + 1)
+        p /= p.sum()
+        nnz_row = 10
+        indices = rng.choice(d, size=n * nnz_row, p=p).astype(np.int32)
+        indptr = np.arange(n + 1) * nnz_row
+        values = np.ones(n * nnz_row, np.float32)
+        y = rng.integers(0, 2, n).astype(np.float32)
+        batch = fs.shard_csr_by_columns(indptr, indices, values, d, y,
+                                        model_mesh)
+        # stacked rectangular layout: total footprint / real nnz
+        blowup = (8 * (batch.values.shape[0] // 8)) / (n * nnz_row)
+        assert blowup < 1.5, f"padding blowup {blowup:.2f}x"
+
+    def test_out_of_range_indices_rejected(self, model_mesh):
+        indptr = np.array([0, 1])
+        with pytest.raises(ValueError, match="out of range"):
+            fs.shard_csr_by_columns(indptr, np.array([7]),
+                                    np.ones(1, np.float32), 7,
+                                    np.zeros(1), model_mesh)
+
+    def test_full_agd_matches_single_device(self, csr_problem, model_mesh):
+        indptr, indices, values, d, y, w = csr_problem
+        X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+        w0 = np.zeros(d, np.float32)
+        ref_w, ref_hist = api.run(
+            (X, y), LogisticGradient(), L2Prox(), num_iterations=8,
+            reg_param=0.1, initial_weights=w0, mesh=False,
+            convergence_tol=0.0)
+
+        batch = fs.shard_csr_by_columns(indptr, indices, values, d, y,
+                                        model_mesh)
+        smooth, sl = fs.make_feature_sharded_smooth(
+            LogisticGradient(), batch, mesh=model_mesh)
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=8)
+        res = jax.jit(
+            lambda ws: agd.run_agd(smooth, px, rv, ws, cfg,
+                                   smooth_loss=sl))(
+            fs.shard_weights(w0, batch, model_mesh))
+        hist = np.asarray(res.loss_history)[:int(res.num_iters)]
+        np.testing.assert_allclose(hist, ref_hist, rtol=1e-5)
+        np.testing.assert_allclose(
+            fs.unshard_weights(res.weights, batch), np.asarray(ref_w),
+            rtol=1e-4, atol=1e-6)
+
+    def test_rejects_non_margin_gradient(self, csr_problem, model_mesh):
+        from spark_agd_tpu.ops.losses import SoftmaxGradient
+
+        indptr, indices, values, d, y, _ = csr_problem
+        batch = fs.shard_csr_by_columns(indptr, indices, values, d, y,
+                                        model_mesh)
+        with pytest.raises(TypeError, match="MarginGradient"):
+            fs.make_feature_sharded_smooth(SoftmaxGradient(3), batch,
+                                           mesh=model_mesh)
