@@ -1,0 +1,36 @@
+"""Multi-host helpers (parallel/multihost.py) — single-process behavior;
+real DCN topologies cannot exist in CI, so these pin the fallback
+contract: same axis names/sizes as the hybrid path."""
+
+import numpy as np
+
+from spark_agd_tpu import api
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.parallel import multihost as mh
+
+
+class TestHybridMesh:
+    def test_axis_sizes_multiply(self, cpu_devices):
+        m = mh.make_hybrid_mesh({"data": 4}, {"data": 2})
+        assert dict(m.shape) == {"data": 8}
+        m2 = mh.make_hybrid_mesh({"data": 4, "model": 2})
+        assert dict(m2.shape) == {"data": 4, "model": 2}
+
+    def test_usable_by_optimizer(self, cpu_devices, rng):
+        mesh = mh.make_hybrid_mesh({"data": 8})
+        X = rng.standard_normal((200, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        w, hist = api.run((X, y), LogisticGradient(), L2Prox(),
+                          num_iterations=3, reg_param=0.1,
+                          initial_weights=np.zeros(4, np.float32),
+                          mesh=mesh)
+        assert np.all(np.isfinite(np.asarray(w)))
+        assert len(hist) >= 1
+
+    def test_initialize_single_process_noop(self):
+        mh.initialize()  # must not raise without a coordinator
+
+    def test_process_local_rows_covers_all(self):
+        s = mh.process_local_rows(1000)
+        assert s == slice(0, 1000)
