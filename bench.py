@@ -13,7 +13,34 @@ that would only make it slower.  ``vs_baseline`` is the iters/sec speedup
 of the fused TPU program over that loop on identical data at matched final
 loss.
 
-Prints ONE JSON line on stdout; diagnostics go to stderr.
+Robustness contract (round-1 failure was an unparseable crash at backend
+init, BENCH_r01.json rc=1; observed here: backend init can also HANG
+indefinitely when the TPU tunnel is wedged):
+
+- The measured run happens in a WORKER SUBPROCESS (``BENCH_STAGE=worker``)
+  with a hard timeout, so a hung backend init can always be killed.  JAX
+  also caches a failed init for the life of a process, so a fresh process
+  is the only real retry.
+- The orchestrator retries the worker once after a pause, then falls back
+  to an in-process CPU run so the harness itself is still measured — the
+  JSON then carries an ``error`` field marking the number as degraded.
+- CPU selection must use ``jax.config.update('jax_platforms', 'cpu')``,
+  NOT the ``JAX_PLATFORMS`` env var: the container's sitecustomize
+  registers the tunneled TPU backend at interpreter startup and the env
+  route still dials the (possibly wedged) tunnel; the config route does
+  not (verified empirically — the env route hangs when the tunnel does).
+- main() emits ONE parseable JSON line on stdout in EVERY outcome,
+  including unexpected exceptions (``error`` field set, rc=1).
+
+Roofline accounting (VERDICT r1 item 2): each smooth evaluation is two
+N×D matmuls (forward margins + gradient), i.e. 4·N·D flops and two full
+reads of X from HBM; the fused Pallas path reads X once.  The JSON reports
+``mfu`` and ``hbm_bw_frac`` against the measured chip's peak (table below).
+At the bench shape the arithmetic intensity is ~0.5 flop/byte — deeply
+HBM-bound — so ``hbm_bw_frac`` is the number that adjudicates "actually
+fast": see SURVEY §3.1 for the cost shape.
+
+Diagnostics go to stderr; stdout is exactly one JSON line.
 """
 
 from __future__ import annotations
@@ -21,6 +48,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import subprocess
 import sys
 import time
 
@@ -37,7 +65,59 @@ N_ROWS = int(os.environ.get("BENCH_ROWS", 1 << 19))
 N_FEATURES = int(os.environ.get("BENCH_FEATURES", 512))
 NUM_ITERS_TPU = int(os.environ.get("BENCH_ITERS_TPU", 40))
 NUM_ITERS_CPU = int(os.environ.get("BENCH_ITERS_CPU", 5))
+PARITY_ITERS = int(os.environ.get("BENCH_PARITY_ITERS", 10))
 REG = 0.1
+RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 30))
+# Hard ceiling on one worker attempt (backend init + compile + run).
+WORKER_TIMEOUT_S = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", 900))
+
+# Per-chip peaks for roofline accounting: device_kind substring ->
+# (dense bf16 TFLOP/s, HBM GB/s).  Public spec-sheet numbers; matmuls on
+# f32 inputs use the MXU's bf16-based passes under default precision.
+# Order matters: first substring match wins.
+_PEAKS = (
+    ("v6e", (918.0, 1640.0)),
+    ("v6 lite", (918.0, 1640.0)),
+    ("v5e", (197.0, 819.0)),
+    ("v5 lite", (197.0, 819.0)),
+    ("v5p", (459.0, 2765.0)),
+    ("v5", (459.0, 2765.0)),
+    ("v4", (275.0, 1228.0)),
+    ("v3", (123.0, 900.0)),
+    ("v2", (45.0, 700.0)),
+)
+
+
+def chip_peaks(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peaks in _PEAKS:
+        if sub in kind:
+            return peaks
+    return None
+
+
+class BackendError(RuntimeError):
+    """TPU/accelerator backend failed to initialize."""
+
+
+def probe_backend():
+    """Initialize the backend up front; fail with a one-line diagnostic.
+
+    This is the exact call that killed round 1 (``BENCH_r01.json``:
+    ``Unable to initialize backend 'axon'``) — moved to the very front so
+    a backend problem is diagnosed before any data is built.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:
+        raise BackendError(f"backend init failed: {e}") from e
+    d = devs[0]
+    log(f"backend: platform={d.platform} kind={d.device_kind} "
+        f"n_local={len(devs)} init={time.perf_counter() - t0:.1f}s")
+    return d
 
 
 def make_data(seed=7):
@@ -50,30 +130,22 @@ def make_data(seed=7):
     return X, y
 
 
-def bench_tpu(X, y):
+def _make_step(gradient, Xd, yd, num_iterations):
     import jax
-    import jax.numpy as jnp
 
     from spark_agd_tpu.core import agd, smooth as smooth_lib
-    from spark_agd_tpu.ops.losses import LogisticGradient
-    from spark_agd_tpu.ops.pallas_kernels import PallasLogisticGradient
     from spark_agd_tpu.ops.prox import L2Prox
 
-    # BENCH_GRADIENT=pallas uses the fused single-HBM-pass Pallas kernel
-    # (ops/pallas_kernels.py) instead of the XLA two-pass lowering.
-    if os.environ.get("BENCH_GRADIENT") == "pallas":
-        gradient = PallasLogisticGradient()
-    else:
-        gradient = LogisticGradient()
-
-    Xd, yd = jnp.asarray(X), jnp.asarray(y)
-    w0 = jnp.zeros(X.shape[1], jnp.float32)
     sm = smooth_lib.make_smooth(gradient, Xd, yd, None)
     sl = smooth_lib.make_smooth_loss(gradient, Xd, yd, None)
     px, rv = smooth_lib.make_prox(L2Prox(), REG)
-    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=NUM_ITERS_TPU)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=num_iterations)
+    return jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
 
-    step = jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
+
+def _time_step(step, w0):
+    import jax
+
     t0 = time.perf_counter()
     res = step(w0)
     jax.block_until_ready(res)
@@ -83,13 +155,111 @@ def bench_tpu(X, y):
     res = step(w0)
     jax.block_until_ready(res)
     run_s = time.perf_counter() - t0
+    return res, run_s, compile_s
 
+
+def _roofline(res, run_s, device, x_reads_per_pass=2):
+    """iters/sec plus MFU / HBM-bandwidth fraction for one timed run.
+
+    ``x_reads_per_pass``: full HBM reads of X per smooth evaluation — 2
+    for the XLA lowering (forward matmul + gradient matmul), 1 for the
+    fused Pallas kernel.
+    """
+    iters = int(res.num_iters)
+    n_bt = int(res.num_backtracks)
+    # Smooth-evaluation count for the fused loop, loss_mode='x': each
+    # trial is a y-eval plus an x-eval, trials = iters + backtracks, and
+    # the loss history reuses the trial's f(x) (no third pass) —
+    # core/agd.py module docstring.
+    passes = 2 * (iters + n_bt)
+    flops = passes * 4.0 * N_ROWS * N_FEATURES
+    hbm_bytes = passes * x_reads_per_pass * N_ROWS * N_FEATURES * 4.0
+    out = {
+        "iters_per_sec": iters / run_s,
+        "smooth_passes": passes,
+        "tflops_per_sec": flops / run_s / 1e12,
+        "hbm_gbps": hbm_bytes / run_s / 1e9,
+        "mfu": None,
+        "hbm_bw_frac": None,
+    }
+    peaks = chip_peaks(device.device_kind) if device.platform == "tpu" \
+        else None
+    if peaks is not None:
+        peak_tflops, peak_gbps = peaks
+        out["mfu"] = out["tflops_per_sec"] / peak_tflops
+        out["hbm_bw_frac"] = out["hbm_gbps"] / peak_gbps
+    return out
+
+
+def bench_tpu(Xd, yd, w0, device):
+    from spark_agd_tpu.ops.losses import LogisticGradient
+
+    step = _make_step(LogisticGradient(), Xd, yd, NUM_ITERS_TPU)
+    res, run_s, compile_s = _time_step(step, w0)
     iters = int(res.num_iters)
     hist = np.asarray(res.loss_history)[:iters]
-    log(f"tpu: platform={jax.devices()[0].platform} compile={compile_s:.1f}s "
-        f"run={run_s * 1e3:.1f}ms iters={iters} "
-        f"backtracks={int(res.num_backtracks)} final_loss={hist[-1]:.6f}")
-    return iters / run_s, hist
+    stats = _roofline(res, run_s, device)
+    log(f"xla: compile={compile_s:.1f}s run={run_s * 1e3:.1f}ms "
+        f"iters={iters} backtracks={int(res.num_backtracks)} "
+        f"final_loss={hist[-1]:.6f} "
+        f"tflops/s={stats['tflops_per_sec']:.2f} "
+        f"hbm={stats['hbm_gbps']:.0f}GB/s mfu={stats['mfu']} "
+        f"bw_frac={stats['hbm_bw_frac']}")
+    return stats, hist, compile_s
+
+
+def bench_tpu_pallas(Xd, yd, w0, device):
+    """The fused single-HBM-pass Pallas kernel, if it compiles here.
+
+    Returns None (with the reason logged + recorded) on any failure —
+    Pallas is a comparison point, never allowed to kill the headline run.
+    """
+    if device.platform != "tpu" and os.environ.get(
+            "BENCH_PALLAS_INTERPRET") != "1":
+        return None, "pallas: skipped (not a TPU backend)"
+    try:
+        from spark_agd_tpu.ops.pallas_kernels import PallasLogisticGradient
+
+        step = _make_step(PallasLogisticGradient(), Xd, yd, NUM_ITERS_TPU)
+        res, run_s, compile_s = _time_step(step, w0)
+        stats = _roofline(res, run_s, device,
+                          x_reads_per_pass=1)  # fused: one X read
+        log(f"pallas: compile={compile_s:.1f}s run={run_s * 1e3:.1f}ms "
+            f"iters={int(res.num_iters)} "
+            f"hbm={stats['hbm_gbps']:.0f}GB/s "
+            f"bw_frac={stats['hbm_bw_frac']}")
+        return stats, None
+    except Exception as e:  # noqa: BLE001 — comparison point only
+        reason = f"pallas: failed ({type(e).__name__}: {e})"
+        log(reason)
+        return None, reason[:300]
+
+
+def check_parity(Xd, yd, w0, cpu_hist):
+    """Loss-trajectory parity vs the f64 host oracle.
+
+    ADVICE r1 item 4: under default TPU matmul precision (bf16 MXU
+    passes) an rtol=1e-3 gate can spuriously fail, killing the benchmark.
+    So the *gate* runs a short highest-precision program, and the default-
+    precision trajectory is only checked loosely (warn, don't die).
+    """
+    import jax
+
+    from spark_agd_tpu.ops.losses import LogisticGradient
+
+    k = min(PARITY_ITERS, len(cpu_hist))
+    with jax.default_matmul_precision("highest"):
+        step = _make_step(LogisticGradient(), Xd, yd, k)
+        res = step(w0)
+        jax.block_until_ready(res)
+    hist = np.asarray(res.loss_history)[: int(res.num_iters)]
+    np.testing.assert_allclose(
+        hist[:k], np.asarray(cpu_hist)[:k], rtol=1e-3,
+        err_msg="TPU (highest precision) and CPU-oracle loss trajectories "
+                "diverged; vs_baseline would compare different "
+                "computations")
+    log(f"loss-trajectory parity ok over {k} iterations "
+        f"(matmul_precision=highest)")
 
 
 def bench_cpu(X, y):
@@ -120,30 +290,156 @@ def bench_cpu(X, y):
     iters = len(res.loss_history)
     log(f"cpu oracle: run={run_s:.1f}s iters={iters} "
         f"smooth_calls={res.num_smooth_calls}")
-
     return iters / run_s, res
 
 
-def main():
+def run_bench():
+    import jax.numpy as jnp
+
+    device = probe_backend()
     log(f"data: {N_ROWS}x{N_FEATURES} f32 "
         f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB)")
     X, y = make_data()
-    tpu_ips, tpu_hist = bench_tpu(X, y)
+    # One H2D transfer; every consumer below shares the device arrays.
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    w0 = jnp.zeros(X.shape[1], jnp.float32)
+    xla, xla_hist, compile_s = bench_tpu(Xd, yd, w0, device)
+    pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
     cpu_ips, cpu_res = bench_cpu(X, y)
-    # The speedup claim is only meaningful if both paths walk the same loss
-    # trajectory: compare the overlapping prefix (f32 TPU vs f64 host).
-    k = min(len(tpu_hist), len(cpu_res.loss_history))
-    np.testing.assert_allclose(
-        tpu_hist[:k], cpu_res.loss_history[:k], rtol=1e-3,
-        err_msg="TPU and CPU-oracle loss trajectories diverged; "
-                "vs_baseline would compare different computations")
-    log(f"loss-trajectory parity ok over {k} iterations")
-    print(json.dumps({
+    check_parity(Xd, yd, w0, cpu_res.loss_history)
+
+    # Loose sanity check on the default-precision headline trajectory —
+    # warn-only (bf16 MXU drift is expected, not a failure).
+    k = min(len(xla_hist), len(cpu_res.loss_history))
+    drift = float(np.max(np.abs(
+        (xla_hist[:k] - np.asarray(cpu_res.loss_history)[:k])
+        / np.asarray(cpu_res.loss_history)[:k])))
+    if drift > 1e-2:
+        log(f"WARNING: default-precision trajectory drift {drift:.2e} "
+            f"rel vs oracle (>1e-2)")
+
+    out = {
         "metric": f"agd_iterations_per_sec_logistic_{N_ROWS}x{N_FEATURES}",
-        "value": round(tpu_ips, 2),
+        "value": round(xla["iters_per_sec"], 2),
         "unit": "iters/sec",
-        "vs_baseline": round(tpu_ips / cpu_ips, 2),
-    }), flush=True)
+        "vs_baseline": round(xla["iters_per_sec"] / cpu_ips, 2),
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "compile_s": round(compile_s, 1),
+        "mfu": None if xla["mfu"] is None else round(xla["mfu"], 4),
+        "hbm_bw_frac": None if xla["hbm_bw_frac"] is None
+        else round(xla["hbm_bw_frac"], 3),
+        "tflops_per_sec": round(xla["tflops_per_sec"], 2),
+        "hbm_gbps": round(xla["hbm_gbps"], 1),
+        "trajectory_drift_rel": round(drift, 6),
+        "error": None,
+    }
+    if pallas is not None:
+        out["pallas_iters_per_sec"] = round(pallas["iters_per_sec"], 2)
+        out["pallas_hbm_bw_frac"] = (
+            None if pallas["hbm_bw_frac"] is None
+            else round(pallas["hbm_bw_frac"], 3))
+    else:
+        out["pallas_iters_per_sec"] = None
+        out["pallas_note"] = pallas_note
+    if device.platform != "tpu":
+        out["error"] = "degraded: not running on a TPU backend"
+    return out
+
+
+def _error_json(msg):
+    return {
+        "metric": f"agd_iterations_per_sec_logistic_{N_ROWS}x{N_FEATURES}",
+        "value": 0.0, "unit": "iters/sec", "vs_baseline": 0.0,
+        "error": str(msg)[:500],
+    }
+
+
+def worker_main():
+    """One measured attempt, in its own process so a hang is killable."""
+    try:
+        out = run_bench()
+    except Exception as e:  # noqa: BLE001 — always emit parseable JSON
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps(_error_json(f"{type(e).__name__}: {e}")),
+              flush=True)
+        sys.exit(1)
+    print(json.dumps(out), flush=True)
+
+
+def _run_worker(tag):
+    """Launch one worker attempt; returns the parsed JSON dict or None."""
+    log(f"worker attempt ({tag}), timeout {WORKER_TIMEOUT_S:.0f}s")
+    env = dict(os.environ, BENCH_STAGE="worker")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, timeout=WORKER_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        log(f"worker ({tag}) TIMED OUT after {WORKER_TIMEOUT_S:.0f}s "
+            f"(hung backend init?) — killed")
+        return None
+    lines = proc.stdout.decode().strip().splitlines()
+    if not lines:
+        log(f"worker ({tag}) produced no stdout (rc={proc.returncode})")
+        return None
+    try:
+        out = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        log(f"worker ({tag}) stdout not JSON: {lines[-1][:200]!r}")
+        return None
+    err = out.get("error")
+    if err and not err.startswith("degraded"):
+        log(f"worker ({tag}) reported error: {out['error']}")
+        return None
+    if err:
+        # e.g. a CPU-only dev box: the run completed, it's just not a TPU
+        # number — retrying cannot change that, so keep the result.
+        log(f"worker ({tag}) completed degraded: {err}")
+    return out
+
+
+def cpu_fallback(reason):
+    """In-process CPU run at reduced scale; the JSON is marked degraded.
+
+    Must NOT touch the env-var platform route (it dials the wedged
+    tunnel, see module docstring) — config.update is the safe switch.
+    """
+    global N_ROWS, NUM_ITERS_TPU, NUM_ITERS_CPU
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    N_ROWS = min(N_ROWS, 1 << 15)
+    NUM_ITERS_TPU = min(NUM_ITERS_TPU, 10)
+    NUM_ITERS_CPU = min(NUM_ITERS_CPU, 3)
+    log(f"cpu fallback: rows={N_ROWS} ({reason})")
+    out = run_bench()
+    out["error"] = f"degraded-to-cpu: {reason}"[:500]
+    return out
+
+
+def main():
+    if os.environ.get("BENCH_STAGE") == "worker":
+        worker_main()
+        return
+    out = _run_worker("first")
+    if out is None:
+        log(f"pausing {RETRY_PAUSE_S:.0f}s before retry")
+        time.sleep(RETRY_PAUSE_S)
+        out = _run_worker("retry")
+    if out is None:
+        try:
+            out = cpu_fallback("TPU worker failed/hung twice")
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps(_error_json(
+                f"tpu unavailable and cpu fallback failed: "
+                f"{type(e).__name__}: {e}")), flush=True)
+            sys.exit(1)
+    print(json.dumps(out), flush=True)
+    sys.exit(0 if not out.get("error") else 1)
 
 
 if __name__ == "__main__":

@@ -120,14 +120,11 @@ def run(
                 "re-shard the batch or drop the mesh argument")
     if (not isinstance(data, mesh_lib.ShardedBatch)
             and isinstance(data[0], CSRMatrix)):
-        # CSR batches are not mesh-shardable yet (nnz-range sharding is a
-        # separate layout problem); run them single-device unless the caller
-        # explicitly asked for a mesh.
-        if mesh not in (None, False):
-            raise NotImplementedError(
-                "mesh-sharded CSRMatrix data is not supported yet; "
-                "densify or pre-shard by rows")
-        mesh = False
+        # CSR rows shard over the data axis like dense rows do
+        # (mesh.shard_csr_batch, nnz-balanced); the GSPMD 'auto' mode
+        # cannot partition the segment-sum's row-id indirection, so the
+        # sparse mesh path always runs the explicit shard_map mode.
+        dist_mode = "shard_map"
     m = _resolve_mesh(mesh)
     sm, sl = _build_smooth(gradient, data, m, dist_mode)
     px, rv = smooth_lib.make_prox(updater, reg_param)

@@ -112,7 +112,7 @@ class AGDResult(NamedTuple):
     final_z: Any
     final_theta: jax.Array
     final_bts: jax.Array
-    converged: jax.Array  # stopped by its own criteria (not the iter cap)
+    converged: jax.Array  # stopped by its own criteria (not cap, not abort)
     # per-iteration diagnostics (NaN/0-padded): the values the reference
     # computes and discards (SURVEY §5 metrics gap)
     diag_l: jax.Array
@@ -342,7 +342,7 @@ def run_agd(
         aborted_non_finite=o.aborted, final_l=o.big_l,
         num_backtracks=o.n_bt, num_restarts=o.n_restart,
         final_z=o.z, final_theta=o.theta, final_bts=o.bts,
-        converged=o.done,
+        converged=jnp.logical_and(o.done, ~o.aborted),
         diag_l=o.diag_l, diag_theta=o.diag_theta, diag_step=o.diag_step,
         diag_restarted=o.diag_restarted,
     )

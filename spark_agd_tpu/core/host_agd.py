@@ -10,7 +10,8 @@ macro-batch workloads the stream dominates, so the syncs are noise.
 
 Use ``core.agd.run_agd`` whenever the data fits on-device; this driver
 exists for the 1B-row regime.  Semantics parity between the two is pinned
-by ``tests/test_streaming.py``.
+by ``tests/test_data_layer.py`` (streamed-vs-in-memory) and
+``tests/test_checkpoint.py`` (kill/resume trajectories).
 """
 
 from __future__ import annotations

@@ -96,6 +96,66 @@ class CSRMatrix:
                          out_dt).at[self.col_ids].add(contrib)
 
 
+@jax.tree_util.register_pytree_node_class
+class RowShardedCSR:
+    """A CSR batch laid out for the mesh ``data`` axis (sparse DP).
+
+    The reference's distributed pass works on any RDD of sparse vectors
+    (``Gradient.compute`` takes a ``Vector``, reference
+    ``AcceleratedGradientDescent.scala:196-204``); this is the TPU layout
+    that restores that capability for mesh parallelism.  Rows are assigned
+    to shards (nnz-balanced by default — see ``parallel.mesh.
+    shard_csr_batch``), each shard's entries are re-indexed to LOCAL row
+    ids and padded to a common ``nnz_per_shard`` so the stacked arrays are
+    rectangular; inside ``shard_map`` every device reconstructs its slice
+    as an ordinary :class:`CSRMatrix` of shape ``(rows_per_shard, D)`` —
+    one sparse kernel implementation serves every layout.
+
+    ``row_ids``/``col_ids``/``values`` are ``(n_shards * nnz_per_shard,)``
+    device arrays sharded over the data axis; padding entries are value
+    0.0 at local row 0 / col 0 (inert in both products, see the module
+    padding contract).  ``shape`` is the GLOBAL logical shape (unpadded
+    row count); per-shard row slots beyond the real rows carry mask 0 in
+    the accompanying ``ShardedBatch.mask``.
+    """
+
+    def __init__(self, row_ids, col_ids, values, shape: Tuple[int, int],
+                 rows_per_shard: int, n_shards: int):
+        self.row_ids = row_ids
+        self.col_ids = col_ids
+        self.values = values
+        self.shape = tuple(shape)
+        self.rows_per_shard = int(rows_per_shard)
+        self.n_shards = int(n_shards)
+
+    def tree_flatten(self):
+        return ((self.row_ids, self.col_ids, self.values),
+                (self.shape, self.rows_per_shard, self.n_shards))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        shape, rows_per_shard, n_shards = aux
+        return cls(*leaves, shape=shape, rows_per_shard=rows_per_shard,
+                   n_shards=n_shards)
+
+    @property
+    def sharding(self):
+        """The values array's sharding (all three leaves are placed
+        identically) — lets ``api.run`` recover the mesh the same way it
+        does from a dense ``ShardedBatch.X``."""
+        return self.values.sharding
+
+    @property
+    def nnz_per_shard(self) -> int:
+        return int(self.values.shape[0]) // self.n_shards
+
+    def local_csr(self, row_ids, col_ids, values) -> CSRMatrix:
+        """Reassemble ONE shard's slice (as seen inside ``shard_map``)
+        into a local CSRMatrix of shape ``(rows_per_shard, D)``."""
+        return CSRMatrix(row_ids, col_ids, values,
+                         (self.rows_per_shard, self.shape[1]))
+
+
 def matvec(X, w):
     """Polymorphic ``X @ w`` (dense array or CSRMatrix) used by the loss
     kernels; 2-D ``w`` routes to matmat."""

@@ -40,6 +40,7 @@ from jax import shard_map
 
 from ..core import tvec
 from ..ops.losses import Gradient
+from ..ops.sparse import RowShardedCSR
 from . import mesh as mesh_lib
 
 
@@ -68,9 +69,17 @@ def make_dist_smooth(
         X, y, mask = X
     elif y is None:
         raise ValueError("y is required when X is a raw array")
-    if not isinstance(X, jax.Array) or not isinstance(y, jax.Array):
+    if not isinstance(X, (jax.Array, RowShardedCSR)) \
+            or not isinstance(y, jax.Array):
         X, y, mask = mesh_lib.shard_batch(mesh, X, y, mask, axis=data_axis)
 
+    if isinstance(X, RowShardedCSR):
+        if mode != "shard_map":
+            raise ValueError(
+                "row-sharded CSR data requires mode='shard_map' (the "
+                "GSPMD partitioner cannot see through the local "
+                "segment-sum's row-id indirection)")
+        return _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis)
     if mode == "auto":
         return _make_auto(gradient, X, y, mask)
     if mode == "shard_map":
@@ -120,6 +129,49 @@ def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
         return ls, gs, n
 
     args = (X, y, mask) if has_mask else (X, y)
+
+    def smooth(w):
+        ls, gs, n = _eval(w, *args)
+        return _finish(ls, gs, n)
+
+    def smooth_loss(w):
+        ls, _, n = _eval(w, *args)
+        return ls / jnp.asarray(n, ls.dtype)
+
+    return smooth, smooth_loss
+
+
+def _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis):
+    """Sparse DP: per-device local CSR kernel + the same single psum.
+
+    Each device reconstructs its entry slice as an ordinary local
+    ``CSRMatrix`` (``RowShardedCSR.local_csr``) of shape
+    ``(rows_per_shard, D)`` and runs the SAME batched kernel as the
+    single-device sparse path — the reference's any-Vector ``seqOp``
+    capability (``AcceleratedGradientDescent.scala:196-204``) on a mesh.
+    The mask is mandatory: per-shard row padding must be excluded from
+    the (loss, grad, count) sums.
+    """
+    if mask is None:
+        raise ValueError(
+            "RowShardedCSR requires its padding mask; build the batch "
+            "with parallel.mesh.shard_csr_batch")
+    row = P(data_axis)
+    in_specs = (P(), row, row, row, row, row)
+    out_specs = (P(), P(), P())
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)
+    def _eval(w, rid, cid, val, ys, ms):
+        Xl = X.local_csr(rid, cid, val)
+        ls, gs, n = gradient.batch_loss_and_grad(w, Xl, ys, ms)
+        ls = lax.psum(ls, data_axis)
+        gs = tvec.tmap(lambda g: lax.psum(g, data_axis), gs)
+        n = lax.psum(n, data_axis)
+        return ls, gs, n
+
+    args = (X.row_ids, X.col_ids, X.values, y, mask)
 
     def smooth(w):
         ls, gs, n = _eval(w, *args)

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.sparse import CSRMatrix, RowShardedCSR
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
@@ -81,6 +83,8 @@ def shard_batch(
     (reference Suite:51 ``sc.parallelize(data, 2)``), minus the skew: every
     shard is the same size by construction.
     """
+    if isinstance(X, CSRMatrix):
+        return shard_csr_batch(mesh, X, y, mask, axis=axis)
     X = np.asarray(X) if not isinstance(X, jax.Array) else X
     y = np.asarray(y) if not isinstance(y, jax.Array) else y
     n = X.shape[0]
@@ -100,3 +104,107 @@ def shard_batch(
     ms = None if mask is None else jax.device_put(
         np.asarray(mask), row_sharding)
     return ShardedBatch(Xs, ys, ms)
+
+
+def shard_csr_batch(
+    mesh: Mesh,
+    X: CSRMatrix,
+    y,
+    mask=None,
+    axis: str = DATA_AXIS,
+    balance: bool = True,
+) -> ShardedBatch:
+    """Shard a CSR batch's ROWS over the mesh ``axis`` (sparse DP).
+
+    This is the sparse twin of :func:`shard_batch` — the capability the
+    reference gets for free from Spark (its ``treeAggregate`` pass accepts
+    sparse MLlib vectors, reference ``AcceleratedGradientDescent.scala:
+    196-204``) and VERDICT r1 flagged as the missing parallelism mode for
+    the rcv1/url_combined configs.
+
+    Layout: rows are assigned to shards nnz-balanced (``balance=True``,
+    default — heaviest row onto the currently lightest shard; the loss /
+    gradient / count sums are row-permutation-invariant, so the answer is
+    unchanged) or in contiguous blocks (``balance=False``).  Each shard's
+    entries are re-indexed to LOCAL row ids and padded to one common
+    per-shard nnz (inert 0.0 entries at local row 0 / col 0); row slots
+    beyond a shard's real rows carry mask 0 so the kernels exclude them
+    from every sum — the exact-mean contract of :func:`shard_batch` holds.
+
+    Returns a ``ShardedBatch`` whose ``X`` is a
+    :class:`~spark_agd_tpu.ops.sparse.RowShardedCSR`; its ``mask`` is
+    always present (padding slots must be masked).
+    """
+    n_rows, n_features = X.shape
+    if n_rows == 0:
+        raise ValueError("cannot shard an empty CSR batch")
+    row_ids = np.asarray(X.row_ids)
+    col_ids = np.asarray(X.col_ids)
+    values = np.asarray(X.values)
+    y = np.asarray(y)
+    n_shards = mesh.shape[axis]
+    rps = -(-n_rows // n_shards)  # rows per shard (ceil)
+
+    counts = np.bincount(row_ids, minlength=n_rows)
+    if balance:
+        # Greedy nnz balance (same scheme as the column layout in
+        # feature_sharded.py): heaviest row onto the lightest shard with
+        # remaining capacity.  Bounds the padded per-shard nnz near
+        # max(heaviest row, total/n_shards).
+        import heapq
+
+        order = np.argsort(-counts, kind="stable")
+        shard_of_row = np.empty(n_rows, np.int64)
+        local_of_row = np.empty(n_rows, np.int64)
+        heap = [(0, s) for s in range(n_shards)]
+        capacity = [rps] * n_shards
+        next_local = [0] * n_shards
+        nnz_list = counts[order].tolist()
+        for rank, r in enumerate(order.tolist()):
+            while True:
+                load, s = heapq.heappop(heap)
+                if capacity[s]:
+                    break
+            shard_of_row[r] = s
+            local_of_row[r] = next_local[s]
+            next_local[s] += 1
+            capacity[s] -= 1
+            heapq.heappush(heap, (load + nnz_list[rank], s))
+    else:
+        rows = np.arange(n_rows, dtype=np.int64)
+        shard_of_row = rows // rps
+        local_of_row = rows % rps
+
+    e_shard = shard_of_row[row_ids]
+    e_local = local_of_row[row_ids].astype(np.int32)
+    eorder = np.argsort(e_shard, kind="stable")
+    shard_sorted = e_shard[eorder]
+    starts = np.searchsorted(shard_sorted, np.arange(n_shards))
+    ends = np.searchsorted(shard_sorted, np.arange(n_shards), side="right")
+    nnz_shard = max(int((ends - starts).max()) if len(values) else 1, 1)
+
+    R = np.zeros((n_shards, nnz_shard), np.int32)
+    C = np.zeros((n_shards, nnz_shard), np.int32)
+    V = np.zeros((n_shards, nnz_shard), values.dtype)
+    for s in range(n_shards):
+        sel = eorder[starts[s]:ends[s]]
+        k = len(sel)
+        R[s, :k] = e_local[sel]
+        C[s, :k] = col_ids[sel]
+        V[s, :k] = values[sel]
+
+    Y = np.zeros((n_shards, rps), y.dtype)
+    Y[shard_of_row, local_of_row] = y
+    M = np.zeros((n_shards, rps), np.float32)
+    M[shard_of_row, local_of_row] = (
+        np.ones(n_rows, np.float32) if mask is None
+        else np.asarray(mask, np.float32))
+
+    spec = NamedSharding(mesh, P(axis))
+    Xs = RowShardedCSR(
+        row_ids=jax.device_put(R.reshape(-1), spec),
+        col_ids=jax.device_put(C.reshape(-1), spec),
+        values=jax.device_put(V.reshape(-1), spec),
+        shape=(n_rows, n_features), rows_per_shard=rps, n_shards=n_shards)
+    return ShardedBatch(Xs, jax.device_put(Y.reshape(-1), spec),
+                        jax.device_put(M.reshape(-1), spec))

@@ -214,7 +214,7 @@ def run_agd_checkpointed(
         save_checkpoint(path, warm, np.asarray(hist),
                         converged=bool(res.converged), aborted=aborted,
                         fingerprint=fp)
-        if bool(res.converged) or done == 0:
+        if bool(res.converged) or aborted or done == 0:
             break
 
     return CheckpointedResult(

@@ -202,6 +202,8 @@ class TestSemantics:
             jnp.zeros(2))
         assert bool(r.aborted_non_finite)
         assert int(r.num_iters) == 1  # aborts on the first iteration
+        # an abort is terminal but must NOT report as convergence
+        assert not bool(r.converged)
 
     def test_first_eval_at_initial_weights(self, rng):
         """theta=inf identity (reference :226,:248): the first smooth

@@ -1,0 +1,213 @@
+"""Row-sharded CSR (sparse data parallelism) parity.
+
+The reference's distributed pass accepts sparse MLlib vectors
+(``Gradient.compute`` takes any ``Vector`` inside the treeAggregate seqOp,
+reference ``AcceleratedGradientDescent.scala:196-204``) — so sparse data
+must run the framework's primary parallelism mode too.  These tests pin
+the mesh CSR path (``parallel.mesh.shard_csr_batch`` +
+``parallel.dist_smooth._make_shard_map_csr``) against the single-device
+CSR path at 1/2/8-way shardings for all three GLM losses (VERDICT r1
+item 3's done-condition).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.core import smooth as smooth_lib
+from spark_agd_tpu.ops import sparse
+from spark_agd_tpu.ops.losses import (
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+    SoftmaxGradient,
+)
+from spark_agd_tpu.ops.prox import L1Prox, L2Prox
+from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def csr_problem():
+    """Sparse rows with varying nnz, N deliberately not divisible by 8."""
+    rng = np.random.default_rng(17)
+    n, d = 301, 157
+    counts = rng.integers(1, 12, n)
+    indptr = np.concatenate([[0], np.cumsum(counts)])
+    nnz = int(indptr[-1])
+    indices = rng.integers(0, d, nnz).astype(np.int32)
+    values = rng.standard_normal(nnz).astype(np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32) / np.sqrt(8)
+    margins = np.zeros(n, np.float32)
+    np.add.at(margins, np.repeat(np.arange(n), counts),
+              values * w_true[indices])
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
+    X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+    return X, y, w, d
+
+
+def data_mesh(k):
+    return mesh_lib.make_mesh({mesh_lib.DATA_AXIS: k},
+                              devices=jax.devices()[:k])
+
+
+class TestShardCsrBatch:
+    @pytest.mark.parametrize("k", [1, 2, 8])
+    @pytest.mark.parametrize("balance", [True, False])
+    def test_layout_roundtrip(self, csr_problem, cpu_devices, k, balance):
+        """Every (row, col, value) entry and every (y, mask) slot must
+        survive the layout exactly once."""
+        X, y, w, d = csr_problem
+        m = data_mesh(k)
+        batch = mesh_lib.shard_csr_batch(m, X, y, balance=balance)
+        Xs = batch.X
+        assert isinstance(Xs, sparse.RowShardedCSR)
+        assert Xs.shape == X.shape
+        assert Xs.n_shards == k
+        # mask marks exactly n real rows
+        assert int(np.asarray(batch.mask).sum()) == X.shape[0]
+        # value multiset is preserved (padding adds only zeros)
+        vals = np.asarray(Xs.values)
+        np.testing.assert_allclose(
+            np.sort(vals[vals != 0.0]),
+            np.sort(np.asarray(X.values)[np.asarray(X.values) != 0.0]))
+
+    def test_balance_bounds_padding(self, cpu_devices):
+        """Power-law row nnz (a few huge rows) must not blow up the padded
+        footprint the way contiguous blocks can."""
+        rng = np.random.default_rng(3)
+        n, d = 2000, 300
+        counts = np.minimum((1.0 / rng.random(n)).astype(int), 200)
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        nnz = int(indptr[-1])
+        indices = rng.integers(0, d, nnz).astype(np.int32)
+        values = np.ones(nnz, np.float32)
+        y = rng.integers(0, 2, n).astype(np.float32)
+        X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+        m = data_mesh(8)
+        bal = mesh_lib.shard_csr_batch(m, X, y, balance=True)
+        blowup = bal.X.values.shape[0] / nnz
+        assert blowup < 1.5, f"balanced padding blowup {blowup:.2f}x"
+
+
+class TestMeshCsrSmooth:
+    @pytest.mark.parametrize("grad_cls", [LogisticGradient,
+                                          LeastSquaresGradient,
+                                          HingeGradient])
+    @pytest.mark.parametrize("k", [1, 2, 8])
+    def test_matches_single_device(self, csr_problem, cpu_devices,
+                                   grad_cls, k):
+        X, y, w, d = csr_problem
+        g = grad_cls()
+        ref_loss, ref_grad = smooth_lib.make_smooth(
+            g, X, jnp.asarray(y))(jnp.asarray(w))
+
+        m = data_mesh(k)
+        batch = mesh_lib.shard_csr_batch(m, X, y)
+        smooth, smooth_loss = dist_smooth.make_dist_smooth(
+            g, batch, mesh=m)
+        loss, grad = smooth(mesh_lib.replicate(jnp.asarray(w), m))
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                                   rtol=1e-4, atol=1e-6)
+        assert float(smooth_loss(mesh_lib.replicate(jnp.asarray(w), m))) \
+            == pytest.approx(float(loss), rel=1e-6)
+
+    def test_mask_composes_with_padding(self, csr_problem, cpu_devices):
+        """A caller's minibatch mask must compose with the layout's row
+        padding mask."""
+        X, y, w, d = csr_problem
+        rng = np.random.default_rng(5)
+        mask = (rng.random(X.shape[0]) < 0.55).astype(np.float32)
+        g = LogisticGradient()
+        ref = g.mean_loss_and_grad(jnp.asarray(w), X, jnp.asarray(y),
+                                   jnp.asarray(mask))
+        m = data_mesh(8)
+        batch = mesh_lib.shard_csr_batch(m, X, y, mask=mask)
+        smooth, _ = dist_smooth.make_dist_smooth(g, batch, mesh=m)
+        loss, grad = smooth(mesh_lib.replicate(jnp.asarray(w), m))
+        assert float(loss) == pytest.approx(float(ref[0]), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref[1]),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_softmax_csr_on_mesh(self, cpu_devices):
+        """Multinomial softmax over sparse rows on the data mesh (the
+        MNIST-8M config shape with CSR features)."""
+        rng = np.random.default_rng(11)
+        n, d, k_classes = 120, 40, 5
+        counts = rng.integers(1, 6, n)
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        nnz = int(indptr[-1])
+        X = sparse.CSRMatrix.from_csr_arrays(
+            indptr, rng.integers(0, d, nnz).astype(np.int32),
+            rng.standard_normal(nnz).astype(np.float32), d)
+        y = rng.integers(0, k_classes, n).astype(np.int32)
+        W = (rng.standard_normal((d, k_classes)) / np.sqrt(d)).astype(
+            np.float32)
+        g = SoftmaxGradient(k_classes)
+        ref = smooth_lib.make_smooth(g, X, jnp.asarray(y))(jnp.asarray(W))
+        m = data_mesh(8)
+        batch = mesh_lib.shard_csr_batch(m, X, y)
+        smooth, _ = dist_smooth.make_dist_smooth(g, batch, mesh=m)
+        loss, grad = smooth(mesh_lib.replicate(jnp.asarray(W), m))
+        assert float(loss) == pytest.approx(float(ref[0]), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref[1]),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_auto_mode_rejected(self, csr_problem, cpu_devices):
+        X, y, w, d = csr_problem
+        m = data_mesh(2)
+        batch = mesh_lib.shard_csr_batch(m, X, y)
+        with pytest.raises(ValueError, match="shard_map"):
+            dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                         mesh=m, mode="auto")
+
+
+class TestMeshCsrAGD:
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_full_agd_trajectory_parity(self, csr_problem, cpu_devices, k):
+        """api.run on mesh-sharded CSR must walk the single-device CSR
+        trajectory (VERDICT r1 item 3 done-condition)."""
+        X, y, w, d = csr_problem
+        w0 = np.zeros(d, np.float32)
+        ref_w, ref_hist = api.run(
+            (X, y), LogisticGradient(), L2Prox(), num_iterations=8,
+            reg_param=0.1, initial_weights=w0, mesh=False,
+            convergence_tol=0.0)
+        mesh_w, mesh_hist = api.run(
+            (X, y), LogisticGradient(), L2Prox(), num_iterations=8,
+            reg_param=0.1, initial_weights=w0, mesh=data_mesh(k),
+            convergence_tol=0.0)
+        np.testing.assert_allclose(mesh_hist, ref_hist, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(mesh_w), np.asarray(ref_w),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_l1_sparse_config_shape(self, csr_problem, cpu_devices):
+        """BASELINE config 3's shape (hinge + L1) on the sparse mesh
+        path — runs end to end and matches single-device."""
+        X, y, w, d = csr_problem
+        w0 = np.zeros(d, np.float32)
+        ref_w, ref_hist = api.run(
+            (X, y), HingeGradient(), L1Prox(), num_iterations=6,
+            reg_param=0.01, initial_weights=w0, mesh=False,
+            convergence_tol=0.0)
+        mesh_w, mesh_hist = api.run(
+            (X, y), HingeGradient(), L1Prox(), num_iterations=6,
+            reg_param=0.01, initial_weights=w0, mesh=data_mesh(8),
+            convergence_tol=0.0)
+        np.testing.assert_allclose(mesh_hist, ref_hist, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(mesh_w), np.asarray(ref_w),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_default_mesh_routes_csr(self, csr_problem, cpu_devices):
+        """mesh=None (the default) must now shard CSR over all devices
+        instead of raising NotImplementedError (VERDICT r1 item 3)."""
+        X, y, w, d = csr_problem
+        w0 = np.zeros(d, np.float32)
+        mesh_w, hist = api.run(
+            (X, y), LogisticGradient(), L2Prox(), num_iterations=4,
+            reg_param=0.1, initial_weights=w0, convergence_tol=0.0)
+        assert len(hist) == 4
+        assert np.all(np.isfinite(hist))
