@@ -136,8 +136,10 @@ def _make_step(gradient, Xd, yd, num_iterations):
     from spark_agd_tpu.core import agd, smooth as smooth_lib
     from spark_agd_tpu.ops.prox import L2Prox
 
-    sm = smooth_lib.make_smooth(gradient, Xd, yd, None)
-    sl = smooth_lib.make_smooth_loss(gradient, Xd, yd, None)
+    # one prepare() shared by both factories (no duplicate staged copy)
+    Xd, yd, mask = gradient.prepare(Xd, yd, None)
+    sm = smooth_lib.make_smooth(gradient, Xd, yd, mask)
+    sl = smooth_lib.make_smooth_loss(gradient, Xd, yd, mask)
     px, rv = smooth_lib.make_prox(L2Prox(), REG)
     cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=num_iterations)
     return jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))

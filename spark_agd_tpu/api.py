@@ -72,6 +72,10 @@ def _build_smooth(gradient, data, mesh, dist_mode):
                 X = jnp.asarray(X)
             y = jnp.asarray(y)
             mask = None if mask is None else jnp.asarray(mask)
+        # One prepare() for BOTH factories — two separate calls would
+        # stage two full-size copies of a prepared layout (e.g. the
+        # Pallas tile padding) in HBM.
+        X, y, mask = gradient.prepare(X, y, mask)
         return (smooth_lib.make_smooth(gradient, X, y, mask),
                 smooth_lib.make_smooth_loss(gradient, X, y, mask))
     batch = (data if isinstance(data, mesh_lib.ShardedBatch)

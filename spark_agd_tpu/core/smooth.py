@@ -22,7 +22,12 @@ from ..ops.prox import Prox
 
 
 def make_smooth(gradient: Gradient, X, y, mask=None) -> Callable:
-    """``smooth(w) -> (mean_loss, mean_grad)`` over one in-memory batch."""
+    """``smooth(w) -> (mean_loss, mean_grad)`` over one in-memory batch.
+
+    ``gradient.prepare`` runs ONCE here, at data-placement time, so
+    kernels with a staged layout (the Pallas tile padding) never re-stage
+    inside the compiled optimizer loop."""
+    X, y, mask = gradient.prepare(X, y, mask)
 
     def smooth(w):
         return gradient.mean_loss_and_grad(w, X, y, mask)
@@ -34,6 +39,7 @@ def make_smooth_loss(gradient: Gradient, X, y, mask=None) -> Callable:
     """Loss-only evaluation (no gradient) — used by ``loss_mode='x'`` when
     backtracking is off.  Falls back to the full kernel; specialised
     loss-only kernels can override later."""
+    X, y, mask = gradient.prepare(X, y, mask)
 
     def smooth_loss(w):
         loss_sum, _, n = gradient.batch_loss_and_grad(w, X, y, mask)

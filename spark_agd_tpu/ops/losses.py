@@ -91,6 +91,14 @@ class Gradient:
         unequal shards."""
         raise NotImplementedError
 
+    def prepare(self, X, y, mask=None):
+        """One-time data staging hook, called by the smooth factories at
+        data-placement time (OUTSIDE the optimizer loop).  Implementations
+        may return transformed operands (e.g. the Pallas kernel's
+        tile-padded layout) that their ``batch_loss_and_grad`` recognizes;
+        the default is the identity."""
+        return X, y, mask
+
     # ------------------------------------------------------------------
     # Convenience: mean loss/grad over one in-memory batch (no mesh).
     # ------------------------------------------------------------------
