@@ -42,7 +42,13 @@ from .api import (  # noqa: F401
     run_minibatch_sgd,
 )
 from .core.agd import AGDConfig, AGDResult  # noqa: F401
-from .parallel.mesh import ShardedBatch, make_mesh, shard_batch  # noqa: F401
+from .parallel.mesh import (  # noqa: F401
+    ShardedBatch,
+    make_mesh,
+    replicate,
+    shard_batch,
+    shard_csr_batch,
+)
 from .ops.prox import (  # noqa: F401
     Prox,
     IdentityProx,

@@ -196,12 +196,21 @@ def run_agd(
     def trial_cond(c: _Trial) -> jax.Array:
         return jnp.logical_and(~c.accept, c.n_bt < cfg.max_backtracks)
 
+    def norm_smooth(w_like, out):
+        """Pin smooth outputs to the carry dtype: a smooth that computes
+        in a wider/narrower dtype (e.g. f64 data under x64 with f32
+        weights) must not leak its dtype into the while_loop carry —
+        that's a trace-time cond/carry mismatch."""
+        f, g = out
+        return s(f), tvec.tmap(lambda gi, wi: gi.astype(wi.dtype),
+                               g, w_like)
+
     def make_trial_body(x_old, z_old, l_old, theta_old):
         def trial_body(c: _Trial) -> _Trial:
             theta = 2.0 / (1.0 + jnp.sqrt(
                 1.0 + 4.0 * (c.big_l / l_old) / (theta_old * theta_old)))
             y = tvec.axpby(1.0 - theta, x_old, theta, z_old)
-            f_y, g_y = smooth(y)
+            f_y, g_y = norm_smooth(x_old, smooth(y))
             step = 1.0 / (theta * c.big_l)
             z = prox(z_old, g_y, step)[0]
             x = tvec.axpby(1.0 - theta, x_old, theta, z)
@@ -222,7 +231,7 @@ def run_agd(
                 return (f_y, jnp.asarray(True), c.big_l, c.bts)
 
             def eval_fx(_):
-                f_x, g_x = smooth(x)
+                f_x, g_x = norm_smooth(x_old, smooth(x))
                 q_x = f_y + tvec.dot(xy, g_y) + 0.5 * c.big_l * xy_sq
                 local_simple = (
                     c.big_l + 2.0 * jnp.maximum(f_x - q_x, 0.0) / xy_sq)

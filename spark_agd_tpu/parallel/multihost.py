@@ -18,6 +18,7 @@ unchanged from laptop CPU to multi-slice pods.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
 
 import numpy as np
@@ -25,6 +26,54 @@ import jax
 from jax.sharding import Mesh
 
 from . import mesh as mesh_lib
+
+
+def _already_initialized() -> bool:
+    """State check (not string matching): has jax.distributed joined a
+    job in this process already?"""
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — private API moved; fall through
+        return False
+
+
+def _backends_initialized() -> bool:
+    """State check: has any XLA backend come up?  (jax.distributed must
+    run before that; this is the condition its own ordering error
+    tests.)"""
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:  # noqa: BLE001 — private API moved
+        return False
+
+
+def launcher_markers() -> list:
+    """Environment markers indicating this process is PART OF a
+    multi-process launch (a cluster launcher, MPI, SLURM, or a multi-
+    worker TPU pod).  In such a context a skipped ``initialize`` would
+    silently produce N independent single-host runs — wrong results, no
+    error (ADVICE r1 #1) — so the no-op fallback must not trigger."""
+    env = os.environ
+    found = []
+    for k in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        if env.get(k):
+            found.append(k)
+    hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+             if h.strip()]
+    if len(hosts) > 1:
+        found.append("TPU_WORKER_HOSTNAMES")
+    # NB: only launcher-owned variables belong here — e.g. NPROC is a
+    # common user convention for core count and must NOT be a marker.
+    for k in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE"):
+        v = env.get(k, "")
+        if v.isdigit() and int(v) > 1:
+            found.append(k)
+    return found
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -37,24 +86,38 @@ def initialize(coordinator_address: Optional[str] = None,
     asymmetry of the reference does not exist here)."""
     explicit = any(a is not None for a in (coordinator_address,
                                            num_processes, process_id))
+    if _already_initialized():
+        return  # second call — idempotent
+    if _backends_initialized():
+        # Too late to join: a backend already came up.  In a genuinely
+        # single-process context a bare call is a harmless no-op; inside
+        # a multi-process launch (or with explicit args) degrading to N
+        # independent runs is the silent-wrong-results failure mode, so
+        # it must surface loudly.
+        markers = launcher_markers()
+        if explicit or markers:
+            raise RuntimeError(
+                "jax.distributed.initialize must run before any JAX "
+                "computation, but a backend is already initialized in "
+                "this process"
+                + (f"; multi-process launcher environment detected "
+                   f"({', '.join(markers)})" if markers else "")
+                + ". Move multihost.initialize() to program start.")
+        return
     try:
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
     except RuntimeError as e:
-        msg = str(e).lower()
-        if "already" in msg:
-            return  # second call — idempotent
-        if not explicit and "before any jax calls" in msg:
-            # bare call after the backend came up in a single-process
-            # context (tests, notebooks): nothing to join.  With explicit
-            # args this is a real ordering bug and must surface.
+        # Backstop for the idempotency contract should the private
+        # global_state check above degrade across JAX versions.
+        if "already" in str(e).lower():
             return
         raise
     except ValueError:
-        if explicit:
-            # ANY explicit argument means the caller wanted a multi-host
-            # job; silently degrading to 4 independent single-process runs
-            # would produce wrong results with no error
+        if explicit or launcher_markers():
+            # The caller (or the launch environment) wanted a multi-host
+            # job; silently degrading to N independent single-process
+            # runs would produce wrong results with no error.
             raise
         # bare initialize() in a single-process run (tests / one chip):
         # nothing to join

@@ -1,0 +1,95 @@
+"""Child program for the real 2-process ``jax.distributed`` smoke test
+(tests/test_multihost.py::TestTwoProcess).  Each process contributes its
+local CPU devices to one global mesh, builds a process-local shard of a
+global array, and runs ONE psum over the data axis — the reference's
+executor-process isolation (``LocalClusterSparkContext``, reference
+Suite:242-260) re-created with real separate interpreters, real
+coordinator handshake, real cross-process collective.
+
+Usage: python multihost_child.py <coordinator_addr> <n_proc> <proc_id>
+"""
+
+import sys
+
+import jax
+
+# Order matters: platform config BEFORE distributed init BEFORE any
+# backend use (see parallel/multihost.initialize's ordering guard).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main():
+    addr, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from spark_agd_tpu.parallel import mesh as mesh_lib, multihost as mh
+
+    mh.initialize(addr, nproc, pid)
+    mh.initialize(addr, nproc, pid)  # idempotent second call
+    assert jax.process_count() == nproc, jax.process_count()
+    devs = jax.devices()
+    assert len(devs) == 2 * nproc, devs
+
+    mesh = mesh_lib.make_mesh({"data": len(devs)})
+
+    n_global = 8
+    rows = mh.process_local_rows(n_global)
+    local = np.arange(n_global, dtype=np.float32)[rows]
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local, (n_global,))
+
+    from jax import lax, shard_map
+
+    total = shard_map(
+        lambda x: lax.psum(jnp.sum(x), "data"),
+        mesh=mesh, in_specs=P("data"), out_specs=P(),
+        check_vma=False)(arr)
+    expect = float(np.arange(n_global).sum())
+    assert float(total) == expect, (float(total), expect)
+
+    if len(sys.argv) > 4:
+        _ingest_check(sys.argv[4], mesh)
+    print(f"CHILD_OK pid={pid} psum={float(total)}", flush=True)
+
+
+def _ingest_check(part_dir, mesh):
+    """Multi-host ingest: each process reads its round-robin partition
+    subset; the assembled global batch's mean loss/grad must equal the
+    full-dataset answer every child can compute locally (the files are
+    tiny and shared)."""
+    import glob
+
+    from spark_agd_tpu.data import ingest, libsvm
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
+
+    paths = sorted(glob.glob(part_dir + "/part-*.libsvm"))
+    assert len(paths) >= 2, paths
+    batch = ingest.from_partitioned_files(paths, mesh)
+    sm, _ = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                         mesh=mesh)
+    d = batch.X.shape[1]
+    w = jnp.asarray(np.linspace(-0.4, 0.4, d), jnp.float32)
+    loss, grad = sm(mesh_lib.replicate(w, mesh))
+
+    # every child recomputes the reference from ALL partitions
+    parts = [libsvm.load_libsvm(p, n_features=d) for p in paths]
+    X = np.concatenate([p.to_dense(d) for p in parts])
+    y = np.concatenate([p.binarized_labels() for p in parts]).astype(
+        np.float32)
+    ref_loss, ref_grad = LogisticGradient().mean_loss_and_grad(
+        jnp.asarray(w), jnp.asarray(X), jnp.asarray(y))
+    assert abs(float(loss) - float(ref_loss)) < 1e-5 * abs(
+        float(ref_loss)), (float(loss), float(ref_loss))
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                               rtol=1e-4, atol=1e-6)
+    print(f"INGEST_OK pid={jax.process_index()} rows={batch.X.shape[0]}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

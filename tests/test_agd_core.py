@@ -205,6 +205,27 @@ class TestSemantics:
         # an abort is terminal but must NOT report as convergence
         assert not bool(r.converged)
 
+    def test_smooth_dtype_mismatch_tolerated(self, rng):
+        """A smooth computing in f64 (x64 data) with f32 weights must not
+        blow up the while_loop carry (regression: trace-time cond dtype
+        mismatch)."""
+        X = jnp.asarray(rng.normal(size=(50, 4)))  # f64 under x64
+        y = jnp.asarray((rng.random(50) < 0.5).astype(np.float64))
+
+        def smooth64(w):
+            m = X @ w.astype(X.dtype)
+            loss = jnp.mean(jnp.logaddexp(0.0, m) - y * m)
+            g = X.T @ (jax.nn.sigmoid(m) - y) / X.shape[0]
+            return loss, g  # both f64
+
+        px, rv = smooth_lib.make_prox(prox.L2Prox(), 0.1)
+        cfg = agd.AGDConfig(num_iterations=4, convergence_tol=0.0)
+        r = jax.jit(lambda w: agd.run_agd(smooth64, px, rv, w, cfg))(
+            jnp.zeros(4, jnp.float32))
+        assert r.weights.dtype == jnp.float32
+        hist = np.asarray(r.loss_history)[:int(r.num_iters)]
+        assert len(hist) == 4 and np.all(np.isfinite(hist))
+
     def test_first_eval_at_initial_weights(self, rng):
         """theta=inf identity (reference :226,:248): the first smooth
         evaluation must happen exactly at w0."""

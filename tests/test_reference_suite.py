@@ -147,6 +147,36 @@ class TestClusterSuiteAnalogue:
         # analogue): the result is a committed jax.Array on the mesh
         assert isinstance(w, jax.Array)
 
+    def test_no_per_iteration_host_transfers(self):
+        """The teeth of the reference's 1MB-closure guard (Suite:256-258),
+        restored (VERDICT r1 item 8): once data and weights are placed,
+        the ENTIRE multi-iteration optimization must execute with ZERO
+        host<->device transfers.  ``jax.transfer_guard('disallow')``
+        turns any weight round-trip through the host — the reference's
+        per-evaluation broadcast/collect pattern — into a hard error."""
+        from spark_agd_tpu.core import agd, smooth as smooth_lib
+        from spark_agd_tpu.parallel import dist_smooth
+
+        m, n = 64, 50_000
+        rng = np.random.default_rng(1)
+        X = (rng.standard_normal((m, n)) / np.sqrt(n)).astype(np.float32)
+        y = (rng.random(m) < 0.5).astype(np.float32)
+
+        mesh = sat.make_mesh({"data": 8})
+        # explicit placement: the one broadcast-equivalent, outside the loop
+        batch = sat.shard_batch(mesh, X, y)
+        w0 = sat.replicate(jnp.zeros(n, jnp.float32), mesh)
+        sm, sl = dist_smooth.make_dist_smooth(gradient, batch, mesh=mesh)
+        px, rv = smooth_lib.make_prox(squared_l2_updater, 0.5)
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=5)
+        step = jax.jit(
+            lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
+        with jax.transfer_guard("disallow"):
+            res = step(w0)  # compile + 5 full AGD iterations, no host hops
+            jax.block_until_ready(res.weights)
+        hist = np.asarray(res.loss_history)[:int(res.num_iters)]
+        assert len(hist) == 5 and np.all(np.isfinite(hist))
+
 
 class TestShardedBatchInput:
     def test_batch_mesh_is_recovered(self, data):
