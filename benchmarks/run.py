@@ -49,6 +49,13 @@ class BenchConfig:
     reg_param: float
     make_w0: Callable  # (X) -> initial weights
     gd_step_size: float = 1.0  # oracle step size
+    # Largest row-scale that fits ONE chip's HBM (~16 GB on v5e) with
+    # comfortable headroom for the optimizer state and XLA workspace —
+    # used when --scale is not given and the backend is a TPU
+    # (VERDICT r1 item 5: "the largest scale fitting one chip's HBM").
+    tpu_scale: float = 1.0
+    # margin-form dense config eligible for the fused Pallas kernel
+    pallas_ok: bool = False
 
 
 def _glm_w0(X):
@@ -56,22 +63,30 @@ def _glm_w0(X):
 
 
 CONFIGS = [
+    # rcv1-like CSR: 697k rows x 74 nnz ~= 0.6 GB device-resident -> full
     BenchConfig(1, "logistic_l2_rcv1like", datasets.rcv1_like,
                 losses.LogisticGradient, prox.SquaredL2Updater,
-                1e-4, _glm_w0),
+                1e-4, _glm_w0, tpu_scale=1.0),
+    # dense 10M x 1k f32 = 40 GB at scale 1; 0.12 -> 1.2M rows ~= 4.8 GB
     BenchConfig(2, "linreg_dense", datasets.dense_linreg,
                 losses.LeastSquaresGradient, prox.IdentityProx,
-                0.0, _glm_w0, gd_step_size=0.1),
+                0.0, _glm_w0, gd_step_size=0.1, tpu_scale=0.12,
+                pallas_ok=True),
+    # url-like CSR: 2.4M rows x 116 nnz ~= 3.3 GB + 4 D-vectors -> full
     BenchConfig(3, "svm_l1_urllike", datasets.url_like,
                 losses.HingeGradient, prox.L1Updater,
-                1e-5, _glm_w0),
+                1e-5, _glm_w0, tpu_scale=1.0),
+    # dense 8.1M x 784 = 25 GB at scale 1; 0.15 -> 1.2M rows ~= 3.8 GB
     BenchConfig(4, "softmax_mnist8mlike", datasets.mnist8m_like,
                 lambda: losses.SoftmaxGradient(10), prox.SquaredL2Updater,
-                1e-4, lambda X: np.zeros((X.shape[1], 10), np.float32)),
+                1e-4, lambda X: np.zeros((X.shape[1], 10), np.float32),
+                tpu_scale=0.15),
+    # dense 1M x 1k = 4 GB -> full
     BenchConfig(5, "mlp_criteolike", datasets.criteo_like,
                 lambda: mlp_lib.mlp_gradient("tanh"), prox.SquaredL2Updater,
                 1e-5,
-                lambda X: mlp_lib.init_mlp_params(X.shape[1], 32, 2, 0)),
+                lambda X: mlp_lib.init_mlp_params(X.shape[1], 32, 2, 0),
+                tpu_scale=1.0),
 ]
 
 
@@ -108,20 +123,30 @@ def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
 
 
 def run_config(config: BenchConfig, scale: float, iters: int,
-               gd_cap: int = 0, eps: float = 1e-3) -> dict:
+               gd_cap: int = 0, eps: float = 1e-3,
+               use_pallas: bool = False) -> dict:
     import jax
 
     t0 = time.perf_counter()
     X, y = config.make_data(scale)
     gen_s = time.perf_counter() - t0
     n = X.shape[0]
-    log(f"[{config.name}] data {X.shape} generated in {gen_s:.1f}s")
+    log(f"[{config.name}] scale={scale} data {X.shape} "
+        f"generated in {gen_s:.1f}s")
 
     w0 = config.make_w0(X)
     data = (X, y)
 
+    def make_gradient():
+        g = config.gradient()
+        if use_pallas and config.pallas_ok:
+            from spark_agd_tpu.ops.pallas_kernels import PallasMarginGradient
+
+            return PallasMarginGradient(g)
+        return g
+
     def fit(w):
-        return api.run(data, config.gradient(), config.updater(),
+        return api.run(data, make_gradient(), config.updater(),
                        convergence_tol=0.0, num_iterations=iters,
                        reg_param=config.reg_param, initial_weights=w,
                        return_result=True)
@@ -151,7 +176,10 @@ def run_config(config: BenchConfig, scale: float, iters: int,
         "config": config.idx,
         "name": config.name,
         "rows": int(n),
+        "scale": scale,
+        "pallas": bool(use_pallas and config.pallas_ok),
         "platform": jax.devices()[0].platform,
+        "n_devices": len(jax.devices()),
         "iters": n_iters,
         "compile_s": round(compile_s - run_s, 2),
         "iters_per_sec": round(ips, 2),
@@ -169,21 +197,52 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", type=int, default=0,
                    help="config index 1-5; 0 = all")
-    p.add_argument("--scale", type=float, default=0.002,
-                   help="row-count scale vs the real dataset")
+    p.add_argument("--scale", type=float, default=None,
+                   help="row-count scale vs the real dataset; default = "
+                        "each config's one-chip-HBM scale on TPU, 0.002 "
+                        "elsewhere")
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--gd-cap", type=int, default=0,
                    help="if >0, run the GD oracle up to this many "
                         "iterations for the iteration-efficiency ratio")
+    p.add_argument("--pallas", action="store_true",
+                   help="use the fused Pallas kernel on eligible dense "
+                        "margin configs")
+    p.add_argument("--out", type=str, default=None,
+                   help="also append each record to this file as a JSON "
+                        "line (e.g. BENCH_CONFIGS_r02.json)")
     args = p.parse_args(argv)
 
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
     selected = [c for c in CONFIGS
                 if args.config in (0, c.idx)]
     if not selected:
         p.error(f"unknown config {args.config}")
+    out_f = open(args.out, "a") if args.out else None
+    failures = 0
     for cfg in selected:
-        rec = run_config(cfg, args.scale, args.iters, gd_cap=args.gd_cap)
+        scale = args.scale if args.scale is not None else (
+            cfg.tpu_scale if on_tpu else 0.002)
+        try:
+            rec = run_config(cfg, scale, args.iters, gd_cap=args.gd_cap,
+                             use_pallas=args.pallas)
+        except Exception as e:  # noqa: BLE001 — one config must not
+            # take down the others; the record carries the error
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            rec = {"config": cfg.idx, "name": cfg.name, "scale": scale,
+                   "error": f"{type(e).__name__}: {e}"[:500]}
+            failures += 1
         print(json.dumps(rec), flush=True)
+        if out_f:
+            out_f.write(json.dumps(rec) + "\n")
+            out_f.flush()
+    if out_f:
+        out_f.close()
+    sys.exit(1 if failures else 0)
 
 
 if __name__ == "__main__":

@@ -280,15 +280,15 @@ def run_agd(
 
         # ---- loss history (reference :302-307 / commented :296-300) ----
         if cfg.loss_mode == "y":
-            loss = t.f_y + reg_value(t.y)
+            loss = t.f_y + s(reg_value(t.y))
         elif cfg.loss_mode == "x_strict":
-            loss = smooth(t.x)[0] + reg_value(t.x)
+            loss = s(smooth(t.x)[0]) + s(reg_value(t.x))
         else:  # 'x': reuse the backtracking pass's f(x)
             if backtracking:
-                loss = t.f_x + reg_value(t.x)
+                loss = t.f_x + s(reg_value(t.x))
             else:
                 ls = smooth_loss or (lambda w: smooth(w)[0])
-                loss = ls(t.x) + reg_value(t.x)
+                loss = s(ls(t.x)) + s(reg_value(t.x))
 
         it_new = o.it + 1
         loss_hist = o.loss_hist.at[o.it].set(loss)

@@ -219,12 +219,23 @@ class TestSemantics:
             return loss, g  # both f64
 
         px, rv = smooth_lib.make_prox(prox.L2Prox(), 0.1)
-        cfg = agd.AGDConfig(num_iterations=4, convergence_tol=0.0)
+        # every loss_mode has its own smooth call site; all must pin dtype
+        for mode in ("x", "x_strict", "y"):
+            cfg = agd.AGDConfig(num_iterations=4, convergence_tol=0.0,
+                                loss_mode=mode)
+            r = jax.jit(lambda w, c=cfg: agd.run_agd(
+                smooth64, px, rv, w, c))(jnp.zeros(4, jnp.float32))
+            assert r.weights.dtype == jnp.float32
+            assert r.loss_history.dtype == jnp.float32
+            hist = np.asarray(r.loss_history)[:int(r.num_iters)]
+            assert len(hist) == 4 and np.all(np.isfinite(hist))
+        # beta>=1 ('x' without backtracking) uses the smooth_loss seam
+        cfg = agd.AGDConfig(num_iterations=3, convergence_tol=0.0,
+                            beta=1.0)
         r = jax.jit(lambda w: agd.run_agd(smooth64, px, rv, w, cfg))(
             jnp.zeros(4, jnp.float32))
-        assert r.weights.dtype == jnp.float32
-        hist = np.asarray(r.loss_history)[:int(r.num_iters)]
-        assert len(hist) == 4 and np.all(np.isfinite(hist))
+        assert r.loss_history.dtype == jnp.float32
+        assert int(r.num_iters) == 3
 
     def test_first_eval_at_initial_weights(self, rng):
         """theta=inf identity (reference :226,:248): the first smooth
