@@ -65,6 +65,11 @@ N_ROWS = int(os.environ.get("BENCH_ROWS", 1 << 19))
 N_FEATURES = int(os.environ.get("BENCH_FEATURES", 512))
 NUM_ITERS_TPU = int(os.environ.get("BENCH_ITERS_TPU", 40))
 NUM_ITERS_CPU = int(os.environ.get("BENCH_ITERS_CPU", 5))
+# BENCH_DTYPE=bf16 stores X in bfloat16: native MXU dtype, HALF the HBM
+# traffic of the f32 layout on this HBM-bound workload.  The parity gate
+# always runs on the f32 copy; the bf16 trajectory is drift-checked
+# loosely (warn only).
+BENCH_DTYPE = os.environ.get("BENCH_DTYPE", "f32")
 PARITY_ITERS = int(os.environ.get("BENCH_PARITY_ITERS", 10))
 REG = 0.1
 RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 30))
@@ -160,12 +165,13 @@ def _time_step(step, w0):
     return res, run_s, compile_s
 
 
-def _roofline(res, run_s, device, x_reads_per_pass=2):
+def _roofline(res, run_s, device, x_reads_per_pass=2, itemsize=4):
     """iters/sec plus MFU / HBM-bandwidth fraction for one timed run.
 
     ``x_reads_per_pass``: full HBM reads of X per smooth evaluation — 2
     for the XLA lowering (forward matmul + gradient matmul), 1 for the
-    fused Pallas kernel.
+    fused Pallas kernel.  ``itemsize``: bytes per X element (4 f32,
+    2 bf16).
     """
     iters = int(res.num_iters)
     n_bt = int(res.num_backtracks)
@@ -175,7 +181,7 @@ def _roofline(res, run_s, device, x_reads_per_pass=2):
     # core/agd.py module docstring.
     passes = 2 * (iters + n_bt)
     flops = passes * 4.0 * N_ROWS * N_FEATURES
-    hbm_bytes = passes * x_reads_per_pass * N_ROWS * N_FEATURES * 4.0
+    hbm_bytes = passes * x_reads_per_pass * N_ROWS * N_FEATURES * itemsize
     out = {
         "iters_per_sec": iters / run_s,
         "smooth_passes": passes,
@@ -200,7 +206,7 @@ def bench_tpu(Xd, yd, w0, device):
     res, run_s, compile_s = _time_step(step, w0)
     iters = int(res.num_iters)
     hist = np.asarray(res.loss_history)[:iters]
-    stats = _roofline(res, run_s, device)
+    stats = _roofline(res, run_s, device, itemsize=Xd.dtype.itemsize)
     log(f"xla: compile={compile_s:.1f}s run={run_s * 1e3:.1f}ms "
         f"iters={iters} backtracks={int(res.num_backtracks)} "
         f"final_loss={hist[-1]:.6f} "
@@ -224,8 +230,8 @@ def bench_tpu_pallas(Xd, yd, w0, device):
 
         step = _make_step(PallasLogisticGradient(), Xd, yd, NUM_ITERS_TPU)
         res, run_s, compile_s = _time_step(step, w0)
-        stats = _roofline(res, run_s, device,
-                          x_reads_per_pass=1)  # fused: one X read
+        stats = _roofline(res, run_s, device, x_reads_per_pass=1,
+                          itemsize=Xd.dtype.itemsize)  # fused: one X read
         log(f"pallas: compile={compile_s:.1f}s run={run_s * 1e3:.1f}ms "
             f"iters={int(res.num_iters)} "
             f"hbm={stats['hbm_gbps']:.0f}GB/s "
@@ -303,12 +309,13 @@ def run_bench():
         f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB)")
     X, y = make_data()
     # One H2D transfer; every consumer below shares the device arrays.
-    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+    Xd32, yd = jnp.asarray(X), jnp.asarray(y)
+    Xd = Xd32.astype(jnp.bfloat16) if BENCH_DTYPE == "bf16" else Xd32
     w0 = jnp.zeros(X.shape[1], jnp.float32)
     xla, xla_hist, compile_s = bench_tpu(Xd, yd, w0, device)
     pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
     cpu_ips, cpu_res = bench_cpu(X, y)
-    check_parity(Xd, yd, w0, cpu_res.loss_history)
+    check_parity(Xd32, yd, w0, cpu_res.loss_history)
 
     # Loose sanity check on the default-precision headline trajectory —
     # warn-only (bf16 MXU drift is expected, not a failure).
@@ -327,6 +334,7 @@ def run_bench():
         "vs_baseline": round(xla["iters_per_sec"] / cpu_ips, 2),
         "platform": device.platform,
         "device_kind": device.device_kind,
+        "dtype": BENCH_DTYPE,
         "compile_s": round(compile_s, 1),
         "mfu": None if xla["mfu"] is None else round(xla["mfu"], 4),
         "hbm_bw_frac": None if xla["hbm_bw_frac"] is None

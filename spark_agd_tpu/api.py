@@ -84,6 +84,62 @@ def _build_smooth(gradient, data, mesh, dist_mode):
                                         mode=dist_mode)
 
 
+def make_runner(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    mesh=None,
+    dist_mode: str = "shard_map",
+    loss_mode: str = "x",
+):
+    """Build ``fit(initial_weights) -> AGDResult``, compiled ONCE.
+
+    ``run()`` builds fresh closures per call, so jit's executable cache
+    misses and a second ``run()`` on the same problem re-traces and
+    re-compiles — fatal for repeated fits (hyper-parameter sweeps,
+    steady-state benchmarking).  The runner returned here carries one
+    ``jax.jit`` program; every ``fit`` after the first reuses it.
+    """
+    data = _normalize_data(data)
+    if isinstance(data, mesh_lib.ShardedBatch):
+        batch_mesh = data.X.sharding.mesh
+        if mesh is None:
+            mesh = batch_mesh
+        elif mesh is not False and mesh != batch_mesh:
+            raise ValueError(
+                "explicit mesh differs from the ShardedBatch's mesh; "
+                "re-shard the batch or drop the mesh argument")
+    if (not isinstance(data, mesh_lib.ShardedBatch)
+            and isinstance(data[0], CSRMatrix)):
+        dist_mode = "shard_map"  # see run()
+    m = _resolve_mesh(mesh)
+    sm, sl = _build_smooth(gradient, data, m, dist_mode)
+    px, rv = smooth_lib.make_prox(updater, reg_param)
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+    step = jax.jit(
+        lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
+
+    def fit(initial_weights):
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        if m is not None:
+            w0 = mesh_lib.replicate(w0, m)
+        return step(w0)
+
+    return fit
+
+
 def run(
     data: Data,
     gradient: Gradient,
@@ -108,7 +164,8 @@ def run(
     ``loss_history`` is a NumPy array with exactly one entry per executed
     iteration (the reference's ``len(lossHistory) == iterations`` contract,
     Suite:181-182).  ``return_result=True`` additionally returns the full
-    ``AGDResult`` diagnostics."""
+    ``AGDResult`` diagnostics.  For repeated fits of the same problem use
+    ``make_runner`` (compiles once)."""
     if initial_weights is None:
         raise ValueError("initial_weights is required")
     data = _normalize_data(data)
