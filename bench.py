@@ -70,6 +70,9 @@ NUM_ITERS_CPU = int(os.environ.get("BENCH_ITERS_CPU", 5))
 # always runs on the f32 copy; the bf16 trajectory is drift-checked
 # loosely (warn only).
 BENCH_DTYPE = os.environ.get("BENCH_DTYPE", "f32")
+if BENCH_DTYPE not in ("f32", "bf16"):
+    raise SystemExit(
+        f"BENCH_DTYPE must be 'f32' or 'bf16', got {BENCH_DTYPE!r}")
 PARITY_ITERS = int(os.environ.get("BENCH_PARITY_ITERS", 10))
 REG = 0.1
 RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 30))

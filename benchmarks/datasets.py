@@ -34,7 +34,10 @@ def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
     np.add.at(margins, row_ids, values * w[col_ids])
     p = 1.0 / (1.0 + np.exp(-margins))
     y = (rng.random(n_rows) < p).astype(np.float32)
-    X = CSRMatrix(row_ids, col_ids, values, (n_rows, n_features))
+    # rows are sorted by construction; carry the column-sorted twin so the
+    # gradient path runs sorted segment-sums on TPU (ops.sparse docstring)
+    X = CSRMatrix(row_ids, col_ids, values, (n_rows, n_features),
+                  rows_sorted=True).with_csc()
     return X, y
 
 

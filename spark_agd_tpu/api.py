@@ -111,6 +111,8 @@ def make_runner(
     """
     data = _normalize_data(data)
     if isinstance(data, mesh_lib.ShardedBatch):
+        # A pre-placed batch carries its own mesh; recover it rather than
+        # defaulting to an all-device mesh the batch may not live on.
         batch_mesh = data.X.sharding.mesh
         if mesh is None:
             mesh = batch_mesh
@@ -120,7 +122,11 @@ def make_runner(
                 "re-shard the batch or drop the mesh argument")
     if (not isinstance(data, mesh_lib.ShardedBatch)
             and isinstance(data[0], CSRMatrix)):
-        dist_mode = "shard_map"  # see run()
+        # CSR rows shard over the data axis like dense rows do
+        # (mesh.shard_csr_batch, nnz-balanced); the GSPMD 'auto' mode
+        # cannot partition the segment-sum's row-id indirection, so the
+        # sparse mesh path always runs the explicit shard_map mode.
+        dist_mode = "shard_map"
     m = _resolve_mesh(mesh)
     sm, sl = _build_smooth(gradient, data, m, dist_mode)
     px, rv = smooth_lib.make_prox(updater, reg_param)
@@ -168,38 +174,12 @@ def run(
     ``make_runner`` (compiles once)."""
     if initial_weights is None:
         raise ValueError("initial_weights is required")
-    data = _normalize_data(data)
-    if isinstance(data, mesh_lib.ShardedBatch):
-        # A pre-placed batch carries its own mesh; recover it rather than
-        # defaulting to an all-device mesh the batch may not live on.
-        batch_mesh = data.X.sharding.mesh
-        if mesh is None:
-            mesh = batch_mesh
-        elif mesh is not False and mesh != batch_mesh:
-            raise ValueError(
-                "explicit mesh differs from the ShardedBatch's mesh; "
-                "re-shard the batch or drop the mesh argument")
-    if (not isinstance(data, mesh_lib.ShardedBatch)
-            and isinstance(data[0], CSRMatrix)):
-        # CSR rows shard over the data axis like dense rows do
-        # (mesh.shard_csr_batch, nnz-balanced); the GSPMD 'auto' mode
-        # cannot partition the segment-sum's row-id indirection, so the
-        # sparse mesh path always runs the explicit shard_map mode.
-        dist_mode = "shard_map"
-    m = _resolve_mesh(mesh)
-    sm, sl = _build_smooth(gradient, data, m, dist_mode)
-    px, rv = smooth_lib.make_prox(updater, reg_param)
-    cfg = agd.AGDConfig(
-        convergence_tol=convergence_tol, num_iterations=num_iterations,
-        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
-        may_restart=may_restart, loss_mode=loss_mode)
-
-    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-    if m is not None:
-        w0 = mesh_lib.replicate(w0, m)
-
-    result = jax.jit(
-        lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))(w0)
+    fit = make_runner(
+        data, gradient, updater, convergence_tol=convergence_tol,
+        num_iterations=num_iterations, reg_param=reg_param, l0=l0,
+        l_exact=l_exact, beta=beta, alpha=alpha, may_restart=may_restart,
+        mesh=mesh, dist_mode=dist_mode, loss_mode=loss_mode)
+    result = fit(initial_weights)
     n = int(result.num_iters)
     loss_history = np.asarray(result.loss_history)[:n]
     if return_result:

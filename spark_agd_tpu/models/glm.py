@@ -54,7 +54,20 @@ def _add_intercept(X):
             [jnp.zeros(n, X.col_ids.dtype), X.col_ids + 1])
         values = jnp.concatenate(
             [jnp.ones(n, X.values.dtype), X.values])
-        return CSRMatrix(row_ids, col_ids, values, (n, d + 1))
+        csc = {}
+        if X.has_csc:
+            # prepending the all-col-0 intercept block keeps column order
+            csc = dict(
+                csc_row_ids=jnp.concatenate(
+                    [jnp.arange(n, dtype=X.csc_row_ids.dtype),
+                     X.csc_row_ids]),
+                csc_col_ids=jnp.concatenate(
+                    [jnp.zeros(n, X.csc_col_ids.dtype), X.csc_col_ids + 1]),
+                csc_values=jnp.concatenate(
+                    [jnp.ones(n, X.csc_values.dtype), X.csc_values]))
+        # the interleave puts all intercept entries first: row ids are no
+        # longer nondecreasing, so the forward copy drops its sorted claim
+        return CSRMatrix(row_ids, col_ids, values, (n, d + 1), **csc)
     X = jnp.asarray(X)
     return jnp.concatenate(
         [jnp.ones((X.shape[0], 1), X.dtype), X], axis=1)

@@ -157,21 +157,23 @@ def _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis):
             "RowShardedCSR requires its padding mask; build the batch "
             "with parallel.mesh.shard_csr_batch")
     row = P(data_axis)
-    in_specs = (P(), row, row, row, row, row)
+    n_csc = 3 if X.has_csc else 0
+    in_specs = (P(),) + (row,) * (5 + n_csc)
     out_specs = (P(), P(), P())
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False)
-    def _eval(w, rid, cid, val, ys, ms):
-        Xl = X.local_csr(rid, cid, val)
+    def _eval(w, rid, cid, val, ys, ms, *csc):
+        Xl = X.local_csr(rid, cid, val, *csc)
         ls, gs, n = gradient.batch_loss_and_grad(w, Xl, ys, ms)
         ls = lax.psum(ls, data_axis)
         gs = tvec.tmap(lambda g: lax.psum(g, data_axis), gs)
         n = lax.psum(n, data_axis)
         return ls, gs, n
 
-    args = (X.row_ids, X.col_ids, X.values, y, mask)
+    args = (X.row_ids, X.col_ids, X.values, y, mask) + (
+        (X.csc_row_ids, X.csc_col_ids, X.csc_values) if X.has_csc else ())
 
     def smooth(w):
         ls, gs, n = _eval(w, *args)

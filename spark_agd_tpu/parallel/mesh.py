@@ -126,10 +126,14 @@ def shard_csr_batch(
     default — heaviest row onto the currently lightest shard; the loss /
     gradient / count sums are row-permutation-invariant, so the answer is
     unchanged) or in contiguous blocks (``balance=False``).  Each shard's
-    entries are re-indexed to LOCAL row ids and padded to one common
-    per-shard nnz (inert 0.0 entries at local row 0 / col 0); row slots
-    beyond a shard's real rows carry mask 0 so the kernels exclude them
-    from every sum — the exact-mean contract of :func:`shard_batch` holds.
+    entries are re-indexed to LOCAL row ids, sorted by local row, and
+    padded to one common per-shard nnz (inert 0.0 entries pointing at the
+    last row/col slot, keeping ids nondecreasing for the sorted
+    segment-sums); row slots beyond a shard's real rows carry mask 0 so
+    the kernels exclude them from every sum — the exact-mean contract of
+    :func:`shard_batch` holds.  When ``X`` carries a CSC twin
+    (``CSRMatrix.with_csc``), each shard also gets its column-sorted
+    entry copy so the mesh gradient path uses sorted reductions too.
 
     Returns a ``ShardedBatch`` whose ``X`` is a
     :class:`~spark_agd_tpu.ops.sparse.RowShardedCSR`; its ``mask`` is
@@ -183,15 +187,30 @@ def shard_csr_batch(
     ends = np.searchsorted(shard_sorted, np.arange(n_shards), side="right")
     nnz_shard = max(int((ends - starts).max()) if len(values) else 1, 1)
 
-    R = np.zeros((n_shards, nnz_shard), np.int32)
+    with_csc = X.has_csc
+    # Padding slots point at the LAST local row / col (inert 0.0 values)
+    # so per-shard ids stay nondecreasing and both segment-sums can claim
+    # ``indices_are_sorted`` (see ops.sparse module docstring).
+    R = np.full((n_shards, nnz_shard), rps - 1, np.int32)
     C = np.zeros((n_shards, nnz_shard), np.int32)
     V = np.zeros((n_shards, nnz_shard), values.dtype)
+    if with_csc:
+        Rc = np.zeros((n_shards, nnz_shard), np.int32)
+        Cc = np.full((n_shards, nnz_shard), n_features - 1, np.int32)
+        Vc = np.zeros((n_shards, nnz_shard), values.dtype)
     for s in range(n_shards):
         sel = eorder[starts[s]:ends[s]]
+        # row-sorted copy: order the shard's entries by local row id
+        sel_r = sel[np.argsort(e_local[sel], kind="stable")]
         k = len(sel)
-        R[s, :k] = e_local[sel]
-        C[s, :k] = col_ids[sel]
-        V[s, :k] = values[sel]
+        R[s, :k] = e_local[sel_r]
+        C[s, :k] = col_ids[sel_r]
+        V[s, :k] = values[sel_r]
+        if with_csc:  # column-sorted twin of the same entries
+            sel_c = sel[np.argsort(col_ids[sel], kind="stable")]
+            Rc[s, :k] = e_local[sel_c]
+            Cc[s, :k] = col_ids[sel_c]
+            Vc[s, :k] = values[sel_c]
 
     Y = np.zeros((n_shards, rps), y.dtype)
     Y[shard_of_row, local_of_row] = y
@@ -201,10 +220,16 @@ def shard_csr_batch(
         else np.asarray(mask, np.float32))
 
     spec = NamedSharding(mesh, P(axis))
+    csc = {}
+    if with_csc:
+        csc = dict(csc_row_ids=jax.device_put(Rc.reshape(-1), spec),
+                   csc_col_ids=jax.device_put(Cc.reshape(-1), spec),
+                   csc_values=jax.device_put(Vc.reshape(-1), spec))
     Xs = RowShardedCSR(
         row_ids=jax.device_put(R.reshape(-1), spec),
         col_ids=jax.device_put(C.reshape(-1), spec),
         values=jax.device_put(V.reshape(-1), spec),
-        shape=(n_rows, n_features), rows_per_shard=rps, n_shards=n_shards)
+        shape=(n_rows, n_features), rows_per_shard=rps, n_shards=n_shards,
+        rows_sorted=True, **csc)
     return ShardedBatch(Xs, jax.device_put(Y.reshape(-1), spec),
                         jax.device_put(M.reshape(-1), spec))

@@ -1,0 +1,207 @@
+"""The CSC-twin sparse layout (sorted-reduction gradient path).
+
+``X.T @ mult`` over unsorted column ids is a scatter-add — the one sparse
+primitive TPUs lower badly.  ``CSRMatrix.with_csc()`` carries a
+column-sorted copy of the entries so ``rmatvec``/``rmatmat`` become the
+same sorted ``segment_sum`` shape as the forward product (ops/sparse.py
+module docstring).  These tests pin:
+
+- product parity: the CSC path equals the scatter path and the dense
+  products (up to f32 reassociation),
+- layout invariants: per-shard ids really are nondecreasing after
+  ``shard_csr_batch`` (the precondition for ``indices_are_sorted`` —
+  claiming it falsely produces silently wrong sums),
+- end-to-end: mesh AGD trajectories with and without the twin agree with
+  the single-device run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.models import glm
+from spark_agd_tpu.ops import sparse
+from spark_agd_tpu.ops.losses import (
+    HingeGradient,
+    LeastSquaresGradient,
+    LogisticGradient,
+    SoftmaxGradient,
+)
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def csr_problem():
+    """Duplicate (row, col) pairs included — scatter-add and segment-sum
+    must both accumulate them."""
+    rng = np.random.default_rng(23)
+    n, d = 211, 97
+    counts = rng.integers(1, 9, n)
+    indptr = np.concatenate([[0], np.cumsum(counts)])
+    nnz = int(indptr[-1])
+    indices = rng.integers(0, d, nnz).astype(np.int32)
+    values = rng.standard_normal(nnz).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d,
+                                         with_csc=True)
+    return X, y, n, d
+
+
+def dense_of(X: sparse.CSRMatrix) -> np.ndarray:
+    D = np.zeros(X.shape, np.float64)
+    np.add.at(D, (np.asarray(X.row_ids), np.asarray(X.col_ids)),
+              np.asarray(X.values, np.float64))
+    return D
+
+
+class TestCscProducts:
+    def test_construction(self, csr_problem):
+        X, _, _, _ = csr_problem
+        assert X.has_csc and X.rows_sorted
+        cid = np.asarray(X.csc_col_ids)
+        assert np.all(np.diff(cid) >= 0), "csc cols must be nondecreasing"
+        # same multiset of entries in both copies
+        ents = sorted(zip(np.asarray(X.row_ids).tolist(),
+                          np.asarray(X.col_ids).tolist(),
+                          np.asarray(X.values).tolist()))
+        csc_ents = sorted(zip(np.asarray(X.csc_row_ids).tolist(),
+                              np.asarray(X.csc_col_ids).tolist(),
+                              np.asarray(X.csc_values).tolist()))
+        assert ents == csc_ents
+
+    def test_with_csc_idempotent(self, csr_problem):
+        X, _, _, _ = csr_problem
+        assert X.with_csc() is X
+
+    def test_rmatvec_matches_scatter_and_dense(self, csr_problem):
+        X, _, n, d = csr_problem
+        rng = np.random.default_rng(5)
+        v = rng.standard_normal(n).astype(np.float32)
+        no_csc = sparse.CSRMatrix(X.row_ids, X.col_ids, X.values, X.shape,
+                                  rows_sorted=True)
+        got = np.asarray(X.rmatvec(jnp.asarray(v)))
+        scatter = np.asarray(no_csc.rmatvec(jnp.asarray(v)))
+        ref = dense_of(X).T @ v.astype(np.float64)
+        np.testing.assert_allclose(got, scatter, rtol=2e-5, atol=1e-5)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-4)
+
+    def test_rmatmat_matches(self, csr_problem):
+        X, _, n, d = csr_problem
+        rng = np.random.default_rng(6)
+        V = rng.standard_normal((n, 4)).astype(np.float32)
+        got = np.asarray(X.rmatmat(jnp.asarray(V)))
+        ref = dense_of(X).T @ V.astype(np.float64)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-4)
+
+    def test_matvec_sorted_claim(self, csr_problem):
+        """from_csr_arrays row ids are sorted; the forward product with
+        the claim must equal the dense product."""
+        X, _, n, d = csr_problem
+        rng = np.random.default_rng(7)
+        w = rng.standard_normal(d).astype(np.float32)
+        got = np.asarray(X.matvec(jnp.asarray(w)))
+        ref = dense_of(X) @ w.astype(np.float64)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-4)
+
+    def test_losses_pick_up_csc(self, csr_problem):
+        """The Gradient kernels call rmatvec through the same object, so
+        loss/grad sums must agree between layouts for every GLM loss."""
+        X, y, n, d = csr_problem
+        no_csc = sparse.CSRMatrix(X.row_ids, X.col_ids, X.values, X.shape,
+                                  rows_sorted=True)
+        rng = np.random.default_rng(8)
+        w = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
+        for g in (LogisticGradient(), LeastSquaresGradient(),
+                  HingeGradient()):
+            l1, g1, n1 = g.batch_loss_and_grad(jnp.asarray(w), X, y)
+            l2, g2, n2 = g.batch_loss_and_grad(jnp.asarray(w), no_csc, y)
+            np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       rtol=2e-5, atol=1e-5)
+            assert int(n1) == int(n2) == n
+
+
+class TestInterceptPreservesCsc:
+    def test_add_intercept(self, csr_problem):
+        X, _, n, d = csr_problem
+        Xi = glm._add_intercept(X)
+        assert Xi.has_csc
+        cid = np.asarray(Xi.csc_col_ids)
+        assert np.all(np.diff(cid) >= 0), (
+            "intercept prepend must keep csc column order")
+        rng = np.random.default_rng(9)
+        v = rng.standard_normal(n).astype(np.float32)
+        ref = np.concatenate([[v.sum()], dense_of(X).T @ v])
+        np.testing.assert_allclose(np.asarray(Xi.rmatvec(jnp.asarray(v))),
+                                   ref, rtol=2e-4, atol=1e-4)
+
+
+class TestShardedCsc:
+    @pytest.mark.parametrize("k", [2, 8])
+    @pytest.mark.parametrize("balance", [True, False])
+    def test_shard_layout_sorted(self, csr_problem, cpu_devices, k,
+                                 balance):
+        """Per-shard row ids and csc col ids must be nondecreasing — the
+        precondition for the sorted segment-sums inside shard_map."""
+        X, y, n, d = csr_problem
+        mesh = mesh_lib.make_mesh({mesh_lib.DATA_AXIS: k},
+                                  devices=jax.devices()[:k])
+        batch = mesh_lib.shard_csr_batch(mesh, X, y)
+        Xs = batch.X
+        assert Xs.has_csc and Xs.rows_sorted
+        nnz_s = Xs.nnz_per_shard
+        R = np.asarray(Xs.row_ids).reshape(k, nnz_s)
+        Cc = np.asarray(Xs.csc_col_ids).reshape(k, nnz_s)
+        for s in range(k):
+            assert np.all(np.diff(R[s]) >= 0), f"shard {s} rows unsorted"
+            assert np.all(np.diff(Cc[s]) >= 0), f"shard {s} csc unsorted"
+
+    @pytest.mark.parametrize("k", [1, 2, 8])
+    def test_mesh_agd_parity(self, csr_problem, cpu_devices, rel_assert,
+                             k):
+        """Full fused AGD on the mesh: the csc layout must reproduce the
+        single-device (no-csc) trajectory."""
+        X, y, n, d = csr_problem
+        w0 = np.zeros(d, np.float32)
+        no_csc = sparse.CSRMatrix(X.row_ids, X.col_ids, X.values, X.shape,
+                                  rows_sorted=True)
+        w_ref, hist_ref = api.run(
+            (no_csc, y), LogisticGradient(), L2Prox(),
+            num_iterations=6, reg_param=0.05, initial_weights=w0)
+        mesh = mesh_lib.make_mesh({mesh_lib.DATA_AXIS: k},
+                                  devices=jax.devices()[:k])
+        w_mesh, hist_mesh = api.run(
+            (X, y), LogisticGradient(), L2Prox(),
+            num_iterations=6, reg_param=0.05, initial_weights=w0,
+            mesh=mesh)
+        assert len(hist_ref) == len(hist_mesh)
+        for a, b in zip(hist_ref, hist_mesh):
+            rel_assert(a, b, 1e-5, "csc mesh trajectory")
+        np.testing.assert_allclose(np.asarray(w_mesh), np.asarray(w_ref),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_softmax_rmatmat_mesh(self, csr_problem, cpu_devices):
+        """The (D, K) gradient path through the sharded csc layout."""
+        X, _, n, d = csr_problem
+        rng = np.random.default_rng(11)
+        k_cls = 5
+        y_cls = rng.integers(0, k_cls, n).astype(np.int32)
+        W0 = np.zeros((d, k_cls), np.float32)
+        g = SoftmaxGradient(k_cls)
+        l_ref, g_ref, n_ref = g.batch_loss_and_grad(jnp.asarray(W0), X,
+                                                    y_cls)
+        mesh = mesh_lib.make_mesh({mesh_lib.DATA_AXIS: 4},
+                                  devices=jax.devices()[:4])
+        batch = mesh_lib.shard_csr_batch(mesh, X, y_cls)
+        from spark_agd_tpu.parallel import dist_smooth
+
+        sm, _ = dist_smooth.make_dist_smooth(g, batch, mesh=mesh)
+        loss, grad = sm(jnp.asarray(W0))
+        np.testing.assert_allclose(float(loss), float(l_ref) / n,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grad),
+                                   np.asarray(g_ref) / n,
+                                   rtol=2e-5, atol=1e-6)
