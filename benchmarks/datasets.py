@@ -37,7 +37,7 @@ def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
     # rows are sorted by construction; carry the column-sorted twin so the
     # gradient path runs sorted segment-sums on TPU (ops.sparse docstring)
     X = CSRMatrix(row_ids, col_ids, values, (n_rows, n_features),
-                  rows_sorted=True).with_csc()
+                  rows_sorted=True).with_csc(lazy=True)
     return X, y
 
 

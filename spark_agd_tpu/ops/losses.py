@@ -95,8 +95,14 @@ class Gradient:
         """One-time data staging hook, called by the smooth factories at
         data-placement time (OUTSIDE the optimizer loop).  Implementations
         may return transformed operands (e.g. the Pallas kernel's
-        tile-padded layout) that their ``batch_loss_and_grad`` recognizes;
-        the default is the identity."""
+        tile-padded layout) that their ``batch_loss_and_grad`` recognizes.
+        The default materializes a lazily-requested CSC twin
+        (``CSRMatrix.with_csc(lazy=True)``) — the single-device half of
+        that contract; ``mesh.shard_csr_batch`` handles the mesh half."""
+        from .sparse import CSRMatrix
+
+        if isinstance(X, CSRMatrix) and X.want_csc and not X.has_csc:
+            X = X.with_csc()
         return X, y, mask
 
     # ------------------------------------------------------------------

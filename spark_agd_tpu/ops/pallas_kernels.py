@@ -291,8 +291,11 @@ class PallasMarginGradient(MarginGradient):
         """Eager one-time padding for the smooth factory.  Returns the
         ``(X, y, mask)`` triple contract with ``X`` a PaddedDense and the
         labels/mask folded in (``None``)."""
-        if isinstance(X, (CSRMatrix, PaddedDense)) \
-                or isinstance(X, jax.core.Tracer):
+        if isinstance(X, CSRMatrix):
+            # sparse falls back to the wrapped jnp kernel — run the base
+            # staging (materializes a lazily-requested CSC twin)
+            return super().prepare(X, y, mask)
+        if isinstance(X, PaddedDense) or isinstance(X, jax.core.Tracer):
             return X, y, mask
         X = jnp.asarray(X)
         itemsize = 2 if X.dtype == jnp.bfloat16 else 4

@@ -52,7 +52,7 @@ class CSRMatrix:
 
     def __init__(self, row_ids, col_ids, values, shape: Tuple[int, int],
                  *, csc_row_ids=None, csc_col_ids=None, csc_values=None,
-                 rows_sorted: bool = False):
+                 rows_sorted: bool = False, want_csc: bool = False):
         self.row_ids = row_ids
         self.col_ids = col_ids
         self.values = values
@@ -61,20 +61,21 @@ class CSRMatrix:
         self.csc_col_ids = csc_col_ids
         self.csc_values = csc_values
         self.rows_sorted = bool(rows_sorted)
+        self.want_csc = bool(want_csc)
 
     # -- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
         return ((self.row_ids, self.col_ids, self.values,
                  self.csc_row_ids, self.csc_col_ids, self.csc_values),
-                (self.shape, self.rows_sorted))
+                (self.shape, self.rows_sorted, self.want_csc))
 
     @classmethod
     def tree_unflatten(cls, aux, leaves):
-        shape, rows_sorted = aux
+        shape, rows_sorted, want_csc = aux
         rid, cid, val, crid, ccid, cval = leaves
         return cls(rid, cid, val, shape, csc_row_ids=crid,
                    csc_col_ids=ccid, csc_values=cval,
-                   rows_sorted=rows_sorted)
+                   rows_sorted=rows_sorted, want_csc=want_csc)
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -96,18 +97,26 @@ class CSRMatrix:
                    jnp.asarray(values), (n_rows, int(n_features)),
                    rows_sorted=True, **csc)
 
-    def with_csc(self) -> "CSRMatrix":
+    def with_csc(self, lazy: bool = False) -> "CSRMatrix":
         """Return a copy carrying the column-sorted twin of the entries.
 
-        Call at data-placement time; the sort happens once on the host,
-        never inside a compiled program.  The twin matches the residency
-        of the source arrays: host-numpy entries get a host-numpy twin
-        (so a later ``shard_csr_batch``, which re-sorts per shard from
-        the host copies anyway, never pays a wasted device transfer),
-        device entries get a device twin.
+        ``lazy=True`` only MARKS the matrix as wanting the twin
+        (``want_csc``); materialization is deferred to data placement —
+        ``Gradient.prepare`` builds it for single-device runs, while
+        ``mesh.shard_csr_batch`` reads the flag and builds per-shard
+        twins directly, never paying for a global one it would discard.
+
+        Eager builds sort once on the host, never inside a compiled
+        program, and match the residency of the source arrays:
+        host-numpy entries get a host-numpy twin, device entries a
+        device twin.
         """
-        if self.has_csc:
+        if self.has_csc or (lazy and self.want_csc):
             return self
+        if lazy:
+            return CSRMatrix(self.row_ids, self.col_ids, self.values,
+                             self.shape, rows_sorted=self.rows_sorted,
+                             want_csc=True)
         on_device = isinstance(self.values, jax.Array)
         put = jnp.asarray if on_device else (lambda a: a)
         cid = np.asarray(self.col_ids)

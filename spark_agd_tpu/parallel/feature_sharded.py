@@ -14,8 +14,8 @@ Layout (classic model-parallel GLM):
 
 - host pre-shards the CSR matrix by column range; each shard's entries are
   re-indexed to local columns and padded to a common nnz so the stacked
-  arrays are rectangular (padding value 0 at local col 0 is inert in both
-  products);
+  arrays are rectangular (padding value 0.0 at the last row/col slot is
+  inert in both products and keeps ids nondecreasing);
 - inside ``shard_map``: ``dots_partial = segment_sum(values * w_local[
   col_local], row_ids)`` — each chip's contribution to every row's margin;
   one ``psum`` over ``model`` assembles full margins everywhere (THE only
@@ -23,10 +23,12 @@ Layout (classic model-parallel GLM):
 - the per-row loss/multiplier middle (``MarginGradient.dots_loss_and_mult``
   — the same code the row-sharded kernels run, so layouts cannot drift) is
   computed replicated;
-- ``grad_local = scatter-add(values * mult[row_ids])`` lands already
-  sharded — the gradient, prox step, and all AT recurrences stay D-sharded
-  with zero further communication; elementwise optimizer math partitions
-  over the mesh for free under GSPMD.
+- ``grad_local`` lands already sharded: a SORTED column segment-sum over
+  each shard's column-sorted entry twin (the ops.sparse CSC rationale;
+  scatter-add only when the twin is disabled) — the gradient, prox step,
+  and all AT recurrences stay D-sharded with zero further communication;
+  elementwise optimizer math partitions over the mesh for free under
+  GSPMD.
 
 Cost shape per evaluation: one psum of (N,) — vs the reference's full-D
 broadcast + full-D tree-reduce.  For N ≪ D (url_combined: 2.4M rows vs
@@ -58,7 +60,14 @@ class FeatureShardedBatch(NamedTuple):
     padded position ``shard * d_local + local`` — columns are assigned to
     shards by greedy nnz balancing, NOT contiguous ranges, so a power-law
     column distribution (url_combined's regime) cannot pile most entries
-    onto one shard."""
+    onto one shard.
+
+    Per-shard entries are sorted by row id (padding points at the last
+    row), and ``csc_*`` — when built, the default — is each shard's
+    entry copy sorted by LOCAL COLUMN, so both the margin segment-sum
+    and the gradient's column reduction run with
+    ``indices_are_sorted=True`` instead of a scatter-add (the
+    ops.sparse CSC-twin rationale, applied to the D-sharded layout)."""
 
     row_ids: jax.Array
     col_local: jax.Array
@@ -69,15 +78,25 @@ class FeatureShardedBatch(NamedTuple):
     n_rows: int
     n_features: int
     d_local: int  # columns per shard (D padded to n_shards * d_local)
+    csc_row_ids: Optional[jax.Array] = None
+    csc_col_local: Optional[jax.Array] = None
+    csc_values: Optional[jax.Array] = None
+
+    @property
+    def has_csc(self) -> bool:
+        return self.csc_values is not None
 
 
 def shard_csr_by_columns(
     indptr, indices, values, n_features: int, y,
     mesh: Mesh, mask=None, axis: str = mesh_lib.MODEL_AXIS,
+    with_csc: bool = True,
 ) -> FeatureShardedBatch:
     """Host-side layout: assign columns to shards in nnz-balanced
     serpentine order, re-index entries to (shard, local), pad shards to a
-    common nnz, place on the mesh."""
+    common nnz, place on the mesh.  ``with_csc=False`` drops the
+    column-sorted gradient twin (halves entry memory, reverts the
+    gradient to scatter-add)."""
     indptr = np.asarray(indptr)
     indices = np.asarray(indices)
     values = np.asarray(values, np.float32)
@@ -131,18 +150,36 @@ def shard_csr_by_columns(
     per_shard = ends - starts
     nnz_shard = max(int(per_shard.max()) if len(values) else 1, 1)
 
-    R = np.zeros((n_shards, nnz_shard), np.int32)
+    # Padding points at the last row / last local column (inert 0.0
+    # values) so per-shard ids stay nondecreasing for the sorted
+    # segment-sums.  Entries within a shard keep original order = sorted
+    # by row (stable shard sort of row-sorted input).
+    R = np.full((n_shards, nnz_shard), max(n_rows - 1, 0), np.int32)
     C = np.zeros((n_shards, nnz_shard), np.int32)
     V = np.zeros((n_shards, nnz_shard), np.float32)
+    if with_csc:
+        Rc = np.zeros((n_shards, nnz_shard), np.int32)
+        Cc = np.full((n_shards, nnz_shard), d_local - 1, np.int32)
+        Vc = np.zeros((n_shards, nnz_shard), np.float32)
     for s in range(n_shards):
         sel = eorder[starts[s]:ends[s]]
         k = len(sel)
         R[s, :k] = row_ids[sel]
         C[s, :k] = e_local[sel]
         V[s, :k] = values[sel]
+        if with_csc:  # column-sorted twin of the same entries
+            sel_c = sel[np.argsort(e_local[sel], kind="stable")]
+            Rc[s, :k] = row_ids[sel_c]
+            Cc[s, :k] = e_local[sel_c]
+            Vc[s, :k] = values[sel_c]
 
     spec = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
+    csc = {}
+    if with_csc:
+        csc = dict(csc_row_ids=jax.device_put(Rc.reshape(-1), spec),
+                   csc_col_local=jax.device_put(Cc.reshape(-1), spec),
+                   csc_values=jax.device_put(Vc.reshape(-1), spec))
     return FeatureShardedBatch(
         row_ids=jax.device_put(R.reshape(-1), spec),
         col_local=jax.device_put(C.reshape(-1), spec),
@@ -151,7 +188,8 @@ def shard_csr_by_columns(
         mask=(None if mask is None
               else jax.device_put(np.asarray(mask, np.float32), rep)),
         positions=positions,
-        n_rows=n_rows, n_features=int(n_features), d_local=int(d_local))
+        n_rows=n_rows, n_features=int(n_features), d_local=int(d_local),
+        **csc)
 
 
 def shard_weights(w, batch: FeatureShardedBatch, mesh: Mesh,
@@ -199,15 +237,22 @@ def make_feature_sharded_smooth(
 
     sharded = P(axis)
     rep = P()
-    in_specs = (sharded, sharded, sharded, sharded, rep) \
+    n_csc = 3 if batch.has_csc else 0
+    in_specs = (sharded,) * (4 + n_csc) + (rep,) \
         + ((rep,) if has_mask else ())
 
     @jax.jit
-    def _eval(w, row_ids, col_local, values, y, *ms):
-        def body(w_l, r, c, v, y_r, *ms_l):
+    def _eval(w, row_ids, col_local, values, *rest):
+        def body(w_l, r, c, v, *rest_l):
+            csc_l, tail = rest_l[:n_csc], rest_l[n_csc:]
+            y_r, ms_l = tail[0], tail[1:]
             # this chip's column slice as a local CSR — the ONE sparse
-            # kernel implementation (ops.sparse) serves here too
-            Xl = CSRMatrix(r, c, v, (n_rows, d_local))
+            # kernel implementation (ops.sparse) serves here too; entries
+            # are row-sorted and the csc twin column-sorted by layout
+            csc_kw = (dict(csc_row_ids=csc_l[0], csc_col_ids=csc_l[1],
+                           csc_values=csc_l[2]) if csc_l else {})
+            Xl = CSRMatrix(r, c, v, (n_rows, d_local), rows_sorted=True,
+                           **csc_kw)
             dots_partial = Xl.matvec(w_l)
             # THE collective: assemble full margins on every chip
             dots = lax.psum(dots_partial, axis)
@@ -217,7 +262,8 @@ def make_feature_sharded_smooth(
                 per = per * ms_l[0]
                 mult = mult * ms_l[0]
             loss_sum = jnp.sum(per)  # identical on every chip post-psum
-            # gradient lands already sharded: scatter into local columns
+            # gradient lands already sharded: a sorted column reduction
+            # (csc twin) or scatter into local columns (without it)
             return loss_sum, Xl.rmatvec(mult)
 
         return shard_map(
@@ -225,10 +271,12 @@ def make_feature_sharded_smooth(
             in_specs=in_specs,
             out_specs=(rep, sharded),
             check_vma=False,
-        )(w, row_ids, col_local, values, y, *ms)
+        )(w, row_ids, col_local, values, *rest)
 
-    args = (batch.row_ids, batch.col_local, batch.values, batch.y) \
-        + ((batch.mask,) if has_mask else ())
+    args = (batch.row_ids, batch.col_local, batch.values) \
+        + ((batch.csc_row_ids, batch.csc_col_local, batch.csc_values)
+           if batch.has_csc else ()) \
+        + (batch.y,) + ((batch.mask,) if has_mask else ())
 
     def smooth(w):
         ls, gs = _eval(w, *args)

@@ -187,7 +187,7 @@ def shard_csr_batch(
     ends = np.searchsorted(shard_sorted, np.arange(n_shards), side="right")
     nnz_shard = max(int((ends - starts).max()) if len(values) else 1, 1)
 
-    with_csc = X.has_csc
+    with_csc = X.has_csc or X.want_csc
     # Padding slots point at the LAST local row / col (inert 0.0 values)
     # so per-shard ids stay nondecreasing and both segment-sums can claim
     # ``indices_are_sorted`` (see ops.sparse module docstring).

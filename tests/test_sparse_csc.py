@@ -76,6 +76,22 @@ class TestCscProducts:
         X, _, _, _ = csr_problem
         assert X.with_csc() is X
 
+    def test_lazy_marker(self, csr_problem, cpu_devices):
+        """with_csc(lazy=True) defers the build: prepare() materializes
+        it for single-device runs; shard_csr_batch reads the flag and
+        builds per-shard twins without a global one ever existing."""
+        X, y, n, d = csr_problem
+        lazy = sparse.CSRMatrix(X.row_ids, X.col_ids, X.values, X.shape,
+                                rows_sorted=True).with_csc(lazy=True)
+        assert lazy.want_csc and not lazy.has_csc
+        assert lazy.with_csc(lazy=True) is lazy
+        Xp, _, _ = LogisticGradient().prepare(lazy, y)
+        assert Xp.has_csc
+        mesh = mesh_lib.make_mesh({mesh_lib.DATA_AXIS: 4},
+                                  devices=jax.devices()[:4])
+        batch = mesh_lib.shard_csr_batch(mesh, lazy, y)
+        assert batch.X.has_csc
+
     def test_rmatvec_matches_scatter_and_dense(self, csr_problem):
         X, _, n, d = csr_problem
         rng = np.random.default_rng(5)
@@ -182,6 +198,45 @@ class TestShardedCsc:
             rel_assert(a, b, 1e-5, "csc mesh trajectory")
         np.testing.assert_allclose(np.asarray(w_mesh), np.asarray(w_ref),
                                    rtol=1e-4, atol=1e-6)
+
+    def test_feature_sharded_csc(self, csr_problem, cpu_devices,
+                                 rel_assert):
+        """D-axis layout: the column-sorted twin must reproduce the
+        scatter layout's smooth evaluation, and per-shard ids must
+        actually be sorted."""
+        from spark_agd_tpu.parallel import feature_sharded as fs
+
+        X, y, n, d = csr_problem
+        rid = np.asarray(X.row_ids)
+        indptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(rid, minlength=n))])
+        mesh = mesh_lib.make_mesh({mesh_lib.MODEL_AXIS: 4},
+                                  devices=jax.devices()[:4])
+        k_shards = 4
+        b_csc = fs.shard_csr_by_columns(
+            indptr, np.asarray(X.col_ids), np.asarray(X.values), d, y,
+            mesh)
+        b_sct = fs.shard_csr_by_columns(
+            indptr, np.asarray(X.col_ids), np.asarray(X.values), d, y,
+            mesh, with_csc=False)
+        assert b_csc.has_csc and not b_sct.has_csc
+        nnz_s = len(np.asarray(b_csc.values)) // k_shards
+        R = np.asarray(b_csc.row_ids).reshape(k_shards, nnz_s)
+        Cc = np.asarray(b_csc.csc_col_local).reshape(k_shards, nnz_s)
+        for s in range(k_shards):
+            assert np.all(np.diff(R[s]) >= 0)
+            assert np.all(np.diff(Cc[s]) >= 0)
+        rng = np.random.default_rng(13)
+        w = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
+        g = LogisticGradient()
+        sm1, _ = fs.make_feature_sharded_smooth(g, b_csc, mesh=mesh)
+        sm2, _ = fs.make_feature_sharded_smooth(g, b_sct, mesh=mesh)
+        l1, g1 = sm1(fs.shard_weights(w, b_csc, mesh))
+        l2, g2 = sm2(fs.shard_weights(w, b_sct, mesh))
+        rel_assert(l1, l2, 1e-6, "feature-sharded csc loss")
+        np.testing.assert_allclose(
+            fs.unshard_weights(g1, b_csc), fs.unshard_weights(g2, b_sct),
+            rtol=2e-5, atol=1e-6)
 
     def test_softmax_rmatmat_mesh(self, csr_problem, cpu_devices):
         """The (D, K) gradient path through the sharded csc layout."""

@@ -85,20 +85,20 @@ def main():
             "compile_s": round(compile_s, 1)}), flush=True)
 
     # XLA vs Pallas smooth-evaluation timing at the wide shape
-    def timed(fn, reps):
-        r = fn(wd)
+    def timed(fn, x, reps):
+        r = fn(x)
         jax.block_until_ready(r)
         t0 = time.perf_counter()
         for _ in range(reps):
-            r = fn(wd)
+            r = fn(x)
         jax.block_until_ready(r)
         return (time.perf_counter() - t0) / reps
 
     g = LogisticGradient()
     xla_s = timed(jax.jit(lambda wv: g.batch_loss_and_grad(wv, Xd, yd)),
-                  args.reps)
+                  wd, args.reps)
     pal_s = timed(jax.jit(lambda wv: fused_margin_loss_grad(g, wv, padded)),
-                  args.reps)
+                  wd, args.reps)
     print(json.dumps({
         "check": "pallas_vs_xla_smooth_eval",
         "d": d, "rows": n,
@@ -134,8 +134,8 @@ def main():
     jax.block_until_ready((gr1, gr2))
     rel_g = float(jnp.linalg.norm(gr1 - gr2)
                   / (jnp.linalg.norm(gr2) + 1e-30))
-    csc_s = timed(lambda wv: sm_csc(wv)[1], args.reps)
-    sct_s = timed(lambda wv: sm_sct(wv)[1], args.reps)
+    csc_s = timed(lambda wv: sm_csc(wv)[1], wd_sp, args.reps)
+    sct_s = timed(lambda wv: sm_sct(wv)[1], wd_sp, args.reps)
     sp_ok = rel_g < 1e-3
     failures += not sp_ok
     print(json.dumps({
