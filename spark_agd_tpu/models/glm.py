@@ -67,7 +67,8 @@ def _add_intercept(X):
                     [jnp.ones(n, X.csc_values.dtype), X.csc_values]))
         # the interleave puts all intercept entries first: row ids are no
         # longer nondecreasing, so the forward copy drops its sorted claim
-        return CSRMatrix(row_ids, col_ids, values, (n, d + 1), **csc)
+        return CSRMatrix(row_ids, col_ids, values, (n, d + 1),
+                         want_csc=X.want_csc, **csc)
     X = jnp.asarray(X)
     return jnp.concatenate(
         [jnp.ones((X.shape[0], 1), X.dtype), X], axis=1)

@@ -33,7 +33,7 @@ layouts put padding at the LAST row/col slot to keep ids nondecreasing.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

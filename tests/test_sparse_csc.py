@@ -155,6 +155,16 @@ class TestInterceptPreservesCsc:
                                    ref, rtol=2e-4, atol=1e-4)
 
 
+    def test_add_intercept_keeps_lazy_marker(self, csr_problem):
+        """The default train(add_intercept=True) path must not silently
+        drop a lazily-requested twin."""
+        X, _, _, _ = csr_problem
+        lazy = sparse.CSRMatrix(X.row_ids, X.col_ids, X.values, X.shape,
+                                rows_sorted=True).with_csc(lazy=True)
+        Xi = glm._add_intercept(lazy)
+        assert Xi.want_csc and not Xi.has_csc
+
+
 class TestShardedCsc:
     @pytest.mark.parametrize("k", [2, 8])
     @pytest.mark.parametrize("balance", [True, False])

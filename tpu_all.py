@@ -1,0 +1,137 @@
+"""One-claim TPU session: every on-chip artifact in a single process.
+
+The tunneled chip hands out one claim per process, and claims can queue
+for many minutes when the pool is contended (observed: instant to >30
+min).  Running bench.py, tpu_checks.py, and the five-config harness as
+separate processes pays that queue up to three times — this driver pays
+it ONCE and produces every artifact sequentially:
+
+    timeout 3600 python tpu_all.py            # everything
+    timeout 3600 python tpu_all.py --skip-configs --tag smoke
+
+Artifacts (JSON lines, one file each, committed for the judge):
+- ``BENCH_MANUAL_{tag}.json``    — bench.py's headline record (in-process)
+- ``TPU_CHECKS_{tag}.json``      — pallas parity/timing, sparse csc-vs-
+  scatter, streaming overlap
+- ``BENCH_CONFIGS_{tag}.json``   — the five BASELINE configs at one-chip
+  HBM scale
+
+Exit code 0 only if every stage produced its artifact with no failures.
+Diagnostics on stderr; per-stage status lines on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def stage(name):
+    print(json.dumps({"stage": name, "t": round(time.time(), 1)}),
+          flush=True)
+
+
+@contextlib.contextmanager
+def stdout_to(path):
+    """Redirect stage stdout (their JSON lines) into the artifact file
+    while keeping this driver's own stdout for status."""
+    old = sys.stdout
+    with open(path, "w") as f:
+        sys.stdout = f
+        try:
+            yield
+        finally:
+            sys.stdout = old
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tag", default="r02")
+    p.add_argument("--skip-bench", action="store_true")
+    p.add_argument("--skip-checks", action="store_true")
+    p.add_argument("--skip-configs", action="store_true")
+    p.add_argument("--config-iters", type=int, default=20)
+    p.add_argument("--gd-cap", type=int, default=0,
+                   help="GD-oracle iteration cap for the AGD-vs-GD ratio "
+                        "(0 = skip the oracle pass)")
+    p.add_argument("--configs", default="1,2,3,4,5")
+    args = p.parse_args(argv)
+
+    t0 = time.perf_counter()
+    import jax
+
+    devs = jax.devices()  # THE claim; may queue behind the pool
+    d = devs[0]
+    log(f"claim acquired in {time.perf_counter() - t0:.1f}s: "
+        f"{d.platform}/{d.device_kind}")
+    if d.platform != "tpu" and not os.environ.get("TPU_ALL_ALLOW_CPU"):
+        print(json.dumps({"error": f"not a TPU: {d.platform}"}))
+        return 1
+
+    failures = 0
+
+    if not args.skip_bench:
+        stage("bench")
+        import bench
+
+        try:
+            out = bench.run_bench()
+        except Exception as e:  # noqa: BLE001 — later stages still run
+            log(f"bench failed: {type(e).__name__}: {e}")
+            out = bench._error_json(f"{type(e).__name__}: {e}")
+            failures += 1
+        with open(f"BENCH_MANUAL_{args.tag}.json", "w") as f:
+            f.write(json.dumps(out) + "\n")
+        stage("bench done")
+
+    if not args.skip_checks:
+        stage("checks")
+        import tpu_checks
+
+        try:
+            with stdout_to(f"TPU_CHECKS_{args.tag}.json"):
+                n_fail = tpu_checks.main([])
+            failures += n_fail
+        except Exception as e:  # noqa: BLE001
+            log(f"tpu_checks failed: {type(e).__name__}: {e}")
+            failures += 1
+        stage("checks done")
+
+    if not args.skip_configs:
+        stage("configs")
+        from benchmarks import run as bench_configs
+
+        argv_c = ["--iters", str(args.config_iters),
+                  "--out", f"BENCH_CONFIGS_{args.tag}.json"]
+        if args.gd_cap:
+            argv_c += ["--gd-cap", str(args.gd_cap)]
+        for c in args.configs.split(","):
+            try:
+                with stdout_to(os.devnull):
+                    # run.main sys.exits per invocation; the artifact file
+                    # accumulates via --out
+                    bench_configs.main(["--config", c] + argv_c)
+            except SystemExit as e:
+                failures += int(bool(e.code))
+            except Exception as e:  # noqa: BLE001
+                log(f"config {c} failed: {type(e).__name__}: {e}")
+                failures += 1
+        stage("configs done")
+
+    print(json.dumps({"stage": "all done", "failures": failures,
+                      "wall_s": round(time.perf_counter() - t0, 1)}),
+          flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,13 +26,13 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main():
+def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--wide-d", type=int, default=47104,
                    help="feature width for the wide checks (rcv1 ~47k)")
     p.add_argument("--rows", type=int, default=1 << 16)
     p.add_argument("--reps", type=int, default=20)
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp
@@ -193,8 +193,8 @@ def main():
         "speedup": round(serial_s / piped_s, 3),
         "ok": True}), flush=True)
 
-    sys.exit(1 if failures else 0)
+    return failures
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(1 if main() else 0)
