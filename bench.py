@@ -317,6 +317,19 @@ def run_bench():
     w0 = jnp.zeros(X.shape[1], jnp.float32)
     xla, xla_hist, compile_s = bench_tpu(Xd, yd, w0, device)
     pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
+    # The other dtype's XLA number rides along (bf16 halves the dominant
+    # HBM traffic — the TPU-native layout; f32 is the parity-clean one).
+    # Opt-in (BENCH_ALT_DTYPE=1, set by tpu_all.py's in-process session):
+    # a third compile+run must not eat the standalone worker's timeout
+    # budget on a contended chip.
+    alt = None
+    if device.platform == "tpu" and \
+            os.environ.get("BENCH_ALT_DTYPE") == "1":
+        alt_dt = jnp.float32 if BENCH_DTYPE == "bf16" else jnp.bfloat16
+        try:
+            alt, _, _ = bench_tpu(Xd32.astype(alt_dt), yd, w0, device)
+        except Exception as e:  # noqa: BLE001 — comparison point only
+            log(f"alt-dtype run failed: {type(e).__name__}: {e}")
     cpu_ips, cpu_res = bench_cpu(X, y)
     check_parity(Xd32, yd, w0, cpu_res.loss_history)
 
@@ -355,6 +368,12 @@ def run_bench():
     else:
         out["pallas_iters_per_sec"] = None
         out["pallas_note"] = pallas_note
+    if alt is not None:
+        alt_name = "f32" if BENCH_DTYPE == "bf16" else "bf16"
+        out[f"{alt_name}_iters_per_sec"] = round(alt["iters_per_sec"], 2)
+        out[f"{alt_name}_hbm_bw_frac"] = (
+            None if alt["hbm_bw_frac"] is None
+            else round(alt["hbm_bw_frac"], 3))
     if device.platform != "tpu":
         out["error"] = "degraded: not running on a TPU backend"
     return out

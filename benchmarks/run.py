@@ -122,17 +122,45 @@ def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
     return cap, False
 
 
+def _cast_features(X, dtype: str):
+    """Features to bf16 (values only — ids/labels/masks stay as-is): the
+    TPU-native dtype, halving the dominant HBM traffic.  Weights and all
+    accumulation stay f32 through the kernels' promotion rules."""
+    if dtype != "bf16":
+        return X
+    import ml_dtypes
+    from spark_agd_tpu.ops.sparse import CSRMatrix
+
+    bf16 = ml_dtypes.bfloat16
+    if isinstance(X, CSRMatrix):
+        csc = {}
+        if X.has_csc:
+            csc = dict(csc_row_ids=X.csc_row_ids,
+                       csc_col_ids=X.csc_col_ids,
+                       csc_values=np.asarray(X.csc_values).astype(bf16))
+        return CSRMatrix(X.row_ids, X.col_ids,
+                         np.asarray(X.values).astype(bf16), X.shape,
+                         rows_sorted=X.rows_sorted, want_csc=X.want_csc,
+                         **csc)
+    return np.asarray(X).astype(bf16)
+
+
 def run_config(config: BenchConfig, scale: float, iters: int,
                gd_cap: int = 0, eps: float = 1e-3,
-               use_pallas: bool = False) -> dict:
+               use_pallas: bool = False, dtype: str = "f32",
+               data=None) -> dict:
+    """One measured record.  ``data`` (optional pre-generated ``(X, y)``)
+    lets a caller measuring several dtypes of the same config pay
+    ``make_data`` once; features are cast per call."""
     import jax
 
     t0 = time.perf_counter()
-    X, y = config.make_data(scale)
+    X, y = data if data is not None else config.make_data(scale)
+    X = _cast_features(X, dtype)
     gen_s = time.perf_counter() - t0
     n = X.shape[0]
-    log(f"[{config.name}] scale={scale} data {X.shape} "
-        f"generated in {gen_s:.1f}s")
+    log(f"[{config.name}] scale={scale} dtype={dtype} data {X.shape} "
+        f"prepared in {gen_s:.1f}s")
 
     w0 = config.make_w0(X)
     data = (X, y)
@@ -178,6 +206,7 @@ def run_config(config: BenchConfig, scale: float, iters: int,
         "name": config.name,
         "rows": int(n),
         "scale": scale,
+        "dtype": dtype,
         "pallas": bool(use_pallas and config.pallas_ok),
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
@@ -206,6 +235,12 @@ def main(argv=None):
     p.add_argument("--gd-cap", type=int, default=0,
                    help="if >0, run the GD oracle up to this many "
                         "iterations for the iteration-efficiency ratio")
+    p.add_argument("--dtype", default="f32",
+                   help="feature dtype(s), comma-separated from "
+                        "{f32, bf16}; the dataset is generated once per "
+                        "config and cast per dtype.  bf16 is the "
+                        "TPU-native layout (weights/accumulation stay "
+                        "f32)")
     p.add_argument("--pallas", action="store_true",
                    help="use the fused Pallas kernel on eligible dense "
                         "margin configs")
@@ -221,26 +256,37 @@ def main(argv=None):
                 if args.config in (0, c.idx)]
     if not selected:
         p.error(f"unknown config {args.config}")
+    dtypes = args.dtype.split(",")
+    bad = [d for d in dtypes if d not in ("f32", "bf16")]
+    if bad:
+        p.error(f"unknown dtype(s) {bad}; choose from f32, bf16")
     out_f = open(args.out, "a") if args.out else None
     failures = 0
     for cfg in selected:
         scale = args.scale if args.scale is not None else (
             cfg.tpu_scale if on_tpu else 0.002)
-        try:
-            rec = run_config(cfg, scale, args.iters, gd_cap=args.gd_cap,
-                             use_pallas=args.pallas)
-        except Exception as e:  # noqa: BLE001 — one config must not
-            # take down the others; the record carries the error
-            import traceback
+        data = None
+        for dt in dtypes:
+            try:
+                if data is None:
+                    data = cfg.make_data(scale)
+                rec = run_config(cfg, scale, args.iters,
+                                 gd_cap=args.gd_cap,
+                                 use_pallas=args.pallas, dtype=dt,
+                                 data=data)
+            except Exception as e:  # noqa: BLE001 — one config must not
+                # take down the others; the record carries the error
+                import traceback
 
-            traceback.print_exc(file=sys.stderr)
-            rec = {"config": cfg.idx, "name": cfg.name, "scale": scale,
-                   "error": f"{type(e).__name__}: {e}"[:500]}
-            failures += 1
-        print(json.dumps(rec), flush=True)
-        if out_f:
-            out_f.write(json.dumps(rec) + "\n")
-            out_f.flush()
+                traceback.print_exc(file=sys.stderr)
+                rec = {"config": cfg.idx, "name": cfg.name,
+                       "scale": scale, "dtype": dt,
+                       "error": f"{type(e).__name__}: {e}"[:500]}
+                failures += 1
+            print(json.dumps(rec), flush=True)
+            if out_f:
+                out_f.write(json.dumps(rec) + "\n")
+                out_f.flush()
     if out_f:
         out_f.close()
     sys.exit(1 if failures else 0)

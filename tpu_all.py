@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import argparse
 import contextlib
-import io
 import json
 import os
 import sys
@@ -64,6 +63,8 @@ def main(argv=None):
                    help="GD-oracle iteration cap for the AGD-vs-GD ratio "
                         "(0 = skip the oracle pass)")
     p.add_argument("--configs", default="1,2,3,4,5")
+    p.add_argument("--config-dtypes", default="f32,bf16",
+                   help="feature dtypes to measure per config")
     args = p.parse_args(argv)
 
     t0 = time.perf_counter()
@@ -81,6 +82,8 @@ def main(argv=None):
 
     if not args.skip_bench:
         stage("bench")
+        os.environ.setdefault("BENCH_ALT_DTYPE", "1")  # in-process: no
+        # worker timeout to protect, so measure both dtypes
         import bench
 
         try:
@@ -110,15 +113,17 @@ def main(argv=None):
         stage("configs")
         from benchmarks import run as bench_configs
 
+        out_path = f"BENCH_CONFIGS_{args.tag}.json"
+        open(out_path, "w").close()  # truncate: --out appends per config
         argv_c = ["--iters", str(args.config_iters),
-                  "--out", f"BENCH_CONFIGS_{args.tag}.json"]
+                  "--dtype", args.config_dtypes, "--out", out_path]
         if args.gd_cap:
             argv_c += ["--gd-cap", str(args.gd_cap)]
         for c in args.configs.split(","):
             try:
                 with stdout_to(os.devnull):
-                    # run.main sys.exits per invocation; the artifact file
-                    # accumulates via --out
+                    # run.main sys.exits per invocation; the artifact
+                    # file accumulates via --out (truncated above)
                     bench_configs.main(["--config", c] + argv_c)
             except SystemExit as e:
                 failures += int(bool(e.code))

@@ -47,7 +47,7 @@ def main(argv=None):
     if dev.platform != "tpu":
         print(json.dumps({"check": "backend", "ok": False,
                           "error": f"not a TPU: {dev.platform}"}))
-        sys.exit(1)
+        return 1
 
     n, d = args.rows, args.wide_d
     br = choose_block_rows(((d + 127) // 128) * 128, 4)
