@@ -265,11 +265,25 @@ def main(argv=None):
     for cfg in selected:
         scale = args.scale if args.scale is not None else (
             cfg.tpu_scale if on_tpu else 0.002)
-        data = None
+        def emit(rec):
+            print(json.dumps(rec), flush=True)
+            if out_f:
+                out_f.write(json.dumps(rec) + "\n")
+                out_f.flush()
+
+        try:
+            data = cfg.make_data(scale)
+        except Exception as e:  # noqa: BLE001 — a dead dataset is ONE
+            # failure, not one per dtype; skip the config's dtype runs
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            emit({"config": cfg.idx, "name": cfg.name, "scale": scale,
+                  "error": f"make_data: {type(e).__name__}: {e}"[:500]})
+            failures += 1
+            continue
         for dt in dtypes:
             try:
-                if data is None:
-                    data = cfg.make_data(scale)
                 rec = run_config(cfg, scale, args.iters,
                                  gd_cap=args.gd_cap,
                                  use_pallas=args.pallas, dtype=dt,
@@ -283,10 +297,7 @@ def main(argv=None):
                        "scale": scale, "dtype": dt,
                        "error": f"{type(e).__name__}: {e}"[:500]}
                 failures += 1
-            print(json.dumps(rec), flush=True)
-            if out_f:
-                out_f.write(json.dumps(rec) + "\n")
-                out_f.flush()
+            emit(rec)
     if out_f:
         out_f.close()
     sys.exit(1 if failures else 0)

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core import tvec
 from ..ops.losses import Gradient
+from ..ops.sparse import CSRMatrix
 from ..parallel import mesh as mesh_lib
 
 
@@ -36,6 +37,62 @@ def iter_array_batches(X, y, batch_rows: int,
     for s in range(0, n, batch_rows):
         e = min(s + batch_rows, n)
         yield X[s:e], y[s:e], None if mask is None else mask[s:e]
+
+
+def iter_csr_batches(indptr, indices, values, n_features: int, y,
+                     batch_rows: int, mask=None,
+                     with_csc: bool = True) -> Iterator[Tuple]:
+    """Slice host CSR arrays into fixed-shape macro-batches.
+
+    XLA compiles ONE kernel per shape, so every batch is padded to the
+    same ``(batch_rows, nnz_pad)`` where ``nnz_pad`` is the largest
+    per-batch entry count (computed up front from ``indptr``).  Padding
+    follows the ops.sparse contract: inert 0.0 entries at the LAST
+    row/col slot (ids stay nondecreasing), padded row slots masked 0.
+    ``with_csc`` builds each batch's column-sorted twin on the host —
+    the per-batch argsort overlaps device compute inside
+    :func:`fold_stream`'s double buffering.
+    """
+    indptr = np.asarray(indptr)
+    indices = np.asarray(indices, np.int32)
+    values = np.asarray(values)
+    y = np.asarray(y)
+    n = len(indptr) - 1
+    starts = np.arange(0, n, batch_rows)
+    if not len(starts):  # empty input: yield nothing, like the dense twin
+        return
+    nnz_pad = max(1, int(np.max(
+        indptr[np.minimum(starts + batch_rows, n)] - indptr[starts])))
+    for s in starts.tolist():
+        e = min(s + batch_rows, n)
+        lo, hi = int(indptr[s]), int(indptr[e])
+        k = hi - lo
+        rid = np.full(nnz_pad, batch_rows - 1, np.int32)
+        cid = np.full(nnz_pad, n_features - 1, np.int32)
+        val = np.zeros(nnz_pad, values.dtype)
+        rid[:k] = np.repeat(np.arange(e - s, dtype=np.int32),
+                            np.diff(indptr[s:e + 1]))
+        cid[:k] = indices[lo:hi]
+        val[:k] = values[lo:hi]
+        csc = {}
+        if with_csc:
+            order = np.argsort(cid[:k], kind="stable")
+            crid = np.full(nnz_pad, batch_rows - 1, np.int32)
+            ccid = np.full(nnz_pad, n_features - 1, np.int32)
+            cval = np.zeros(nnz_pad, values.dtype)
+            crid[:k] = rid[:k][order]
+            ccid[:k] = cid[:k][order]
+            cval[:k] = val[:k][order]
+            csc = dict(csc_row_ids=crid, csc_col_ids=ccid,
+                       csc_values=cval)
+        Xb = CSRMatrix(rid, cid, val, (batch_rows, int(n_features)),
+                       rows_sorted=True, **csc)
+        yb = np.zeros(batch_rows, y.dtype)
+        yb[:e - s] = y[s:e]
+        mb = np.zeros(batch_rows, np.float32)
+        mb[:e - s] = (np.ones(e - s, np.float32) if mask is None
+                      else np.asarray(mask[s:e], np.float32))
+        yield Xb, yb, mb
 
 
 class StreamingDataset:
@@ -55,6 +112,16 @@ class StreamingDataset:
     def from_arrays(cls, X, y, batch_rows: int, mask=None):
         return cls(lambda: iter_array_batches(X, y, batch_rows, mask),
                    batch_rows)
+
+    @classmethod
+    def from_csr(cls, indptr, indices, values, n_features: int, y,
+                 batch_rows: int, mask=None, with_csc: bool = True):
+        """Macro-batches over host CSR arrays (``data.libsvm.CSRData``'s
+        fields) — the sparse twin of ``from_arrays``; see
+        :func:`iter_csr_batches` for the fixed-shape padding contract."""
+        return cls(lambda: iter_csr_batches(
+            indptr, indices, values, n_features, y, batch_rows, mask,
+            with_csc), batch_rows)
 
     def __iter__(self):
         return iter(self._factory())
@@ -88,6 +155,16 @@ def make_streaming_smooth(
         return ls, n
 
     def _place(X, y, mask):
+        if isinstance(X, CSRMatrix):
+            # iter_csr_batches already padded to fixed shape; just move
+            # the leaves (csc twin included) onto the device
+            if mesh is not None:
+                raise NotImplementedError(
+                    "mesh-sharded CSR streaming is not supported yet; "
+                    "stream single-device or pre-shard with "
+                    "parallel.mesh.shard_csr_batch")
+            return (jax.tree_util.tree_map(jnp.asarray, X),
+                    jnp.asarray(y), jnp.asarray(mask))
         X = np.asarray(X)
         y = np.asarray(y)
         n = X.shape[0]

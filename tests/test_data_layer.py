@@ -208,6 +208,83 @@ class TestStreaming:
         assert r_host.num_restarts == int(r_fused.num_restarts)
         assert r_host.num_backtracks == int(r_fused.num_backtracks)
 
+    @pytest.mark.parametrize("with_csc", [True, False])
+    def test_streamed_csr_smooth_equals_in_memory(self, rng, with_csc):
+        """Sparse macro-batches (fixed-shape padding, ragged tail) must
+        reproduce the in-memory CSR smooth exactly up to reassociation."""
+        n, d = 531, 73  # deliberately not divisible by batch_rows
+        counts = rng.integers(1, 9, n)
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        nnz = int(indptr[-1])
+        indices = rng.integers(0, d, nnz).astype(np.int32)
+        values = rng.normal(size=nnz).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        g = losses.LogisticGradient()
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+        X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+        f_ref, g_ref = smooth_lib.make_smooth(g, X, jnp.asarray(y))(w)
+
+        ds = streaming.StreamingDataset.from_csr(
+            indptr, indices, values, d, y, batch_rows=128,
+            with_csc=with_csc)
+        batches = list(ds)
+        assert all(b[0].has_csc == with_csc for b in batches)
+        # fixed shapes: one compile serves every batch incl. the tail
+        assert len({(b[0].nnz, b[0].shape) for b in batches}) == 1
+        for Xb, _, _ in batches:  # sorted-claim preconditions
+            assert np.all(np.diff(np.asarray(Xb.row_ids)) >= 0)
+            if with_csc:
+                assert np.all(np.diff(np.asarray(Xb.csc_col_ids)) >= 0)
+        sm, sl = streaming.make_streaming_smooth(g, ds)
+        f, gr = sm(w)
+        np.testing.assert_allclose(float(f), float(f_ref), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(sl(w)), float(f_ref), rtol=1e-6)
+
+    def test_streamed_csr_host_agd(self, rng):
+        """Full host-driver AGD over streamed CSR equals the fused
+        in-memory sparse run."""
+        n, d = 700, 41
+        npr = 6
+        indptr = np.arange(n + 1) * npr
+        indices = rng.integers(0, d, n * npr).astype(np.int32)
+        values = rng.normal(size=n * npr).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        g = losses.LogisticGradient()
+        w0 = jnp.zeros(d, jnp.float32)
+        px, rv = smooth_lib.make_prox(prox.MLlibSquaredL2Updater(), 0.05)
+        cfg = agd.AGDConfig(num_iterations=6, convergence_tol=0.0)
+
+        import jax
+        X = sparse.CSRMatrix.from_csr_arrays(indptr, indices, values, d,
+                                             with_csc=True)
+        sm = smooth_lib.make_smooth(g, X, jnp.asarray(y))
+        r_fused = jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg))(w0)
+
+        ds = streaming.StreamingDataset.from_csr(
+            indptr, indices, values, d, y, batch_rows=256)
+        sm_s, sl_s = streaming.make_streaming_smooth(g, ds)
+        r_host = host_agd.run_agd_host(sm_s, px, rv, w0, cfg,
+                                       smooth_loss=sl_s)
+        assert r_host.num_iters == int(r_fused.num_iters)
+        np.testing.assert_allclose(
+            r_host.loss_history,
+            np.asarray(r_fused.loss_history)[:r_host.num_iters],
+            rtol=1e-5)
+
+    def test_streamed_csr_mesh_rejected(self, rng):
+        ds = streaming.StreamingDataset.from_csr(
+            np.array([0, 1]), np.array([0], np.int32),
+            np.array([1.0], np.float32), 4,
+            np.array([1.0], np.float32), batch_rows=8)
+        m = sat.make_mesh({"data": 2})
+        sm, _ = streaming.make_streaming_smooth(
+            losses.LogisticGradient(), ds, mesh=m)
+        with pytest.raises(NotImplementedError, match="CSR streaming"):
+            sm(jnp.zeros(4, jnp.float32))
+
     def test_fold_stream_overlaps_transfer_with_compute(self):
         """The pipeline contract (VERDICT r1 weak #5): batch i+1 must be
         staged before ANY batch's scalar count syncs to the host — i.e.
