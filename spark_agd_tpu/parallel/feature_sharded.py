@@ -47,6 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from .. import native
 from ..ops.losses import MarginGradient
 from ..ops.sparse import CSRMatrix
 from . import mesh as mesh_lib
@@ -116,27 +117,11 @@ def shard_csr_by_columns(
     # currently lightest shard with remaining capacity.  Max shard load ≈
     # max(heaviest column, total/n_shards) — the best any column-granular
     # layout can do under power-law occupancy (url_combined's regime).
-    # O(D log S) host Python; seconds even at D = 3.2M, once per dataset.
-    import heapq
-
+    # C++ core with bit-identical Python fallback (native.greedy_balance);
+    # the pure-Python loop costs seconds at D = 3.2M (native ~7x faster).
     col_nnz = np.bincount(indices, minlength=n_features)
-    order = np.argsort(-col_nnz, kind="stable")
-    shard_of_col = np.empty(n_features, np.int64)
-    local_of_col = np.empty(n_features, np.int64)
-    heap = [(0, s) for s in range(n_shards)]
-    capacity = [d_local] * n_shards
-    next_local = [0] * n_shards
-    nnz_list = col_nnz[order].tolist()
-    for rank, col in enumerate(order.tolist()):
-        while True:
-            load, s = heapq.heappop(heap)
-            if capacity[s]:
-                break
-        shard_of_col[col] = s
-        local_of_col[col] = next_local[s]
-        next_local[s] += 1
-        capacity[s] -= 1
-        heapq.heappush(heap, (load + nnz_list[rank], s))
+    shard_of_col, local_of_col = native.greedy_balance(
+        col_nnz, n_shards, d_local)
     positions = shard_of_col * d_local + local_of_col
 
     e_shard = shard_of_col[indices]

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import native
 from ..ops.sparse import CSRMatrix, RowShardedCSR
 
 DATA_AXIS = "data"
@@ -154,26 +155,12 @@ def shard_csr_batch(
         # Greedy nnz balance (same scheme as the column layout in
         # feature_sharded.py): heaviest row onto the lightest shard with
         # remaining capacity.  Bounds the padded per-shard nnz near
-        # max(heaviest row, total/n_shards).
-        import heapq
-
-        order = np.argsort(-counts, kind="stable")
-        shard_of_row = np.empty(n_rows, np.int64)
-        local_of_row = np.empty(n_rows, np.int64)
-        heap = [(0, s) for s in range(n_shards)]
-        capacity = [rps] * n_shards
-        next_local = [0] * n_shards
-        nnz_list = counts[order].tolist()
-        for rank, r in enumerate(order.tolist()):
-            while True:
-                load, s = heapq.heappop(heap)
-                if capacity[s]:
-                    break
-            shard_of_row[r] = s
-            local_of_row[r] = next_local[s]
-            next_local[s] += 1
-            capacity[s] -= 1
-            heapq.heappush(heap, (load + nnz_list[rank], s))
+        # max(heaviest row, total/n_shards).  C++ core
+        # (native.greedy_balance) with a bit-identical Python fallback
+        # — the heapq loop costs seconds at url_combined scale (native
+        # measured 7x faster at 3.2M items).
+        shard_of_row, local_of_row = native.greedy_balance(
+            counts, n_shards, rps)
     else:
         rows = np.arange(n_rows, dtype=np.int64)
         shard_of_row = rows // rps

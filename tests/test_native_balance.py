@@ -1,0 +1,96 @@
+"""The C++ greedy shard balancer vs its Python executable spec.
+
+``native/shard_balance.cpp`` must be BIT-IDENTICAL to the heapq
+fallback inside ``native.greedy_balance`` — the sharded layouts (and
+therefore every mesh trajectory) depend on the assignment, so the two
+paths drifting would make results toolchain-dependent.
+"""
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu import native
+
+
+def python_balance(counts, n_shards, capacity):
+    """The spec, inlined (native.greedy_balance minus the native fast
+    path)."""
+    import heapq
+
+    counts = np.asarray(counts, np.int64)
+    n = len(counts)
+    order = np.argsort(-counts, kind="stable")
+    shard_of = np.empty(n, np.int64)
+    local_of = np.empty(n, np.int64)
+    heap = [(0, s) for s in range(n_shards)]
+    cap = [capacity] * n_shards
+    next_local = [0] * n_shards
+    nnz_list = counts[order].tolist()
+    for rank, r in enumerate(order.tolist()):
+        while True:
+            load, s = heapq.heappop(heap)
+            if cap[s]:
+                break
+        shard_of[r] = s
+        local_of[r] = next_local[s]
+        next_local[s] += 1
+        cap[s] -= 1
+        heapq.heappush(heap, (load + nnz_list[rank], s))
+    return shard_of, local_of
+
+
+needs_native = pytest.mark.skipif(
+    native.load_balancer() is None,
+    reason="no C++ toolchain for the native balancer")
+
+
+@needs_native
+@pytest.mark.parametrize("seed,n,shards", [
+    (0, 1, 1), (1, 17, 4), (2, 1000, 8), (3, 4096, 3), (4, 9999, 16),
+])
+def test_native_matches_python(seed, n, shards):
+    rng = np.random.default_rng(seed)
+    # ties included on purpose: duplicate counts exercise stable order
+    counts = rng.integers(0, 12, n).astype(np.int64)
+    cap = -(-n // shards)
+    got = native.greedy_balance(counts, shards, cap)
+    want = python_balance(counts, shards, cap)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_rejects_overflow_same_error_either_path():
+    """Capacity validation happens before dispatch, so the error is
+    identical with or without the toolchain."""
+    with pytest.raises(ValueError, match="exceed"):
+        native.greedy_balance(np.ones(10, np.int64), 3, 3)
+
+
+def test_python_fallback_matches(monkeypatch):
+    """Force the fallback and pin it to the spec (the native path is
+    covered above when the toolchain exists)."""
+    monkeypatch.setattr(native, "load_balancer", lambda: None)
+    rng = np.random.default_rng(11)
+    counts = rng.integers(0, 9, 777).astype(np.int64)
+    cap = -(-777 // 5)
+    got = native.greedy_balance(counts, 5, cap)
+    want = python_balance(counts, 5, cap)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+@needs_native
+def test_dispatch_used_by_layouts():
+    """The layouts' balancer must route through the native core when
+    available and agree with the spec."""
+    rng = np.random.default_rng(7)
+    counts = rng.integers(1, 30, 500).astype(np.int64)
+    got = native.greedy_balance(counts, 8, -(-500 // 8))
+    want = python_balance(counts, 8, -(-500 // 8))
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    # capacity respected, every local id unique per shard
+    for s in range(8):
+        locs = got[1][got[0] == s]
+        assert len(locs) <= -(-500 // 8)
+        assert len(set(locs.tolist())) == len(locs)
