@@ -80,7 +80,7 @@ CONFIGS = [
     BenchConfig(4, "softmax_mnist8mlike", datasets.mnist8m_like,
                 lambda: losses.SoftmaxGradient(10), prox.SquaredL2Updater,
                 1e-4, lambda X: np.zeros((X.shape[1], 10), np.float32),
-                tpu_scale=0.15),
+                tpu_scale=0.15, pallas_ok=True),
     # dense 1M x 1k = 4 GB -> full
     BenchConfig(5, "mlp_criteolike", datasets.criteo_like,
                 lambda: mlp_lib.mlp_gradient("tanh"), prox.SquaredL2Updater,
@@ -167,9 +167,13 @@ def run_config(config: BenchConfig, scale: float, iters: int,
 
     gradient = config.gradient()
     if use_pallas and config.pallas_ok:
-        from spark_agd_tpu.ops.pallas_kernels import PallasMarginGradient
+        from spark_agd_tpu.ops.pallas_kernels import (
+            PallasMarginGradient, PallasSoftmaxGradient)
 
-        gradient = PallasMarginGradient(gradient)
+        if isinstance(gradient, losses.SoftmaxGradient):
+            gradient = PallasSoftmaxGradient(gradient)
+        else:
+            gradient = PallasMarginGradient(gradient)
 
     # make_runner compiles ONCE; timing the second fit() measures the
     # steady state (api.run would re-trace per call and the "steady"

@@ -75,17 +75,23 @@ def _pad_to(n: int, m: int) -> int:
 
 
 def choose_block_rows(d_padded: int, itemsize: int,
-                      vmem_budget: int = _VMEM_BUDGET) -> int:
+                      vmem_budget: int = _VMEM_BUDGET,
+                      fixed_bytes: Optional[int] = None,
+                      row_extra_bytes: int = 0) -> int:
     """Largest sublane-aligned row-block height whose working set fits
-    the VMEM budget: 2 double-buffered (rows, Dp) X blocks plus the
-    full-width f32 w column and gradient-accumulator row.  Returns 0 when
-    even the minimum 8-row block cannot fit (caller falls back to XLA).
-    """
-    fixed = 2 * d_padded * 4  # w (Dp,1) + grad accumulator (1,Dp), f32
-    avail = vmem_budget - fixed
+    the VMEM budget: 2 double-buffered (rows, Dp) X blocks plus
+    ``fixed_bytes`` of block-independent panels (default: the margin
+    kernel's f32 w column + gradient-accumulator row) plus
+    ``row_extra_bytes`` per block row (kernel temporaries wider than a
+    lane, e.g. the softmax kernel's (BN, Kp) intermediates).  Returns 0
+    when even the minimum 8-row block cannot fit (caller falls back to
+    XLA)."""
+    if fixed_bytes is None:
+        fixed_bytes = 2 * d_padded * 4  # w (Dp,1) + grad acc (1,Dp), f32
+    avail = vmem_budget - fixed_bytes
     if avail <= 0:
         return 0
-    rows = avail // (2 * d_padded * itemsize)
+    rows = avail // (2 * d_padded * itemsize + row_extra_bytes)
     rows = min(_MAX_BLOCK_ROWS, (rows // _SUBLANE) * _SUBLANE)
     return int(rows) if rows >= _SUBLANE else 0
 
@@ -333,3 +339,175 @@ class PallasLogisticGradient(PallasMarginGradient):
     def __init__(self, interpret=None, block_rows: Optional[int] = None):
         super().__init__(LogisticGradient(), interpret=interpret,
                          block_rows=block_rows)
+
+
+# ---------------------------------------------------------------------------
+# Fused softmax: the (D, K)-weight multinomial loss (BASELINE config 4)
+# through the same single-HBM-pass design as the margin kernel.
+# ---------------------------------------------------------------------------
+
+def choose_block_rows_softmax(d_padded: int, k_padded: int, itemsize: int,
+                              vmem_budget: int = _VMEM_BUDGET) -> int:
+    """Row-block height for the softmax kernel's working set: beyond the
+    X stream, the full (Dp, Kp) f32 weight AND gradient-accumulator
+    panels are block-independent, and ~4 (BN, Kp) f32 intermediates
+    (logits / ez / onehot / resid) are live per block row."""
+    return choose_block_rows(
+        d_padded, itemsize, vmem_budget,
+        fixed_bytes=2 * d_padded * k_padded * 4,
+        row_extra_bytes=4 * k_padded * 4)
+
+
+def _softmax_kernel(num_classes, x_ref, y_ref, m_ref, w_ref, loss_ref,
+                    grad_ref):
+    """One row-block: logits, a stable masked logsumexp, and BOTH MXU
+    products off a single VMEM-resident X block.  Class padding columns
+    (Kp > K) carry -inf logits so they vanish from the softmax; their
+    residuals are exactly 0, so the (Dp, Kp) gradient tail stays zero."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        loss_ref[0, 0] = jnp.float32(0.0)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    xb = x_ref[:]  # (BN, Dp) — read once, used twice
+    logits = jnp.dot(xb, w_ref[:],
+                     preferred_element_type=jnp.float32)  # (BN, Kp)
+    kp = logits.shape[1]
+    class_ids = jax.lax.broadcasted_iota(jnp.float32, (1, kp), 1)
+    valid_cls = class_ids < num_classes  # (1, Kp)
+    neg_inf = jnp.float32(-jnp.inf)
+    logits = jnp.where(valid_cls, logits, neg_inf)
+    zmax = jnp.max(logits, axis=1, keepdims=True)  # (BN, 1)
+    ez = jnp.where(valid_cls, jnp.exp(logits - zmax), 0.0)
+    sez = jnp.sum(ez, axis=1, keepdims=True)
+    lse = zmax + jnp.log(sez)  # (BN, 1)
+
+    y = y_ref[:]  # (BN, 1) f32 integral labels
+    m = m_ref[:]  # (BN, 1) f32, 0 for padding rows
+    onehot = jnp.where(class_ids == y, 1.0, 0.0)  # (BN, Kp)
+    # select-then-sum, NOT logits*onehot: padding classes hold -inf and
+    # 0 * -inf would poison the sum with NaN
+    picked = jnp.sum(jnp.where(onehot > 0, logits, 0.0), axis=1,
+                     keepdims=True)
+    per = (lse - picked) * m
+    resid = (ez / sez - onehot) * m  # (BN, Kp); 0 on padding classes
+
+    loss_ref[0, 0] += jnp.sum(per)
+    # grad partial = X^T @ resid -> (Dp, Kp), contracting the BN rows
+    grad_ref[:] += jax.lax.dot_general(
+        xb, resid, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_classes", "interpret",
+                                   "block_rows"))
+def fused_softmax_loss_grad(num_classes: int, W, padded: PaddedDense, *,
+                            interpret=False,
+                            block_rows: Optional[int] = None):
+    """``(loss_sum, grad_sum)`` of the multinomial softmax, one HBM pass.
+
+    ``padded`` comes from :func:`pad_dense` built with
+    ``choose_block_rows_softmax`` blocks (labels ride the f32 ``y``
+    plane); ``W`` is the logical (D, K) weight matrix.
+    """
+    Xp, yp, mp = padded.X, padded.y, padded.m
+    np_, dp = Xp.shape
+    kp = _pad_to(num_classes, _LANE)
+    br = block_rows or choose_block_rows_softmax(dp, kp,
+                                                 Xp.dtype.itemsize)
+    if br == 0 or np_ % br:
+        raise ValueError(
+            f"padded rows {np_} not divisible by softmax block_rows {br}")
+    kernel = functools.partial(_softmax_kernel, num_classes)
+    Wp = jnp.zeros((dp, kp), jnp.float32).at[
+        :padded.n_features, :num_classes].set(
+        jnp.asarray(W).astype(jnp.float32))
+
+    grid = np_ // br
+    loss, grad = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((br, dp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((dp, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((dp, kp), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * np_ * dp * kp,
+            bytes_accessed=np_ * dp * Xp.dtype.itemsize + 3 * np_ * 4,
+            transcendentals=2 * np_ * kp,
+        ),
+        interpret=interpret,
+    )(Xp, yp, mp, Wp)
+    return loss[0, 0], grad[:padded.n_features, :num_classes]
+
+
+class PallasSoftmaxGradient(Gradient):
+    """Drop-in fused-kernel wrapper for :class:`~spark_agd_tpu.ops.
+    losses.SoftmaxGradient` on dense data (BASELINE config 4).
+
+    Same staging contract as :class:`PallasMarginGradient`: ``prepare``
+    pads once at data-placement time; CSR, over-wide, and un-prepared
+    tracer inputs fall back to the wrapped jnp kernel.
+    """
+
+    def __init__(self, inner, interpret=None,
+                 block_rows: Optional[int] = None):
+        from .losses import SoftmaxGradient
+
+        if not isinstance(inner, SoftmaxGradient):
+            raise TypeError(
+                "PallasSoftmaxGradient wraps SoftmaxGradient; got "
+                f"{type(inner).__name__}")
+        self.inner = inner
+        self.num_classes = inner.num_classes
+        self._interpret = (jax.default_backend() != "tpu"
+                           if interpret is None else bool(interpret))
+        self._block_rows = block_rows
+
+    def _block(self, d: int, itemsize: int) -> int:
+        dp = _pad_to(d, _LANE)
+        kp = _pad_to(self.num_classes, _LANE)
+        return self._block_rows or choose_block_rows_softmax(dp, kp,
+                                                             itemsize)
+
+    def prepare(self, X, y, mask=None):
+        if isinstance(X, CSRMatrix):
+            return super().prepare(X, y, mask)
+        if isinstance(X, PaddedDense) or isinstance(X, jax.core.Tracer):
+            return X, y, mask
+        X = jnp.asarray(X)
+        itemsize = 2 if X.dtype == jnp.bfloat16 else 4
+        if X.ndim != 2 or self._block(X.shape[1], itemsize) < _SUBLANE:
+            return X, y, mask
+        return (pad_dense(X, y, mask,
+                          block_rows=self._block(X.shape[1], itemsize)),
+                None, None)
+
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        if isinstance(X, PaddedDense):
+            loss, grad = fused_softmax_loss_grad(
+                self.num_classes, weights, X, interpret=self._interpret,
+                block_rows=self._block(X.n_features,
+                                       X.X.dtype.itemsize))
+            dt = jnp.result_type(weights)
+            return loss.astype(dt), grad.astype(dt), X.n_valid
+        return self.inner.batch_loss_and_grad(weights, X, y, mask)

@@ -79,18 +79,47 @@ def test_python_fallback_matches(monkeypatch):
     np.testing.assert_array_equal(got[1], want[1])
 
 
-@needs_native
-def test_dispatch_used_by_layouts():
-    """The layouts' balancer must route through the native core when
-    available and agree with the spec."""
+def test_layouts_route_through_balancer(monkeypatch):
+    """Both sharded layouts must call native.greedy_balance (with a
+    capacity that holds every item) — and its output must respect
+    capacity with unique local slots per shard."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as _np
+
+    from spark_agd_tpu.ops.sparse import CSRMatrix
+    from spark_agd_tpu.parallel import feature_sharded as fs
+    from spark_agd_tpu.parallel import mesh as mesh_lib
+
+    calls = []
+    real = native.greedy_balance
+
+    def spy(counts, n_shards, capacity):
+        out = real(counts, n_shards, capacity)
+        calls.append((len(counts), n_shards, capacity))
+        for s in range(n_shards):
+            locs = out[1][out[0] == s]
+            assert len(locs) <= capacity
+            assert len(set(locs.tolist())) == len(locs)
+        return out
+
+    monkeypatch.setattr(mesh_lib.native, "greedy_balance", spy)
+    monkeypatch.setattr(fs.native, "greedy_balance", spy)
+
     rng = np.random.default_rng(7)
-    counts = rng.integers(1, 30, 500).astype(np.int64)
-    got = native.greedy_balance(counts, 8, -(-500 // 8))
-    want = python_balance(counts, 8, -(-500 // 8))
-    np.testing.assert_array_equal(got[0], want[0])
-    np.testing.assert_array_equal(got[1], want[1])
-    # capacity respected, every local id unique per shard
-    for s in range(8):
-        locs = got[1][got[0] == s]
-        assert len(locs) <= -(-500 // 8)
-        assert len(set(locs.tolist())) == len(locs)
+    n, d = 101, 37
+    counts = rng.integers(1, 6, n)
+    indptr = np.concatenate([[0], np.cumsum(counts)])
+    nnz = int(indptr[-1])
+    indices = rng.integers(0, d, nnz).astype(np.int32)
+    values = rng.standard_normal(nnz).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    mesh = mesh_lib.make_mesh({"data": 4}, devices=jax.devices()[:4])
+    X = CSRMatrix.from_csr_arrays(indptr, indices, values, d)
+    mesh_lib.shard_csr_batch(mesh, X, y)
+    assert calls and calls[-1] == (n, 4, -(-n // 4))
+
+    mesh2 = mesh_lib.make_mesh({"model": 4}, devices=jax.devices()[:4])
+    fs.shard_csr_by_columns(indptr, indices, values, d, y, mesh2)
+    assert calls[-1] == (d, 4, -(-d // 4))

@@ -259,3 +259,79 @@ class TestPrepare:
             return jnp.sum(Xt)
 
         jax.jit(traced)(X)
+
+
+class TestFusedSoftmax:
+    """The fused softmax kernel (BASELINE config 4's dense path)."""
+
+    @pytest.fixture(scope="class")
+    def sm_data(self):
+        rng = np.random.default_rng(21)
+        n, d, k = 700, 130, 10  # unaligned: pads to (rows, 256) x Kp=128
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        W = (rng.standard_normal((d, k)) / np.sqrt(d)).astype(np.float32)
+        y = rng.integers(0, k, n).astype(np.float32)
+        return jnp.asarray(X), jnp.asarray(W), jnp.asarray(y), k
+
+    def test_matches_jnp_kernel(self, sm_data):
+        from spark_agd_tpu.ops.pallas_kernels import (
+            PallasSoftmaxGradient, choose_block_rows_softmax, pad_dense)
+
+        X, W, y, k = sm_data
+        ref_l, ref_g, ref_n = SoftmaxGradient(k).batch_loss_and_grad(
+            W, X, y)
+        g = PallasSoftmaxGradient(SoftmaxGradient(k), interpret=True)
+        Xp, yp, mp = g.prepare(X, y)
+        loss, grad, n = g.batch_loss_and_grad(W, Xp, yp, mp)
+        assert int(n) == int(ref_n)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_g),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_masked_rows_excluded(self, sm_data):
+        from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
+
+        X, W, y, k = sm_data
+        rng = np.random.default_rng(5)
+        mask = (rng.random(X.shape[0]) < 0.7).astype(np.float32)
+        ref_l, ref_g, ref_n = SoftmaxGradient(k).batch_loss_and_grad(
+            W, X, y, mask)
+        g = PallasSoftmaxGradient(SoftmaxGradient(k), interpret=True)
+        args = g.prepare(X, y, mask)
+        loss, grad, n = g.batch_loss_and_grad(W, *args)
+        assert int(n) == int(ref_n)
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_g),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_loop_parity(self, sm_data):
+        """Full AGD through the fused softmax smooth vs the jnp path."""
+        import jax
+
+        from spark_agd_tpu.core import agd, smooth as smooth_lib
+        from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
+        from spark_agd_tpu.ops.prox import L2Prox
+
+        X, W, y, k = sm_data
+        W0 = jnp.zeros_like(W)
+        cfg = agd.AGDConfig(num_iterations=4, convergence_tol=0.0)
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.01)
+
+        def fit(gradient):
+            Xp, yp, mp = gradient.prepare(X, y)
+            sm = smooth_lib.make_smooth(gradient, Xp, yp, mp)
+            sl = smooth_lib.make_smooth_loss(gradient, Xp, yp, mp)
+            r = jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg,
+                                              smooth_loss=sl))(W0)
+            return np.asarray(r.loss_history)[:int(r.num_iters)]
+
+        h_ref = fit(SoftmaxGradient(k))
+        h_fused = fit(PallasSoftmaxGradient(SoftmaxGradient(k),
+                                            interpret=True))
+        np.testing.assert_allclose(h_fused, h_ref, rtol=1e-5)
+
+    def test_rejects_non_softmax(self):
+        from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
+
+        with pytest.raises(TypeError, match="SoftmaxGradient"):
+            PallasSoftmaxGradient(LogisticGradient())

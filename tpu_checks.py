@@ -107,6 +107,46 @@ def main(argv=None):
         "speedup": round(xla_s / pal_s, 3),
         "ok": True}), flush=True)
 
+    # Fused softmax kernel at MNIST-8M-like dense shape (config 4):
+    # compiled parity + single-pass vs two-pass timing.
+    from spark_agd_tpu.ops.losses import SoftmaxGradient
+    from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
+
+    smx_n, smx_d, smx_k = 1 << 17, 784, 10
+    Xs_d = jnp.asarray(rng.standard_normal((smx_n, smx_d)).astype(
+        np.float32) / np.sqrt(smx_d))
+    ys_d = jnp.asarray(rng.integers(0, smx_k, smx_n).astype(np.float32))
+    Ws_d = jnp.asarray((rng.standard_normal((smx_d, smx_k))
+                        / np.sqrt(smx_d)).astype(np.float32))
+    g_smx = SoftmaxGradient(smx_k)
+    ref_l, ref_g, _ = jax.jit(
+        lambda wv: g_smx.batch_loss_and_grad(wv, Xs_d, ys_d))(Ws_d)
+    gp = PallasSoftmaxGradient(g_smx, interpret=False)
+    Xp_s, yp_s, mp_s = gp.prepare(Xs_d, ys_d)
+    t0 = time.perf_counter()
+    fl, fg, _ = gp.batch_loss_and_grad(Ws_d, Xp_s, yp_s, mp_s)
+    jax.block_until_ready(fg)
+    smx_compile = time.perf_counter() - t0
+    rel_l = abs(float(fl) - float(ref_l)) / max(abs(float(ref_l)), 1e-30)
+    rel_gr = float(jnp.linalg.norm(fg - ref_g)
+                   / (jnp.linalg.norm(ref_g) + 1e-30))
+    smx_ok = rel_l < 1e-3 and rel_gr < 1e-3
+    failures += not smx_ok
+    xla_smx = timed(jax.jit(
+        lambda wv: g_smx.batch_loss_and_grad(wv, Xs_d, ys_d)[1]),
+        Ws_d, args.reps)
+    pal_smx = timed(
+        lambda wv: gp.batch_loss_and_grad(wv, Xp_s, yp_s, mp_s)[1],
+        Ws_d, args.reps)
+    print(json.dumps({
+        "check": "pallas_softmax_compiled_parity",
+        "rows": smx_n, "d": smx_d, "k": smx_k, "ok": bool(smx_ok),
+        "rel_loss_err": rel_l, "rel_grad_err": rel_gr,
+        "compile_s": round(smx_compile, 1),
+        "xla_ms": round(xla_smx * 1e3, 3),
+        "pallas_ms": round(pal_smx * 1e3, 3),
+        "speedup": round(xla_smx / pal_smx, 3)}), flush=True)
+
     # Sparse gradient layouts on the real chip: scatter-add vs the
     # column-sorted CSC twin (ops/sparse.py docstring) at rcv1-like
     # sparsity.  Parity is asserted; the timing decides whether the twin
