@@ -60,3 +60,5 @@ from .ops.prox import (  # noqa: F401
     SquaredL2Updater,
     L1Updater,
 )
+from .ops.sparse import CSRMatrix  # noqa: F401
+from .data.streaming import StreamingDataset  # noqa: F401

@@ -59,9 +59,12 @@ def main(argv=None):
     p.add_argument("--skip-checks", action="store_true")
     p.add_argument("--skip-configs", action="store_true")
     p.add_argument("--config-iters", type=int, default=20)
-    p.add_argument("--gd-cap", type=int, default=0,
-                   help="GD-oracle iteration cap for the AGD-vs-GD ratio "
-                        "(0 = skip the oracle pass)")
+    p.add_argument("--gd-cap", type=int, default=-1,
+                   help="GD-oracle iteration cap for the AGD-vs-GD ratio; "
+                        "0 skips the oracle pass, -1 (default) auto-"
+                        "scales to 8x --config-iters so the reference's "
+                        "implicit ~5x headline ratio can actually "
+                        "resolve instead of saturating the cap")
     p.add_argument("--configs", default="1,2,3,4,5")
     p.add_argument("--config-dtypes", default="f32,bf16",
                    help="feature dtypes to measure per config")
@@ -115,21 +118,34 @@ def main(argv=None):
 
         out_path = f"BENCH_CONFIGS_{args.tag}.json"
         open(out_path, "w").close()  # truncate: --out appends per config
+        gd_cap = (8 * args.config_iters if args.gd_cap < 0
+                  else args.gd_cap)
         argv_c = ["--iters", str(args.config_iters),
                   "--dtype", args.config_dtypes, "--out", out_path]
-        if args.gd_cap:
-            argv_c += ["--gd-cap", str(args.gd_cap)]
-        for c in args.configs.split(","):
-            try:
-                with stdout_to(os.devnull):
-                    # run.main sys.exits per invocation; the artifact
-                    # file accumulates via --out (truncated above)
-                    bench_configs.main(["--config", c] + argv_c)
-            except SystemExit as e:
-                failures += int(bool(e.code))
-            except Exception as e:  # noqa: BLE001
-                log(f"config {c} failed: {type(e).__name__}: {e}")
-                failures += 1
+        if gd_cap:
+            argv_c += ["--gd-cap", str(gd_cap)]
+        pallas_ok = {str(c.idx) for c in bench_configs.CONFIGS
+                     if c.pallas_ok}
+        for c in (t.strip() for t in args.configs.split(",")):
+            variants = [[]]
+            if c in pallas_ok:
+                # fused-kernel pass rides along, f32 only; the GD oracle
+                # would just repeat the base pass's answer — skip it
+                variants.append(["--pallas", "--dtype", "f32",
+                                 "--gd-cap", "0"])
+            for extra in variants:
+                try:
+                    with stdout_to(os.devnull):
+                        # run.main sys.exits per invocation; the artifact
+                        # file accumulates via --out (truncated above)
+                        bench_configs.main(
+                            ["--config", c] + argv_c + extra)
+                except SystemExit as e:
+                    failures += int(bool(e.code))
+                except Exception as e:  # noqa: BLE001
+                    log(f"config {c} {extra} failed: "
+                        f"{type(e).__name__}: {e}")
+                    failures += 1
         stage("configs done")
 
     print(json.dumps({"stage": "all done", "failures": failures,
