@@ -245,6 +245,11 @@ def main(argv=None):
                         "config and cast per dtype.  bf16 is the "
                         "TPU-native layout (weights/accumulation stay "
                         "f32)")
+    p.add_argument("--pallas-extra", action="store_true",
+                   help="after the dtype passes, run one extra f32 pass "
+                        "through the fused Pallas kernels on eligible "
+                        "configs (same generated data; GD oracle skipped "
+                        "- it would repeat the base pass's answer)")
     p.add_argument("--pallas", action="store_true",
                    help="use the fused Pallas kernel on eligible dense "
                         "margin configs")
@@ -286,11 +291,14 @@ def main(argv=None):
                   "error": f"make_data: {type(e).__name__}: {e}"[:500]})
             failures += 1
             continue
-        for dt in dtypes:
+        variants = [(dt, args.pallas, args.gd_cap) for dt in dtypes]
+        if args.pallas_extra and cfg.pallas_ok and not args.pallas:
+            variants.append(("f32", True, 0))
+        for dt, pallas, gd_cap in variants:
             try:
                 rec = run_config(cfg, scale, args.iters,
-                                 gd_cap=args.gd_cap,
-                                 use_pallas=args.pallas, dtype=dt,
+                                 gd_cap=gd_cap,
+                                 use_pallas=pallas, dtype=dt,
                                  data=data)
             except Exception as e:  # noqa: BLE001 — one config must not
                 # take down the others; the record carries the error

@@ -1,0 +1,101 @@
+"""bfloat16 feature layouts through the full public API.
+
+The dtype policy (README): features may be bf16 — the MXU-native
+layout, halving the dominant HBM traffic — while weights, reductions,
+and the optimizer recurrences stay f32.  These tests pin that the bf16
+trajectories track the f32 ones loosely (mantissa-limited) and stay
+finite through every layout: dense mesh, CSR (csc twin), and the fused
+softmax kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.ops.losses import LogisticGradient, SoftmaxGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.ops.sparse import CSRMatrix
+from spark_agd_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope="module")
+def dense_problem():
+    rng = np.random.default_rng(31)
+    n, d = 2000, 64
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w_true)))).astype(
+        np.float32)
+    return X, y, d
+
+
+def tracks(h_bf16, h_f32, tol=3e-2):
+    assert len(h_bf16) == len(h_f32)
+    assert np.all(np.isfinite(h_bf16))
+    np.testing.assert_allclose(h_bf16, h_f32, rtol=tol)
+
+
+class TestBf16EndToEnd:
+    def test_dense_mesh(self, dense_problem, cpu_devices):
+        X, y, d = dense_problem
+        kw = dict(num_iterations=6, reg_param=0.05,
+                  initial_weights=np.zeros(d, np.float32),
+                  mesh=mesh_lib.make_mesh({"data": 8}))
+        _, h32 = api.run((X, y), LogisticGradient(), L2Prox(), **kw)
+        _, h16 = api.run((X.astype(ml_dtypes.bfloat16), y),
+                         LogisticGradient(), L2Prox(), **kw)
+        tracks(h16, h32)
+
+    def test_csr_with_csc(self, cpu_devices):
+        rng = np.random.default_rng(33)
+        n, d, npr = 1500, 90, 7
+        indptr = np.arange(n + 1) * npr
+        cols = rng.integers(0, d, n * npr).astype(np.int32)
+        vals = rng.standard_normal(n * npr).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        X32 = CSRMatrix.from_csr_arrays(indptr, cols, vals, d,
+                                        with_csc=True)
+        X16 = CSRMatrix.from_csr_arrays(indptr, cols,
+                                        vals.astype(ml_dtypes.bfloat16),
+                                        d, with_csc=True)
+        kw = dict(num_iterations=6, reg_param=0.05,
+                  initial_weights=np.zeros(d, np.float32))
+        _, h32 = api.run((X32, y), LogisticGradient(), L2Prox(),
+                         mesh=False, **kw)
+        _, h16 = api.run((X16, y), LogisticGradient(), L2Prox(),
+                         mesh=False, **kw)
+        tracks(h16, h32)
+        # and sharded over the mesh
+        _, h16m = api.run((X16, y), LogisticGradient(), L2Prox(),
+                          mesh=mesh_lib.make_mesh({"data": 4}), **kw)
+        tracks(h16m, h32)
+
+    def test_fused_softmax_bf16(self, dense_problem):
+        from spark_agd_tpu.core import agd, smooth as smooth_lib
+        from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
+        from spark_agd_tpu.ops.prox import L2Prox as P2
+
+        X, _, d = dense_problem
+        rng = np.random.default_rng(35)
+        k = 5
+        y = rng.integers(0, k, X.shape[0]).astype(np.float32)
+        W0 = jnp.zeros((d, k), jnp.float32)
+        cfg = agd.AGDConfig(num_iterations=4, convergence_tol=0.0)
+        px, rv = smooth_lib.make_prox(P2(), 0.01)
+
+        def fit(Xin, gradient):
+            a = gradient.prepare(Xin, y)
+            sm = smooth_lib.make_smooth(gradient, *a)
+            sl = smooth_lib.make_smooth_loss(gradient, *a)
+            r = jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg,
+                                              smooth_loss=sl))(W0)
+            return np.asarray(r.loss_history)[:int(r.num_iters)]
+
+        h32 = fit(jnp.asarray(X), SoftmaxGradient(k))
+        h16 = fit(jnp.asarray(X).astype(jnp.bfloat16),
+                  PallasSoftmaxGradient(SoftmaxGradient(k),
+                                        interpret=True))
+        tracks(h16, h32)

@@ -121,31 +121,29 @@ def main(argv=None):
         gd_cap = (8 * args.config_iters if args.gd_cap < 0
                   else args.gd_cap)
         argv_c = ["--iters", str(args.config_iters),
-                  "--dtype", args.config_dtypes, "--out", out_path]
+                  "--dtype", args.config_dtypes, "--pallas-extra",
+                  "--out", out_path]
         if gd_cap:
             argv_c += ["--gd-cap", str(gd_cap)]
-        pallas_ok = {str(c.idx) for c in bench_configs.CONFIGS
-                     if c.pallas_ok}
-        for c in (t.strip() for t in args.configs.split(",")):
-            variants = [[]]
-            if c in pallas_ok:
-                # fused-kernel pass rides along, f32 only; the GD oracle
-                # would just repeat the base pass's answer — skip it
-                variants.append(["--pallas", "--dtype", "f32",
-                                 "--gd-cap", "0"])
-            for extra in variants:
-                try:
-                    with stdout_to(os.devnull):
-                        # run.main sys.exits per invocation; the artifact
-                        # file accumulates via --out (truncated above)
-                        bench_configs.main(
-                            ["--config", c] + argv_c + extra)
-                except SystemExit as e:
-                    failures += int(bool(e.code))
-                except Exception as e:  # noqa: BLE001
-                    log(f"config {c} {extra} failed: "
-                        f"{type(e).__name__}: {e}")
+        # canonicalize tokens: int() strips whitespace/leading zeros and
+        # rejects garbage here, not deep inside a stage
+        configs = [str(int(t)) for t in args.configs.split(",")
+                   if t.strip()]
+        for c in configs:
+            try:
+                with stdout_to(os.devnull):
+                    # run.main sys.exits per invocation; the artifact
+                    # file accumulates via --out (truncated above); the
+                    # fused-kernel ride-along reuses each config's
+                    # generated data inside run.py (--pallas-extra)
+                    bench_configs.main(["--config", c] + argv_c)
+            except SystemExit as e:
+                if e.code:
+                    log(f"config {c} exited rc={e.code}")
                     failures += 1
+            except Exception as e:  # noqa: BLE001
+                log(f"config {c} failed: {type(e).__name__}: {e}")
+                failures += 1
         stage("configs done")
 
     print(json.dumps({"stage": "all done", "failures": failures,
