@@ -306,7 +306,7 @@ def main(argv=None):
 
                 traceback.print_exc(file=sys.stderr)
                 rec = {"config": cfg.idx, "name": cfg.name,
-                       "scale": scale, "dtype": dt,
+                       "scale": scale, "dtype": dt, "pallas": pallas,
                        "error": f"{type(e).__name__}: {e}"[:500]}
                 failures += 1
             emit(rec)

@@ -76,7 +76,6 @@ class TestBf16EndToEnd:
     def test_fused_softmax_bf16(self, dense_problem):
         from spark_agd_tpu.core import agd, smooth as smooth_lib
         from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
-        from spark_agd_tpu.ops.prox import L2Prox as P2
 
         X, _, d = dense_problem
         rng = np.random.default_rng(35)
@@ -84,7 +83,7 @@ class TestBf16EndToEnd:
         y = rng.integers(0, k, X.shape[0]).astype(np.float32)
         W0 = jnp.zeros((d, k), jnp.float32)
         cfg = agd.AGDConfig(num_iterations=4, convergence_tol=0.0)
-        px, rv = smooth_lib.make_prox(P2(), 0.01)
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.01)
 
         def fit(Xin, gradient):
             a = gradient.prepare(Xin, y)

@@ -69,6 +69,14 @@ def main(argv=None):
     p.add_argument("--config-dtypes", default="f32,bf16",
                    help="feature dtypes to measure per config")
     args = p.parse_args(argv)
+    try:
+        # canonicalize tokens up front: int() strips whitespace/leading
+        # zeros, empties are dropped, and garbage fails BEFORE any
+        # expensive stage runs (a typo must not burn the claim)
+        configs = [str(int(t)) for t in args.configs.split(",")
+                   if t.strip()]
+    except ValueError:
+        p.error(f"--configs {args.configs!r}: tokens must be integers")
 
     t0 = time.perf_counter()
     import jax
@@ -125,10 +133,6 @@ def main(argv=None):
                   "--out", out_path]
         if gd_cap:
             argv_c += ["--gd-cap", str(gd_cap)]
-        # canonicalize tokens: int() strips whitespace/leading zeros and
-        # rejects garbage here, not deep inside a stage
-        configs = [str(int(t)) for t in args.configs.split(",")
-                   if t.strip()]
         for c in configs:
             try:
                 with stdout_to(os.devnull):
