@@ -1,0 +1,96 @@
+"""Compiled-program cost-shape guards.
+
+The architectural claim (README, SURVEY §3.2): the reference's per-
+evaluation broadcast + tree-reduce collapse into a single fused XLA
+program whose only collective is the psum of ``(Σloss, Σgrad, n)``, and
+whose collective count is INDEPENDENT of the iteration cap (the loop is
+a compiled ``while``, not an unrolled chain).  These tests pin that at
+the HLO level, so a regression that quietly adds per-iteration
+collectives (or reintroduces a host round-trip as a collective-permute/
+all-gather) fails loudly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu.core import agd, smooth as smooth_lib
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
+
+
+def compiled_text(fn, *args):
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def count_ops(hlo: str, name: str) -> int:
+    return sum(1 for line in hlo.splitlines()
+               if f" {name}(" in line or f" {name}-start(" in line)
+
+
+@pytest.fixture(scope="module")
+def dp_problem(cpu_devices):
+    rng = np.random.default_rng(41)
+    n, d = 512, 32
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    mesh = mesh_lib.make_mesh({"data": 8})
+    batch = mesh_lib.shard_batch(mesh, X, y)
+    sm, sl = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                          mesh=mesh)
+    w0 = mesh_lib.replicate(jnp.zeros(d, jnp.float32), mesh)
+    return sm, sl, w0
+
+
+class TestCollectiveCount:
+    def test_smooth_eval_single_reduce_phase(self, dp_problem):
+        """One smooth evaluation: its collectives are the (loss, grad,
+        count) psum — a handful of all-reduces (XLA may or may not merge
+        them), and nothing else."""
+        sm, _, w0 = dp_problem
+        hlo = compiled_text(sm, w0)
+        n_ar = count_ops(hlo, "all-reduce")
+        assert 1 <= n_ar <= 3, f"expected the single psum phase, {n_ar}"
+        for op in ("all-gather", "collective-permute", "all-to-all"):
+            assert count_ops(hlo, op) == 0, f"unexpected {op} in:\n{hlo}"
+
+    def test_loop_collectives_independent_of_iteration_cap(self,
+                                                           dp_problem):
+        """The fused AGD program's collective count must not grow with
+        num_iterations — the loop compiles once, iterations reuse it
+        (vs the reference's 2-3 broadcasts+reduces per iteration)."""
+        sm, sl, w0 = dp_problem
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+
+        def fit(iters):
+            cfg = agd.AGDConfig(num_iterations=iters, convergence_tol=0.0)
+            return compiled_text(
+                lambda w: agd.run_agd(sm, px, rv, w, cfg,
+                                      smooth_loss=sl), w0)
+
+        hlo5, hlo50 = fit(5), fit(50)
+        n5 = count_ops(hlo5, "all-reduce")
+        n50 = count_ops(hlo50, "all-reduce")
+        assert n5 == n50, (
+            f"collective count grew with the iteration cap: {n5} -> "
+            f"{n50}")
+        # the whole program stays a fixed handful of reduce phases
+        # (trial-y eval, trial-x eval, loss-only eval paths)
+        assert n5 <= 9, f"unexpectedly many all-reduces: {n5}"
+        for op in ("all-gather", "collective-permute", "all-to-all"):
+            assert count_ops(hlo5, op) == 0
+
+    def test_no_host_transfers_in_loop(self, dp_problem):
+        """No outfeed/infeed/send/recv anywhere in the compiled loop —
+        the fused program never talks to the host mid-run (the
+        reference ships weights every evaluation)."""
+        sm, sl, w0 = dp_problem
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        cfg = agd.AGDConfig(num_iterations=10, convergence_tol=0.0)
+        hlo = compiled_text(
+            lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl),
+            w0)
+        for op in ("outfeed", "infeed", "send", "recv"):
+            assert count_ops(hlo, op) == 0, f"host {op} in compiled loop"
