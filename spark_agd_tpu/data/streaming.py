@@ -39,19 +39,35 @@ def iter_array_batches(X, y, batch_rows: int,
         yield X[s:e], y[s:e], None if mask is None else mask[s:e]
 
 
+def _max_batch_nnz(indptr, batch_rows: int) -> int:
+    """Largest entry count of any ``batch_rows``-row slice — the one
+    batching-boundary computation, shared by the padding loop and the
+    ``from_libsvm_parts`` shape inference so they cannot disagree."""
+    indptr = np.asarray(indptr)
+    n = len(indptr) - 1
+    starts = np.arange(0, n, batch_rows)
+    if not len(starts):
+        return 0
+    return max(1, int(np.max(
+        indptr[np.minimum(starts + batch_rows, n)] - indptr[starts])))
+
+
 def iter_csr_batches(indptr, indices, values, n_features: int, y,
                      batch_rows: int, mask=None,
-                     with_csc: bool = True) -> Iterator[Tuple]:
+                     with_csc: bool = True,
+                     nnz_pad: Optional[int] = None) -> Iterator[Tuple]:
     """Slice host CSR arrays into fixed-shape macro-batches.
 
     XLA compiles ONE kernel per shape, so every batch is padded to the
-    same ``(batch_rows, nnz_pad)`` where ``nnz_pad`` is the largest
-    per-batch entry count (computed up front from ``indptr``).  Padding
-    follows the ops.sparse contract: inert 0.0 entries at the LAST
-    row/col slot (ids stay nondecreasing), padded row slots masked 0.
-    ``with_csc`` builds each batch's column-sorted twin on the host —
-    the per-batch argsort overlaps device compute inside
-    :func:`fold_stream`'s double buffering.
+    same ``(batch_rows, nnz_pad)`` — by default the largest per-batch
+    entry count (computed up front from ``indptr``); pass ``nnz_pad``
+    explicitly when batches from SEVERAL sources must share one compiled
+    shape (``StreamingDataset.from_libsvm_parts``).  Padding follows the
+    ops.sparse contract: inert 0.0 entries at the LAST row/col slot (ids
+    stay nondecreasing), padded row slots masked 0.  ``with_csc`` builds
+    each batch's column-sorted twin on the host — the per-batch argsort
+    overlaps device compute inside :func:`fold_stream`'s double
+    buffering.
     """
     indptr = np.asarray(indptr)
     indices = np.asarray(indices, np.int32)
@@ -61,8 +77,15 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
     starts = np.arange(0, n, batch_rows)
     if not len(starts):  # empty input: yield nothing, like the dense twin
         return
-    nnz_pad = max(1, int(np.max(
-        indptr[np.minimum(starts + batch_rows, n)] - indptr[starts])))
+    max_batch_nnz = _max_batch_nnz(indptr, batch_rows)
+    if nnz_pad is None:
+        nnz_pad = max_batch_nnz
+    elif max_batch_nnz > nnz_pad:
+        raise ValueError(
+            f"a macro-batch holds {max_batch_nnz} entries > nnz_pad="
+            f"{nnz_pad}; raise nnz_pad (one compiled shape must fit "
+            f"every batch — from_libsvm_parts callers: pass nnz_pad "
+            f"sized for the densest part)")
     for s in starts.tolist():
         e = min(s + batch_rows, n)
         lo, hi = int(indptr[s]), int(indptr[e])
@@ -115,13 +138,75 @@ class StreamingDataset:
 
     @classmethod
     def from_csr(cls, indptr, indices, values, n_features: int, y,
-                 batch_rows: int, mask=None, with_csc: bool = True):
+                 batch_rows: int, mask=None, with_csc: bool = True,
+                 nnz_pad: Optional[int] = None):
         """Macro-batches over host CSR arrays (``data.libsvm.CSRData``'s
         fields) — the sparse twin of ``from_arrays``; see
         :func:`iter_csr_batches` for the fixed-shape padding contract."""
         return cls(lambda: iter_csr_batches(
             indptr, indices, values, n_features, y, batch_rows, mask,
-            with_csc), batch_rows)
+            with_csc, nnz_pad=nnz_pad), batch_rows)
+
+    @classmethod
+    def from_libsvm_parts(cls, paths, n_features: int, batch_rows: int,
+                          with_csc: bool = True,
+                          nnz_pad: Optional[int] = None,
+                          binarize_labels: bool = True):
+        """Stream LIBSVM partition files (e.g. a Spark job's part-*
+        output — the north star's ingest seam) as fixed-shape CSR
+        macro-batches WITHOUT ever materializing the full dataset: one
+        part is parsed (C++ parser, Python fallback) while the previous
+        part's batches run, and each re-iteration re-reads from disk.
+
+        All parts share one compiled kernel shape, so ``nnz_pad`` must
+        bound every batch; by default it is sized from the first
+        NON-EMPTY part (its max batch nnz, +25% headroom, lane-rounded;
+        the part's parse is cached and consumed by the first iteration,
+        not repeated).  A later, denser part then raises mid-stream with
+        instructions — pass ``nnz_pad`` explicitly when part density
+        varies.  ``n_features`` is required: parts must agree on the
+        feature space (per-part inference would disagree on trailing
+        sparse columns), and out-of-range indices fail at parse time
+        rather than silently clamping inside the compiled gather.
+        """
+        from .libsvm import load_libsvm
+
+        paths = list(paths)
+        if not paths:
+            raise ValueError("from_libsvm_parts needs at least one path")
+
+        def part_arrays(path):
+            d = load_libsvm(path, n_features=n_features)
+            if len(d.indices) and int(d.indices.max()) >= n_features:
+                raise ValueError(
+                    f"{path}: feature index {int(d.indices.max())} >= "
+                    f"n_features={n_features} — an undersized feature "
+                    f"space would silently clamp/drop entries in the "
+                    f"compiled gather/scatter")
+            y = d.binarized_labels() if binarize_labels else d.labels
+            return d.indptr, d.indices, d.values, y.astype(np.float32)
+
+        first_cache = {}
+        if nnz_pad is None:
+            for path in paths:  # first NON-EMPTY part sizes the shape
+                arrays = part_arrays(path)
+                m0 = _max_batch_nnz(arrays[0], batch_rows)
+                if m0:
+                    first_cache[path] = arrays
+                    nnz_pad = -(-int(m0 * 1.25) // 128) * 128
+                    break
+            else:
+                raise ValueError("all parts are empty")
+
+        def factory():
+            for path in paths:
+                # the inference parse is reused exactly once (first pass)
+                arrays = first_cache.pop(path, None) or part_arrays(path)
+                yield from iter_csr_batches(
+                    *arrays[:3], n_features, arrays[3], batch_rows,
+                    with_csc=with_csc, nnz_pad=nnz_pad)
+
+        return cls(factory, batch_rows)
 
     def __iter__(self):
         return iter(self._factory())
