@@ -107,6 +107,67 @@ def shard_batch(
     return ShardedBatch(Xs, ys, ms)
 
 
+def shard_batch_by_features(
+    mesh: Mesh,
+    X,
+    y,
+    mask=None,
+    axis: str = MODEL_AXIS,
+) -> ShardedBatch:
+    """Shard a DENSE batch's feature columns over ``axis`` (dense D-axis
+    parallelism — the dense twin of ``feature_sharded``'s CSR layout).
+
+    Consume with ``make_dist_smooth(..., mode="auto")`` and weights
+    placed by :func:`shard_weights_by_features` (which zero-pads to the
+    batch's width): GSPMD keeps the optimizer state D-sharded end to end
+    and inserts the one (N,)-margin reduction itself — pinned by
+    ``tests/test_parallel.py::TestDenseFeatureSharding``.  Columns pad
+    with zeros to an even split; a pad column is inert ONLY while its
+    weight slot is zero (zero gradient + every prox in ``ops.prox``
+    fixing 0 keeps it there) — weights that start nonzero in the pad
+    tail would silently leak regularization, which is why the weight
+    helper owns the padding.
+    """
+    if isinstance(X, CSRMatrix):
+        raise ValueError(
+            "shard_batch_by_features is the DENSE D-axis layout; for "
+            "sparse data use parallel.feature_sharded."
+            "shard_csr_by_columns")
+    X = np.asarray(X) if not isinstance(X, jax.Array) else X
+    d = X.shape[1]
+    k = mesh.shape[axis]
+    rem = (-d) % k
+    if rem:
+        X = np.concatenate(
+            [np.asarray(X),
+             np.zeros((X.shape[0], rem), dtype=X.dtype)], axis=1)
+    rep = NamedSharding(mesh, P())
+    Xs = jax.device_put(X, NamedSharding(mesh, P(None, axis)))
+    ys = jax.device_put(np.asarray(y) if not isinstance(y, jax.Array)
+                        else y, rep)
+    ms = None if mask is None else jax.device_put(
+        np.asarray(mask, np.float32), rep)
+    return ShardedBatch(Xs, ys, ms)
+
+
+def shard_weights_by_features(w, batch: ShardedBatch, mesh: Mesh,
+                              axis: str = MODEL_AXIS):
+    """Place a (D,) (or (D, K)) weight array for a
+    :func:`shard_batch_by_features` batch: zero-pad the feature dim to
+    the batch's padded width (keeping the pad slots inert — see the
+    batch builder's contract) and shard it over ``axis``.  Invert with
+    ``np.asarray(w_sharded)[:d]``."""
+    w = np.asarray(w)
+    d_pad = batch.X.shape[1]
+    if w.shape[0] > d_pad:
+        raise ValueError(f"weights width {w.shape[0]} exceeds the "
+                         f"batch's padded feature width {d_pad}")
+    wp = np.zeros((d_pad,) + w.shape[1:], w.dtype)
+    wp[:w.shape[0]] = w
+    return jax.device_put(
+        wp, NamedSharding(mesh, P(axis, *([None] * (w.ndim - 1)))))
+
+
 def shard_csr_batch(
     mesh: Mesh,
     X: CSRMatrix,
