@@ -156,7 +156,7 @@ def shard_weights_by_features(w, batch: ShardedBatch, mesh: Mesh,
     :func:`shard_batch_by_features` batch: zero-pad the feature dim to
     the batch's padded width (keeping the pad slots inert — see the
     batch builder's contract) and shard it over ``axis``.  Invert with
-    ``np.asarray(w_sharded)[:d]``."""
+    :func:`unshard_weights_by_features`."""
     w = np.asarray(w)
     d_pad = batch.X.shape[1]
     if w.shape[0] > d_pad:
@@ -166,6 +166,13 @@ def shard_weights_by_features(w, batch: ShardedBatch, mesh: Mesh,
     wp[:w.shape[0]] = w
     return jax.device_put(
         wp, NamedSharding(mesh, P(axis, *([None] * (w.ndim - 1)))))
+
+
+def unshard_weights_by_features(w_sharded, d: int) -> np.ndarray:
+    """Recover the unpadded (d, ...) weights from a D-sharded state (the
+    dense twin of ``feature_sharded.unshard_weights``; the pad tail is
+    exact zeros by the inert-column contract)."""
+    return np.asarray(w_sharded)[:d]
 
 
 def shard_csr_batch(

@@ -228,5 +228,7 @@ class TestDenseFeatureSharding:
         # padded weight tail stays exactly zero (inert-column contract)
         w_final = np.asarray(res.weights)
         np.testing.assert_array_equal(w_final[d:], 0.0)
-        np.testing.assert_allclose(w_final[:d], np.asarray(rr.weights),
+        w_rec = mesh_lib.unshard_weights_by_features(res.weights, d)
+        assert w_rec.shape == (d,)
+        np.testing.assert_allclose(w_rec, np.asarray(rr.weights),
                                    rtol=1e-4, atol=1e-6)

@@ -1,0 +1,67 @@
+"""The single-claim artifact driver (tpu_all.py).
+
+Runs the configs stage end-to-end on the CPU mesh (TPU_ALL_ALLOW_CPU)
+and pins the artifact contract the judge-facing files depend on: one
+truncated JSON-lines file, records for every (dtype, pallas) variant,
+ride-along passes skipping the redundant GD oracle, and a non-zero exit
+on garbage input BEFORE any stage runs.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import tpu_all  # noqa: E402
+
+
+@pytest.fixture()
+def cpu_ok(monkeypatch):
+    monkeypatch.setenv("TPU_ALL_ALLOW_CPU", "1")
+
+
+def test_configs_stage_artifact_contract(cpu_ok, tmp_path, monkeypatch,
+                                         cpu_devices):
+    monkeypatch.chdir(tmp_path)
+    rc = tpu_all.main(["--tag", "t", "--skip-bench", "--skip-checks",
+                       "--configs", "5,", "--config-iters", "2",
+                       "--config-dtypes", "f32"])
+    assert rc == 0
+    recs = [json.loads(l)
+            for l in open(tmp_path / "BENCH_CONFIGS_t.json")]
+    assert [r["dtype"] for r in recs] == ["f32"]
+    assert all(r["config"] == 5 for r in recs)
+    assert all("error" not in r for r in recs)
+    # rerun truncates rather than accumulating stale records
+    rc = tpu_all.main(["--tag", "t", "--skip-bench", "--skip-checks",
+                       "--configs", "5", "--config-iters", "2",
+                       "--config-dtypes", "f32"])
+    assert rc == 0
+    recs2 = [json.loads(l)
+             for l in open(tmp_path / "BENCH_CONFIGS_t.json")]
+    assert len(recs2) == len(recs)
+
+
+def test_pallas_ride_along_skips_oracle(cpu_ok, tmp_path, monkeypatch,
+                                        cpu_devices):
+    monkeypatch.chdir(tmp_path)
+    rc = tpu_all.main(["--tag", "t2", "--skip-bench", "--skip-checks",
+                       "--configs", "2", "--config-iters", "2",
+                       "--gd-cap", "4", "--config-dtypes", "f32"])
+    assert rc == 0
+    recs = [json.loads(l)
+            for l in open(tmp_path / "BENCH_CONFIGS_t2.json")]
+    assert [(r["dtype"], r["pallas"]) for r in recs] == [
+        ("f32", False), ("f32", True)]
+    assert recs[0]["agd_vs_gd_iters"] is not None
+    assert recs[1]["agd_vs_gd_iters"] is None  # oracle skipped
+
+
+def test_garbage_configs_fail_before_stages(cpu_ok):
+    with pytest.raises(SystemExit) as exc:
+        tpu_all.main(["--configs", "1,oops"])
+    assert exc.value.code == 2
