@@ -1,0 +1,64 @@
+"""spark_agd_tpu — a TPU-native accelerated proximal gradient framework.
+
+A ground-up re-design of the capabilities of ``staple/spark-agd`` (TFOCS-style
+Accelerated Gradient Descent on Spark, reference mounted at
+``/root/reference``) for TPU: XLA-compiled batched loss kernels instead of
+per-example ``Gradient.compute``, a ``psum`` over the ICI mesh instead of
+``RDD.treeAggregate``, on-chip weight updates instead of driver round-trips,
+and the whole outer iteration — acceleration, backtracking line search,
+restart — compiled into one XLA program via ``lax.while_loop``.
+
+Layer map (mirrors SURVEY.md §1, re-drawn TPU-first):
+
+====  =============================  =========================================
+L5    public API                     ``AcceleratedGradientDescent`` class,
+                                     ``run`` / ``run_minibatch_agd``
+L4    optimizer core                 ``core.agd`` fused while-loop state
+                                     machine
+L3    math plugins                   ``ops.losses`` (Gradient), ``ops.prox``
+                                     (Updater)
+L2    distributed reduce             ``parallel`` — shard_map psum / pjit
+                                     auto-sharding over a Mesh
+L1    runtime                        XLA:TPU + host data staging (``data``)
+L0    local math                     ``core.tvec`` pytree algebra inside the
+                                     compiled program
+====  =============================  =========================================
+"""
+
+__version__ = "0.1.0"
+
+from .ops.losses import (  # noqa: F401
+    Gradient,
+    LogisticGradient,
+    LeastSquaresGradient,
+    HingeGradient,
+    SoftmaxGradient,
+    CustomGradient,
+)
+from .api import (  # noqa: F401
+    AcceleratedGradientDescent,
+    run,
+    run_minibatch_agd,
+    run_minibatch_sgd,
+)
+from .core.agd import AGDConfig, AGDResult  # noqa: F401
+from .parallel.mesh import (  # noqa: F401
+    ShardedBatch,
+    make_mesh,
+    replicate,
+    shard_batch,
+    shard_csr_batch,
+)
+from .ops.prox import (  # noqa: F401
+    Prox,
+    IdentityProx,
+    L2Prox,
+    MLlibSquaredL2Updater,
+    L1Prox,
+    ElasticNetProx,
+    SimpleUpdater,
+    SquaredL2Updater,
+    L1Updater,
+)
+from .ops.sparse import CSRMatrix  # noqa: F401
+from .data.streaming import StreamingDataset  # noqa: F401

@@ -1,0 +1,348 @@
+"""Public API — drop-in surface parity with the reference, TPU underneath.
+
+Mirrors the reference's L5 (SURVEY §1): the ``AcceleratedGradientDescent``
+class with its nine fluent setters and defaults (reference
+``AcceleratedGradientDescent.scala:44-51, :57-120``), ``optimize(data,
+initial_weights)`` (``:128``), the functional ``run(...) -> (weights,
+loss_history)`` (``:177-189``), and the ``run_minibatch_agd`` alias the
+north-star config names.  CamelCase aliases (``setConvergenceTol`` …) are
+provided so reference-style call sites port verbatim.
+
+What "data" is here: instead of an ``RDD[(Double, Vector)]`` the API takes
+``(X, y)`` arrays, an ``(X, y, mask)`` triple, or a ``parallel.mesh.
+ShardedBatch`` already placed on a mesh.  By default the optimizer runs
+distributed over every visible device (a ``data``-axis mesh) — the
+reference's executor parallelism with the driver round-trips deleted; pass
+``mesh=False`` to force single-device, or an explicit ``jax.sharding.Mesh``
+(e.g. with a ``model`` axis for wide softmax/MLP weights).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import agd, gd, smooth as smooth_lib
+from .ops.losses import Gradient
+from .ops.prox import Prox
+from .ops.sparse import CSRMatrix
+from .parallel import dist_smooth, mesh as mesh_lib
+
+Data = Union[Tuple, "mesh_lib.ShardedBatch"]
+
+
+def _normalize_data(data: Data):
+    """Accept (X, y), (X, y, mask), or ShardedBatch."""
+    if isinstance(data, mesh_lib.ShardedBatch):
+        return data
+    if isinstance(data, (tuple, list)):
+        if len(data) == 2:
+            return data[0], data[1], None
+        if len(data) == 3:
+            return data[0], data[1], data[2]
+    raise TypeError(
+        "data must be (X, y), (X, y, mask), or a ShardedBatch; got "
+        f"{type(data).__name__}")
+
+
+def _resolve_mesh(mesh):
+    """None → all-device data mesh (single-device short-circuits to local);
+    False → force local; a Mesh → as given."""
+    if mesh is False:
+        return None
+    if mesh is None:
+        devs = jax.devices()
+        if len(devs) == 1:
+            return None
+        return mesh_lib.make_mesh({mesh_lib.DATA_AXIS: len(devs)})
+    return mesh
+
+
+def _build_smooth(gradient, data, mesh, dist_mode):
+    if mesh is None:
+        if isinstance(data, mesh_lib.ShardedBatch):
+            X, y, mask = data
+        else:
+            X, y, mask = data
+            if not isinstance(X, CSRMatrix):
+                X = jnp.asarray(X)
+            y = jnp.asarray(y)
+            mask = None if mask is None else jnp.asarray(mask)
+        # One prepare() for BOTH factories — two separate calls would
+        # stage two full-size copies of a prepared layout (e.g. the
+        # Pallas tile padding) in HBM.
+        X, y, mask = gradient.prepare(X, y, mask)
+        return (smooth_lib.make_smooth(gradient, X, y, mask),
+                smooth_lib.make_smooth_loss(gradient, X, y, mask))
+    batch = (data if isinstance(data, mesh_lib.ShardedBatch)
+             else mesh_lib.shard_batch(mesh, data[0], data[1], data[2]))
+    return dist_smooth.make_dist_smooth(gradient, batch, mesh=mesh,
+                                        mode=dist_mode)
+
+
+def make_runner(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    mesh=None,
+    dist_mode: str = "shard_map",
+    loss_mode: str = "x",
+):
+    """Build ``fit(initial_weights) -> AGDResult``, compiled ONCE.
+
+    ``run()`` builds fresh closures per call, so jit's executable cache
+    misses and a second ``run()`` on the same problem re-traces and
+    re-compiles — fatal for repeated fits (hyper-parameter sweeps,
+    steady-state benchmarking).  The runner returned here carries one
+    ``jax.jit`` program; every ``fit`` after the first reuses it.
+    """
+    data = _normalize_data(data)
+    if isinstance(data, mesh_lib.ShardedBatch):
+        # A pre-placed batch carries its own mesh; recover it rather than
+        # defaulting to an all-device mesh the batch may not live on.
+        batch_mesh = data.X.sharding.mesh
+        if mesh is None:
+            mesh = batch_mesh
+        elif mesh is not False and mesh != batch_mesh:
+            raise ValueError(
+                "explicit mesh differs from the ShardedBatch's mesh; "
+                "re-shard the batch or drop the mesh argument")
+    if (not isinstance(data, mesh_lib.ShardedBatch)
+            and isinstance(data[0], CSRMatrix)):
+        # CSR rows shard over the data axis like dense rows do
+        # (mesh.shard_csr_batch, nnz-balanced); the GSPMD 'auto' mode
+        # cannot partition the segment-sum's row-id indirection, so the
+        # sparse mesh path always runs the explicit shard_map mode.
+        dist_mode = "shard_map"
+    m = _resolve_mesh(mesh)
+    sm, sl = _build_smooth(gradient, data, m, dist_mode)
+    px, rv = smooth_lib.make_prox(updater, reg_param)
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+    step = jax.jit(
+        lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
+
+    def fit(initial_weights):
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        if m is not None:
+            w0 = mesh_lib.replicate(w0, m)
+        return step(w0)
+
+    return fit
+
+
+def run(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    initial_weights: Any = None,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    mesh=None,
+    dist_mode: str = "shard_map",
+    loss_mode: str = "x",
+    return_result: bool = False,
+):
+    """Functional entry point, signature-parity with reference ``run``
+    (``:177-189``).  Returns ``(weights, loss_history)`` where
+    ``loss_history`` is a NumPy array with exactly one entry per executed
+    iteration (the reference's ``len(lossHistory) == iterations`` contract,
+    Suite:181-182).  ``return_result=True`` additionally returns the full
+    ``AGDResult`` diagnostics.  For repeated fits of the same problem use
+    ``make_runner`` (compiles once)."""
+    if initial_weights is None:
+        raise ValueError("initial_weights is required")
+    fit = make_runner(
+        data, gradient, updater, convergence_tol=convergence_tol,
+        num_iterations=num_iterations, reg_param=reg_param, l0=l0,
+        l_exact=l_exact, beta=beta, alpha=alpha, may_restart=may_restart,
+        mesh=mesh, dist_mode=dist_mode, loss_mode=loss_mode)
+    result = fit(initial_weights)
+    n = int(result.num_iters)
+    loss_history = np.asarray(result.loss_history)[:n]
+    if return_result:
+        return result.weights, loss_history, result
+    return result.weights, loss_history
+
+
+def run_minibatch_agd(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    minibatch_fraction: float = 1.0,
+    seed: int = 42,
+    **kwargs,
+):
+    """``runMiniBatchAGD`` entry point (named by the north-star config).
+
+    AGD's backtracking line search requires a *consistent* smooth function
+    across the evaluations of one run — per-iteration resampling (MLlib
+    SGD style) would make the Lipschitz estimates incoherent.  So the
+    mini-batch here is one fixed Bernoulli subsample of the dataset drawn
+    up front (deterministic in ``seed``), then full AGD on it.
+    """
+    if not 0.0 < minibatch_fraction <= 1.0:
+        raise ValueError("minibatch_fraction must be in (0, 1]")
+    if minibatch_fraction < 1.0:
+        X, y, mask = _normalize_data(data)
+        n = X.shape[0]
+        rng = np.random.default_rng(seed)
+        sample = (rng.random(n) < minibatch_fraction).astype(np.float32)
+        mask = sample if mask is None else np.asarray(mask) * sample
+        data = (X, y, mask)
+    return run(data, gradient, updater, **kwargs)
+
+
+class AcceleratedGradientDescent:
+    """Config-holder class, reference ``:41-144``: nine fluent setters with
+    the reference's defaults, one ``optimize``."""
+
+    def __init__(self, gradient: Gradient, updater: Prox):
+        self._gradient = gradient
+        self._updater = updater
+        self._convergence_tol = 1e-4
+        self._num_iterations = 100
+        self._reg_param = 0.0
+        self._l0 = 1.0
+        self._l_exact = math.inf
+        self._beta = 0.5
+        self._alpha = 0.9
+        self._may_restart = True
+        self._mesh = None
+        self._dist_mode = "shard_map"
+        self._loss_mode = "x"
+
+    # -- the nine reference setters (snake_case + camelCase parity) -------
+    def set_convergence_tol(self, tol: float):
+        self._convergence_tol = float(tol)
+        return self
+
+    def set_num_iterations(self, iters: int):
+        self._num_iterations = int(iters)
+        return self
+
+    def set_reg_param(self, reg_param: float):
+        self._reg_param = float(reg_param)
+        return self
+
+    def set_l0(self, l0: float):
+        self._l0 = float(l0)
+        return self
+
+    def set_lexact(self, l_exact: float):
+        self._l_exact = float(l_exact)
+        return self
+
+    def set_beta(self, beta: float):
+        self._beta = float(beta)
+        return self
+
+    def set_alpha(self, alpha: float):
+        self._alpha = float(alpha)
+        return self
+
+    def set_may_restart(self, may_restart: bool):
+        self._may_restart = bool(may_restart)
+        return self
+
+    def set_gradient(self, gradient: Gradient):
+        self._gradient = gradient
+        return self
+
+    def set_updater(self, updater: Prox):
+        self._updater = updater
+        return self
+
+    # TPU-specific knobs (beyond the reference surface)
+    def set_mesh(self, mesh):
+        self._mesh = mesh
+        return self
+
+    def set_loss_mode(self, loss_mode: str):
+        self._loss_mode = loss_mode
+        return self
+
+    def set_dist_mode(self, dist_mode: str):
+        """'shard_map' (explicit psum) or 'auto' (GSPMD; required for
+        model-axis tensor parallelism through this class)."""
+        self._dist_mode = dist_mode
+        return self
+
+    # camelCase aliases for verbatim ports of reference call sites
+    setConvergenceTol = set_convergence_tol
+    setNumIterations = set_num_iterations
+    setRegParam = set_reg_param
+    setL0 = set_l0
+    setLexact = set_lexact
+    setBeta = set_beta
+    setAlpha = set_alpha
+    setMayRestart = set_may_restart
+    setGradient = set_gradient
+    setUpdater = set_updater
+
+    def optimize(self, data: Data, initial_weights: Any):
+        """reference ``:128-144``: run and return the solution weights."""
+        weights, _ = run(
+            data, self._gradient, self._updater,
+            convergence_tol=self._convergence_tol,
+            num_iterations=self._num_iterations,
+            reg_param=self._reg_param,
+            initial_weights=initial_weights,
+            l0=self._l0, l_exact=self._l_exact, beta=self._beta,
+            alpha=self._alpha, may_restart=self._may_restart,
+            mesh=self._mesh, dist_mode=self._dist_mode,
+            loss_mode=self._loss_mode)
+        return weights
+
+
+def run_minibatch_sgd(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    step_size: float = 1.0,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    minibatch_fraction: float = 1.0,
+    initial_weights: Any = None,
+    seed: int = 42,
+):
+    """MLlib ``GradientDescent.runMiniBatchSGD`` equivalent — the oracle
+    the reference tests against (SURVEY §2.2); single-device evaluation.
+    Returns ``(weights, loss_history)``."""
+    if initial_weights is None:
+        raise ValueError("initial_weights is required")
+    X, y, mask = _normalize_data(data)
+    if not isinstance(X, CSRMatrix):
+        X = jnp.asarray(X)
+    y = jnp.asarray(y)
+    mask = None if mask is None else jnp.asarray(mask)
+    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+    res = jax.jit(
+        lambda w: gd.run_minibatch_sgd(
+            gradient, updater, X, y, w,
+            step_size=step_size, num_iterations=num_iterations,
+            reg_param=reg_param, minibatch_fraction=minibatch_fraction,
+            mask=mask, seed=seed))(w0)
+    return res.weights, np.asarray(res.loss_history)

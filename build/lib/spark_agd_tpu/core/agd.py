@@ -1,0 +1,357 @@
+"""Fused TPU-native AGD: the whole optimizer is ONE compiled XLA program.
+
+The reference runs a driver-orchestrated loop: per outer iteration it ships
+weights to executors by broadcast, tree-reduces (loss, grad) back, and does
+the Auslender–Teboulle / backtracking / restart math on the driver in Breeze
+(reference ``AcceleratedGradientDescent.scala:237-332``; cost shape SURVEY
+§3.1: 2-3 network round-trips per iteration).  Here the inversion promised by
+SURVEY §7: weights, data, and every recurrence live on device; the outer
+``for``/inner ``while(true)`` become nested ``lax.while_loop``s; the
+distributed reduce is whatever collective the mesh layer compiled into
+``smooth``; the host launches one program and reads back scalars at the end.
+
+Parity quirks carried over exactly (each tested against the NumPy oracle in
+``tests/test_agd_core.py``):
+
+- ``theta = +inf`` first-iteration identity (reference ``:226, :248``) —
+  IEEE ``x/inf == 0`` makes the first trial evaluate at ``w0``.
+- backtracking estimator switch ``backtrack_simple`` at tol 1e-10
+  (``:272-279``), and the infinite-localL L-update dance (``:285-292``).
+- loss history at x = ``f(x) + reg(x)`` (``:302-307``).  The reference pays
+  a third full distributed pass (loss AND gradient) for this; the gradient
+  of that pass is *discarded* (only the ``step=0`` prox trick uses it, which
+  ignores g).  We instead reuse the ``f(x)`` the backtracking loop already
+  computed — same argument, same kernel, agreeing to ~1 ulp (XLA may fuse
+  the two call sites differently) — and call ``reg_value`` directly.  One
+  fewer
+  full pass per iteration than the reference at identical numerics
+  (``loss_mode='x'``); ``'x_strict'`` recomputes like the reference for
+  cost-parity benchmarking; ``'y'`` is the cheaper variant the reference
+  left commented out (``:296-300``).
+- NaN/Inf loss guard (``:309-312``); convergence rules incl. the
+  ``nIter > 1`` gate on exact-zero steps (``:314-324``); O'Donoghue-Candes
+  gradient-test restart (``:326-331``).
+
+One deliberate deviation: the reference's inner ``while(true)`` spins forever
+if the loss goes NaN mid-backtracking (NaN comparisons are all false).  Here
+a non-finite ``f_y`` accepts the trial immediately so the outer NaN guard
+aborts the run, and ``max_backtracks`` (default 100, never hit on finite
+data) bounds the inner loop — both strictly safer, neither reachable on the
+oracle-parity test surface.
+
+Weights may be any pytree (``core.tvec``); scalars inherit the loss dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import tvec
+
+SmoothFn = Callable[[Any], Tuple[jax.Array, Any]]
+ProxFn = Callable[[Any, Any, jax.Array], Tuple[Any, jax.Array]]
+RegValFn = Callable[[Any], jax.Array]
+LossFn = Callable[[Any], jax.Array]
+
+
+@dataclass(frozen=True)
+class AGDConfig:
+    """The nine reference knobs (defaults from reference ``:44-51``) plus
+    the in-body constant ``backtrack_tol`` (``:235``) and fused-loop extras."""
+
+    convergence_tol: float = 1e-4
+    num_iterations: int = 100
+    l0: float = 1.0
+    l_exact: float = math.inf
+    beta: float = 0.5
+    alpha: float = 0.9
+    may_restart: bool = True
+    backtrack_tol: float = 1e-10
+    max_backtracks: int = 100
+    loss_mode: str = "x"  # 'x' | 'x_strict' | 'y'
+
+
+class AGDWarmState(NamedTuple):
+    """The complete inter-iteration carry of the optimizer — what SURVEY §5
+    calls "2 vectors + 3 scalars" (plus the estimator-switch flag): enough
+    to continue a run exactly where it stopped.  ``prior_iters`` feeds the
+    ``nIter > 1`` gate on exact-zero steps (reference ``:317-321``) so a
+    resumed run makes the same stop decisions as an uninterrupted one."""
+
+    x: Any
+    z: Any
+    theta: Any
+    big_l: Any
+    bts: Any
+    prior_iters: Any
+
+    @classmethod
+    def initial(cls, w0: Any, config: "AGDConfig") -> "AGDWarmState":
+        """The iteration-zero carry (reference init ``:224-235``): the ONE
+        definition all three drivers (fused, host, checkpointed) expand, so
+        cold start and resume-from-zero cannot drift apart."""
+        return cls(x=w0, z=w0, theta=math.inf, big_l=float(config.l0),
+                   bts=True, prior_iters=0)
+
+
+class AGDResult(NamedTuple):
+    weights: Any
+    loss_history: jax.Array  # (num_iterations,), NaN-padded past num_iters
+    num_iters: jax.Array  # iterations actually executed
+    aborted_non_finite: jax.Array
+    final_l: jax.Array  # Lipschitz estimate at exit
+    num_backtracks: jax.Array
+    num_restarts: jax.Array
+    # the carry needed to continue this run (checkpoint/resume; utils/)
+    final_z: Any
+    final_theta: jax.Array
+    final_bts: jax.Array
+    converged: jax.Array  # stopped by its own criteria (not cap, not abort)
+    # per-iteration diagnostics (NaN/0-padded): the values the reference
+    # computes and discards (SURVEY §5 metrics gap)
+    diag_l: jax.Array
+    diag_theta: jax.Array
+    diag_step: jax.Array
+    diag_restarted: jax.Array
+
+
+class _Outer(NamedTuple):
+    x: Any
+    z: Any
+    theta: jax.Array
+    big_l: jax.Array
+    bts: jax.Array  # backtrack_simple
+    it: jax.Array
+    done: jax.Array
+    aborted: jax.Array
+    loss_hist: jax.Array
+    n_bt: jax.Array
+    n_restart: jax.Array
+    diag_l: jax.Array
+    diag_theta: jax.Array
+    diag_step: jax.Array
+    diag_restarted: jax.Array
+
+
+class _Trial(NamedTuple):
+    theta: jax.Array
+    big_l: jax.Array
+    x: Any
+    y: Any
+    z: Any
+    f_y: jax.Array
+    g_y: Any
+    f_x: jax.Array  # f at the trial x (reused for loss history)
+    bts: jax.Array
+    accept: jax.Array
+    n_bt: jax.Array
+
+
+def run_agd(
+    smooth: SmoothFn,
+    prox: ProxFn,
+    reg_value: RegValFn,
+    w0: Any,
+    config: AGDConfig,
+    *,
+    smooth_loss: LossFn | None = None,
+    warm: AGDWarmState | None = None,
+) -> AGDResult:
+    """Pure, trace-compatible AGD.  Wrap in ``jax.jit`` (the API layer does).
+
+    ``smooth(w) -> (mean_loss, mean_grad)`` — built by the mesh layer, its
+    internals carry the cross-device reduction.  ``prox(w, g, step) ->
+    (w_new, reg_value)``; ``reg_value(w)`` reads the penalty without the
+    reference's ``step = 0`` prox trick (reference ``:305``).
+    ``smooth_loss(w) -> mean_loss`` is an optional loss-only evaluation used
+    by ``loss_mode='x'`` when backtracking is disabled (``beta >= 1``).
+
+    ``warm`` resumes from a saved ``AGDWarmState`` (``w0`` is then ignored
+    except as the structure template): the run continues bit-exactly where
+    the checkpointed one stopped, executing up to ``config.num_iterations``
+    *further* iterations.
+    """
+    cfg = config
+    if cfg.loss_mode not in ("x", "x_strict", "y"):
+        raise ValueError(f"unknown loss_mode {cfg.loss_mode!r}")
+
+    dt = jnp.promote_types(
+        jnp.result_type(*jax.tree_util.tree_leaves(w0)), jnp.float32)
+
+    def s(v):
+        return jnp.asarray(v, dt)
+
+    tol = s(cfg.convergence_tol)
+    l_exact = s(cfg.l_exact)
+    beta = s(cfg.beta)
+    btol = s(cfg.backtrack_tol)
+    backtracking = cfg.beta < 1.0  # static: trial-acceptance structure
+
+    def trial_cond(c: _Trial) -> jax.Array:
+        return jnp.logical_and(~c.accept, c.n_bt < cfg.max_backtracks)
+
+    def norm_smooth(w_like, out):
+        """Pin smooth outputs to the carry dtype: a smooth that computes
+        in a wider/narrower dtype (e.g. f64 data under x64 with f32
+        weights) must not leak its dtype into the while_loop carry —
+        that's a trace-time cond/carry mismatch."""
+        f, g = out
+        return s(f), tvec.tmap(lambda gi, wi: gi.astype(wi.dtype),
+                               g, w_like)
+
+    def make_trial_body(x_old, z_old, l_old, theta_old):
+        def trial_body(c: _Trial) -> _Trial:
+            theta = 2.0 / (1.0 + jnp.sqrt(
+                1.0 + 4.0 * (c.big_l / l_old) / (theta_old * theta_old)))
+            y = tvec.axpby(1.0 - theta, x_old, theta, z_old)
+            f_y, g_y = norm_smooth(x_old, smooth(y))
+            step = 1.0 / (theta * c.big_l)
+            z = prox(z_old, g_y, step)[0]
+            x = tvec.axpby(1.0 - theta, x_old, theta, z)
+
+            if not backtracking:
+                return _Trial(theta, c.big_l, x, y, z, f_y, g_y,
+                              s(jnp.nan), c.bts, jnp.asarray(True), c.n_bt)
+
+            xy = tvec.sub(x, y)
+            xy_sq = tvec.sq_norm(xy)
+            # Trivial accepts: exact-zero step (reference :263-267) or a
+            # non-finite f_y (deviation: defer to the outer NaN guard
+            # instead of spinning — see module docstring).
+            trivial = jnp.logical_or(xy_sq == 0.0, ~jnp.isfinite(f_y))
+
+            def accept_trivial(_):
+                # x == y exactly when xy_sq == 0, so f_x := f_y is exact.
+                return (f_y, jnp.asarray(True), c.big_l, c.bts)
+
+            def eval_fx(_):
+                f_x, g_x = norm_smooth(x_old, smooth(x))
+                q_x = f_y + tvec.dot(xy, g_y) + 0.5 * c.big_l * xy_sq
+                local_simple = (
+                    c.big_l + 2.0 * jnp.maximum(f_x - q_x, 0.0) / xy_sq)
+                local_curv = 2.0 * tvec.dot(xy, tvec.sub(g_x, g_y)) / xy_sq
+                local_l = jnp.where(c.bts, local_simple, local_curv)
+                bts_new = jnp.logical_and(
+                    c.bts,
+                    jnp.abs(f_y - f_x)
+                    >= btol * jnp.maximum(jnp.abs(f_x), jnp.abs(f_y)))
+                accept = jnp.logical_or(local_l <= c.big_l,
+                                        c.big_l >= l_exact)
+                # The L-update dance, reference :285-292: for finite localL
+                # first clamp L to min(Lexact, localL), then grow by 1/beta;
+                # infinite localL degrades to L/beta.
+                is_inf = jnp.isinf(local_l)
+                l1 = jnp.where(is_inf, c.big_l,
+                               jnp.minimum(l_exact, local_l))
+                local2 = jnp.where(is_inf, c.big_l, local_l)
+                l_next = jnp.minimum(l_exact,
+                                     jnp.maximum(local2, l1 / beta))
+                return (f_x, accept, jnp.where(accept, c.big_l, l_next),
+                        bts_new)
+
+            f_x, accept, big_l, bts = lax.cond(
+                trivial, accept_trivial, eval_fx, operand=None)
+            return _Trial(theta, big_l, x, y, z, f_y, g_y, f_x, bts, accept,
+                          c.n_bt + jnp.where(accept, 0, 1))
+
+        return trial_body
+
+    def outer_body(o: _Outer) -> _Outer:
+        x_old, z_old = o.x, o.z
+        l_old = o.big_l
+        big_l = o.big_l * s(cfg.alpha)
+        theta_old = o.theta
+
+        init = _Trial(
+            theta=o.theta, big_l=big_l, x=o.x, y=o.x, z=o.z,
+            f_y=s(0.0), g_y=tvec.zeros_like(o.x), f_x=s(jnp.nan),
+            bts=o.bts, accept=jnp.asarray(False),
+            n_bt=jnp.zeros((), jnp.int32))
+        body = make_trial_body(x_old, z_old, l_old, theta_old)
+        # Run the first trial unconditionally, then loop while rejected —
+        # the reference's do-while.
+        t = lax.while_loop(trial_cond, body, body(init))
+
+        # ---- loss history (reference :302-307 / commented :296-300) ----
+        if cfg.loss_mode == "y":
+            loss = t.f_y + s(reg_value(t.y))
+        elif cfg.loss_mode == "x_strict":
+            loss = s(smooth(t.x)[0]) + s(reg_value(t.x))
+        else:  # 'x': reuse the backtracking pass's f(x)
+            if backtracking:
+                loss = t.f_x + s(reg_value(t.x))
+            else:
+                ls = smooth_loss or (lambda w: smooth(w)[0])
+                loss = s(ls(t.x)) + s(reg_value(t.x))
+
+        it_new = o.it + 1
+        loss_hist = o.loss_hist.at[o.it].set(loss)
+
+        aborted = ~jnp.isfinite(t.f_y)  # NaN guard, reference :309-312
+        norm_x = tvec.norm(t.x)
+        norm_dx = tvec.norm(tvec.sub(t.x, x_old))
+        done_zero = jnp.logical_and(norm_dx == 0.0,
+                                    it_new + prior_iters > 1)
+        done_tol = norm_dx < tol * jnp.maximum(norm_x, 1.0)
+        done = aborted | done_zero | done_tol
+
+        # Restart (reference :326-331), only on the continue path.
+        restart = jnp.asarray(False)
+        if cfg.may_restart:
+            restart = jnp.logical_and(
+                tvec.dot(t.g_y, tvec.sub(t.x, x_old)) > 0.0, ~done)
+        z_new = tvec.tmap(
+            lambda zi, xi: jnp.where(restart, xi, zi), t.z, t.x)
+        theta_new = jnp.where(restart, s(jnp.inf), t.theta)
+        bts_new = jnp.logical_or(restart, t.bts)
+
+        return _Outer(
+            x=t.x, z=z_new, theta=theta_new, big_l=t.big_l, bts=bts_new,
+            it=it_new, done=done, aborted=aborted, loss_hist=loss_hist,
+            n_bt=o.n_bt + t.n_bt,
+            n_restart=o.n_restart + jnp.where(restart, 1, 0),
+            diag_l=o.diag_l.at[o.it].set(t.big_l),
+            diag_theta=o.diag_theta.at[o.it].set(t.theta),
+            diag_step=o.diag_step.at[o.it].set(1.0 / (t.theta * t.big_l)),
+            diag_restarted=o.diag_restarted.at[o.it].set(restart),
+        )
+
+    def outer_cond(o: _Outer) -> jax.Array:
+        return jnp.logical_and(o.it < cfg.num_iterations, ~o.done)
+
+    n = cfg.num_iterations
+    if warm is None:
+        warm = AGDWarmState.initial(w0, cfg)
+    x0, z0 = warm.x, warm.z
+    theta0, l_init = s(warm.theta), s(warm.big_l)
+    bts0 = jnp.asarray(warm.bts, jnp.bool_)
+    prior_iters = jnp.asarray(warm.prior_iters, jnp.int32)
+    init = _Outer(
+        x=x0, z=z0,
+        theta=theta0, big_l=l_init, bts=bts0,
+        it=jnp.zeros((), jnp.int32), done=jnp.asarray(False),
+        aborted=jnp.asarray(False),
+        loss_hist=jnp.full((n,), jnp.nan, dt),
+        n_bt=jnp.zeros((), jnp.int32), n_restart=jnp.zeros((), jnp.int32),
+        diag_l=jnp.full((n,), jnp.nan, dt),
+        diag_theta=jnp.full((n,), jnp.nan, dt),
+        diag_step=jnp.full((n,), jnp.nan, dt),
+        diag_restarted=jnp.zeros((n,), jnp.bool_),
+    )
+    o = lax.while_loop(outer_cond, outer_body, init) if n > 0 else init
+
+    return AGDResult(
+        weights=o.x, loss_history=o.loss_hist, num_iters=o.it,
+        aborted_non_finite=o.aborted, final_l=o.big_l,
+        num_backtracks=o.n_bt, num_restarts=o.n_restart,
+        final_z=o.z, final_theta=o.theta, final_bts=o.bts,
+        converged=jnp.logical_and(o.done, ~o.aborted),
+        diag_l=o.diag_l, diag_theta=o.diag_theta, diag_step=o.diag_step,
+        diag_restarted=o.diag_restarted,
+    )

@@ -1,0 +1,109 @@
+"""Mini-batch gradient descent with spark-mllib 1.3.0 semantics.
+
+The reference's entire test strategy is equivalence against MLlib's
+``GradientDescent.runMiniBatchSGD`` (reference Suite:78-86, :118-126,
+:225-233 — SURVEY §4 calls it the correctness oracle).  To reproduce that
+strategy the framework must carry its own GD comparator with the *same*
+semantics, faithfully including the parts the AGD path deliberately
+bypasses:
+
+- the hidden per-iteration step rescaling ``stepSize / sqrt(iter)`` that
+  MLlib updaters apply (the reference defeats it with ``iter = 1`` at
+  ``AcceleratedGradientDescent.scala:218-219``; GD *keeps* it);
+- loss-history entry i = smooth loss at the pre-update weights plus the
+  regularization value carried over from the *previous* update (seeded by a
+  ``step = 0`` updater call before the loop);
+- Bernoulli mini-batch sampling per iteration (``miniBatchFraction``),
+  dividing by the realised batch size, skipping the update only when the
+  sample is empty;
+- no convergence test — GD always runs all ``num_iterations`` (Spark 1.3
+  behavior; convergence-tol arrived in Spark 1.5).
+
+Compiled as one ``lax.fori_loop`` program; sampling uses a per-iteration
+Bernoulli mask folded into the kernels' mask argument, so shapes stay
+static (the TPU answer to ``RDD.sample``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import tvec
+from ..ops.losses import Gradient
+from ..ops.prox import Prox
+
+
+class GDResult(NamedTuple):
+    weights: Any
+    loss_history: jax.Array  # (num_iterations,)
+
+
+def run_minibatch_sgd(
+    gradient: Gradient,
+    updater: Prox,
+    X,
+    y,
+    initial_weights,
+    *,
+    step_size: float = 1.0,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    minibatch_fraction: float = 1.0,
+    mask=None,
+    seed: int = 42,
+) -> GDResult:
+    """Trace-compatible MLlib-1.3 ``runMiniBatchSGD``.  ``mask`` is the
+    data-layer padding mask; sampling masks compose with it."""
+    full_batch = minibatch_fraction >= 1.0
+    base_key = jax.random.PRNGKey(seed)
+    w0 = initial_weights
+    dt = jnp.promote_types(
+        jnp.result_type(*jax.tree_util.tree_leaves(w0)), jnp.float32)
+
+    # Seed regVal exactly as MLlib does: an updater call with step 0 at the
+    # initial weights (the same step=0 identity the reference leans on).
+    reg_val0 = jnp.asarray(
+        updater.prox(w0, tvec.zeros_like(w0), 0.0, reg_param)[1], dt)
+
+    n_rows = X.shape[0]
+
+    def body(i, carry):
+        w, reg_val, hist = carry
+        it = i + 1  # MLlib iterations are 1-based
+
+        if full_batch:
+            it_mask = mask
+        else:
+            key = jax.random.fold_in(base_key, it)
+            sample = jax.random.bernoulli(
+                key, minibatch_fraction, (n_rows,)).astype(dt)
+            it_mask = sample if mask is None else sample * jnp.asarray(
+                mask, dt)
+
+        loss_sum, grad_sum, n = gradient.batch_loss_and_grad(
+            w, X, y, it_mask)
+        nf = jnp.asarray(n, dt)
+        nonempty = nf > 0
+
+        loss = jnp.where(nonempty, loss_sum / jnp.maximum(nf, 1.0), 0.0)
+        hist = hist.at[i].set(jnp.where(nonempty, loss + reg_val,
+                                        jnp.asarray(jnp.nan, dt)))
+
+        # MLlib's hidden rescaling, applied by the driver here because our
+        # prox operators are rescaling-free by design.
+        this_step = step_size / jnp.sqrt(jnp.asarray(it, dt))
+        g_mean = tvec.scale(1.0 / jnp.maximum(nf, 1.0), grad_sum)
+        w_new, reg_new = updater.prox(w, g_mean, this_step, reg_param)
+        # empty sample: skip the update entirely (MLlib logs and continues)
+        w = tvec.tmap(lambda a, b: jnp.where(nonempty, b, a), w, w_new)
+        reg_val = jnp.where(nonempty, reg_new, reg_val)
+        return w, reg_val, hist
+
+    hist0 = jnp.zeros((num_iterations,), dt)
+    w, _, hist = lax.fori_loop(0, num_iterations, body,
+                               (w0, reg_val0, hist0))
+    return GDResult(weights=w, loss_history=hist)

@@ -37,9 +37,6 @@ class HostAGDResult(NamedTuple):
     final_z: Any = None
     final_theta: float = math.inf
     final_bts: bool = True
-    # stopped by its own criteria (not the cap, not an abort) — the
-    # fused loop's `converged` semantics (core/agd.py)
-    converged: bool = False
 
 
 def run_agd_host(
@@ -71,7 +68,6 @@ def run_agd_host(
     n_bt = 0
     n_restart = 0
     aborted = False
-    stopped_by_criteria = False
     backtracking = cfg.beta < 1.0
 
     for n_iter in range(prior_iters + 1, prior_iters + cfg.num_iterations + 1):
@@ -168,15 +164,13 @@ def run_agd_host(
             on_iteration(_carry(x, z, theta, big_l, bts, n_iter,
                                 loss_hist[-1], stopped=stop, last=last))
         if stop:
-            stopped_by_criteria = True
             break
 
     return HostAGDResult(
         weights=x, loss_history=np.asarray(loss_hist),
         num_iters=len(loss_hist), aborted_non_finite=aborted,
         final_l=big_l, num_backtracks=n_bt, num_restarts=n_restart,
-        final_z=z, final_theta=theta, final_bts=bts,
-        converged=stopped_by_criteria)
+        final_z=z, final_theta=theta, final_bts=bts)
 
 
 def _carry(x, z, theta, big_l, bts, n_iter, loss, aborted=False,

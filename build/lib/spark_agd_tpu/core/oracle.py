@@ -1,0 +1,165 @@
+"""Plain-NumPy TFOCS-AT oracle — the executable specification of AGD.
+
+The reference's only correctness spec is "final loss within 2% of MLlib GD"
+(reference Suite:88-91).  SURVEY §7 step 2 calls for something much stronger:
+a driver-style NumPy implementation of the exact recurrences of
+``AcceleratedGradientDescent.run`` (reference
+``AcceleratedGradientDescent.scala:224-332``) that the compiled TPU
+implementation must match *step by step* in float64.  This file is that
+oracle.  It is deliberately written as a slow, obvious, sequential Python
+loop over flat NumPy vectors — no JAX — so that any disagreement with the
+compiled path localises the bug to the compiled path.
+
+Semantics covered (each with its reference citation):
+
+- Auslender–Teboulle acceleration with ``theta = +inf`` first-iteration
+  identity (``:226, :248``)
+- backtracking line search with the simple/curvature estimator switch at
+  tolerance 1e-10 (``:261-293``, switch ``:272-279``, tol ``:235``)
+- the L-update dance including the infinite-localL quirk (``:285-292``)
+- loss history at x: ``f(x) + reg(x)`` — a third distributed pass in the
+  reference (``:302-307``)
+- NaN/Inf loss guard (``:309-312``)
+- convergence: exact-zero step only counts after iteration 1; relative
+  tolerance vs ``max(‖x‖, 1)`` (``:314-324``)
+- O'Donoghue–Candes gradient-test restart (``:326-331``)
+
+The oracle counts ``smooth`` evaluations so tests can also pin the
+2-3-passes-per-iteration cost shape (SURVEY §3.1).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+
+@dataclass
+class OracleResult:
+    weights: np.ndarray
+    loss_history: List[float]
+    num_smooth_calls: int
+    num_backtracks: int
+    num_restarts: int
+    aborted_non_finite: bool
+
+
+def run_oracle(
+    smooth: Callable[[np.ndarray], Tuple[float, np.ndarray]],
+    prox: Callable[[np.ndarray, np.ndarray, float], Tuple[np.ndarray, float]],
+    w0: np.ndarray,
+    *,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    backtrack_tol: float = 1e-10,
+    max_backtracks: int = 100,
+) -> OracleResult:
+    """Run the TFOCS-AT recurrence exactly as the reference driver does.
+
+    ``smooth(w) -> (mean_loss, mean_grad)``; ``prox(w, g, step) ->
+    (w_new, reg_value)`` with the ``step = 0`` identity.  ``max_backtracks``
+    is a safety bound absent from the reference (whose inner ``while(true)``
+    can spin forever on NaN losses); it is set high enough to never trigger
+    on finite data.
+    """
+    calls = {"n": 0}
+
+    def smooth_counted(w):
+        calls["n"] += 1
+        return smooth(w)
+
+    x = np.array(w0, dtype=np.float64, copy=True)
+    z = x
+    theta = math.inf
+    L = float(l0)
+    backtrack_simple = True
+    loss_history: List[float] = []
+    n_backtracks = 0
+    n_restarts = 0
+    aborted = False
+
+    for n_iter in range(1, num_iterations + 1):
+        x_old, z_old = x, z
+        L_old = L
+        L = L * alpha
+        theta_old = theta
+
+        f_y = 0.0
+        g_y = np.zeros_like(x)
+        y = x
+        for bt in range(max_backtracks):
+            theta = 2.0 / (1.0 + math.sqrt(
+                1.0 + 4.0 * (L / L_old) / (theta_old * theta_old)))
+            y = (1.0 - theta) * x_old + theta * z_old
+            f_y, g_y = smooth_counted(y)
+            step = 1.0 / (theta * L)
+            z = prox(z_old, g_y, step)[0]
+            x = (1.0 - theta) * x_old + theta * z
+
+            if beta >= 1.0:
+                break
+
+            xy = x - y
+            xy_sq = float(xy @ xy)
+            if xy_sq == 0.0:
+                break
+
+            f_x, g_x = smooth_counted(x)
+            if backtrack_simple:
+                q_x = f_y + float(xy @ g_y) + 0.5 * L * xy_sq
+                local_l = L + 2.0 * max(f_x - q_x, 0.0) / xy_sq
+                backtrack_simple = (
+                    abs(f_y - f_x)
+                    >= backtrack_tol * max(abs(f_x), abs(f_y)))
+            else:
+                local_l = 2.0 * float(xy @ (g_x - g_y)) / xy_sq
+
+            if local_l <= L or L >= l_exact:
+                break
+
+            n_backtracks += 1
+            if not math.isinf(local_l):
+                L = min(l_exact, local_l)
+            else:
+                local_l = L
+            L = min(l_exact, max(local_l, L / beta))
+
+        # Loss history at x (TFOCS-validation mode, reference :302-307):
+        # a third full pass in the reference; the oracle mirrors it.
+        f_x_hist, g_x_hist = smooth_counted(x)
+        c_x = prox(x, g_x_hist, 0.0)[1]
+        loss_history.append(f_x_hist + c_x)
+
+        if math.isnan(f_y) or math.isinf(f_y):
+            aborted = True
+            break
+
+        norm_x = float(np.linalg.norm(x))
+        norm_dx = float(np.linalg.norm(x - x_old))
+        if norm_dx == 0.0 and n_iter > 1:
+            break
+        if norm_dx < convergence_tol * max(norm_x, 1.0):
+            break
+
+        if may_restart and float(g_y @ (x - x_old)) > 0.0:
+            z = x
+            theta = math.inf
+            backtrack_simple = True
+            n_restarts += 1
+
+    return OracleResult(
+        weights=x,
+        loss_history=loss_history,
+        num_smooth_calls=calls["n"],
+        num_backtracks=n_backtracks,
+        num_restarts=n_restarts,
+        aborted_non_finite=aborted,
+    )

@@ -1,0 +1,63 @@
+"""Builders turning (Gradient, data) into the ``smooth(w) -> (f, g)`` the
+optimizer core consumes.
+
+This is the single-device analogue of the reference's ``applySmooth``
+(reference ``AcceleratedGradientDescent.scala:192-208``): mean loss and mean
+gradient over the full dataset.  No broadcast, no tree-reduce — the data is
+already device-resident and XLA fuses the mean into the kernels.  The mesh-
+sharded builders live in ``parallel/`` and have the same signature, so the
+core never knows whether its reduction crossed a chip boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import tvec
+from ..ops.losses import Gradient
+from ..ops.prox import Prox
+
+
+def make_smooth(gradient: Gradient, X, y, mask=None) -> Callable:
+    """``smooth(w) -> (mean_loss, mean_grad)`` over one in-memory batch.
+
+    ``gradient.prepare`` runs ONCE here, at data-placement time, so
+    kernels with a staged layout (the Pallas tile padding) never re-stage
+    inside the compiled optimizer loop."""
+    X, y, mask = gradient.prepare(X, y, mask)
+
+    def smooth(w):
+        return gradient.mean_loss_and_grad(w, X, y, mask)
+
+    return smooth
+
+
+def make_smooth_loss(gradient: Gradient, X, y, mask=None) -> Callable:
+    """Loss-only evaluation (no gradient) — used by ``loss_mode='x'`` when
+    backtracking is off.  Falls back to the full kernel; specialised
+    loss-only kernels can override later."""
+    X, y, mask = gradient.prepare(X, y, mask)
+
+    def smooth_loss(w):
+        loss_sum, _, n = gradient.batch_loss_and_grad(w, X, y, mask)
+        return loss_sum / jnp.asarray(n, loss_sum.dtype)
+
+    return smooth_loss
+
+
+def make_prox(p: Prox, reg_param: float):
+    """Close a ``Prox`` over its regularization parameter: the pair
+    ``(prox(w, g, step), reg_value(w))`` the core consumes (the reference
+    threads ``regParam`` through every ``Updater.compute`` call instead,
+    reference ``:215-220``)."""
+
+    def prox(w, g, step):
+        return p.prox(w, g, step, reg_param)
+
+    def reg_value(w):
+        return p.reg_value(w, reg_param)
+
+    return prox, reg_value

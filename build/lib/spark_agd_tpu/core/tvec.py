@@ -1,0 +1,95 @@
+"""Vector-space algebra over arbitrary JAX pytrees.
+
+The reference optimizer does all of its driver-side math on flat Breeze
+``DenseVector``s (reference ``AcceleratedGradientDescent.scala:224-331``:
+axpy-style recurrences, dot products, norms).  A TPU-native framework should
+not force every model into a flat vector: the optimizer state is naturally a
+*pytree* of device arrays (a GLM weight vector, a ``(D, K)`` softmax matrix,
+or a full MLP parameter tree), and every recurrence the algorithm needs is a
+vector-space operation that maps leafwise.
+
+This module provides exactly that vector-space contract: ``add``, ``sub``,
+``scale``, ``axpby``, ``dot``, ``norm``, ``zeros_like`` over pytrees.  All
+functions are pure ``jnp`` and jit-safe; reductions (``dot``, ``norm``)
+return 0-d arrays so they compose into ``lax.while_loop`` carries without
+host sync.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tmap(fn, *trees):
+    """``jax.tree_util.tree_map`` shorthand."""
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def add(a, b):
+    return tmap(jnp.add, a, b)
+
+
+def sub(a, b):
+    return tmap(jnp.subtract, a, b)
+
+
+def scale(s, a):
+    return tmap(lambda x: s * x, a)
+
+
+def axpby(alpha, a, beta, b):
+    """``alpha * a + beta * b`` leafwise (the AT interpolation primitive)."""
+    return tmap(lambda x, y: alpha * x + beta * y, a, b)
+
+
+def _reduce_leaves(parts):
+    if not parts:
+        return jnp.zeros(())
+    return sum(parts[1:], parts[0])
+
+
+def dot(a, b):
+    """Full inner product across all leaves (accumulated in the leaf dtype).
+
+    Uses tree_map (not a bare zip) so mismatched tree structures raise
+    instead of silently truncating.
+    """
+    parts = jax.tree_util.tree_leaves(tmap(jnp.vdot, a, b))
+    return _reduce_leaves(parts)
+
+
+def sq_norm(a):
+    return dot(a, a)
+
+
+def norm(a):
+    return jnp.sqrt(sq_norm(a))
+
+
+def zeros_like(a):
+    return tmap(jnp.zeros_like, a)
+
+
+def cast(a, dtype):
+    return tmap(lambda x: x.astype(dtype), a)
+
+
+def size(a):
+    """Total element count across leaves (static python int)."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(a))
+
+
+def l1_norm(a):
+    leaves = [jnp.sum(jnp.abs(x)) for x in jax.tree_util.tree_leaves(a)]
+    return _reduce_leaves(leaves)
+
+
+def isfinite_all(a):
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(a)]
+    if not leaves:
+        return jnp.asarray(True)
+    out = leaves[0]
+    for l in leaves[1:]:
+        out = jnp.logical_and(out, l)
+    return out

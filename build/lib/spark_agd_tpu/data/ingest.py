@@ -1,0 +1,140 @@
+"""Partitioned-file ingest — the Spark-seam adapter (SURVEY §7 step 5).
+
+The north star keeps Spark "only as the ingest layer": upstream, a Spark
+job (or any writer) materializes the dataset as K partition files; here
+each HOST of the SPMD job reads only its own subset of those partitions
+and the shards are assembled into one global mesh-sharded array — the
+structural equivalent of "Spark shards RDD[LabeledPoint] onto the mesh"
+with no JVM in the serving path (VERDICT r1 item 9).
+
+Contract (every host runs the same code — jax.distributed SPMD):
+
+- ``paths`` is the SAME full partition list on every host (sorted
+  internally, so any consistent enumeration works);
+- host p reads partitions ``paths[p::process_count]`` (round-robin, so a
+  size-skewed tail spreads instead of landing on the last host);
+- per-host row counts and the inferred feature width are equalized with
+  one ``process_allgather``; hosts pad their local block to the common
+  height with mask-0 rows (the kernels' padding contract keeps all sums
+  exact — ``ops.losses._as_mask``);
+- ``jax.make_array_from_process_local_data`` assembles the global
+  (N_padded, D) array, row-sharded over the mesh ``data`` axis.
+
+Single-process (tests, one chip) degenerates to: read everything, shard
+like ``mesh.shard_batch`` — same return type, no branching in callers.
+
+Multi-host assembly currently densifies rows (the MXU path); CSR data in
+a single process routes through ``mesh.shard_csr_batch`` instead.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as mesh_lib
+from . import libsvm
+
+
+def _allgather_max(value: int) -> int:
+    """Max of a per-host int across the SPMD job (identity when
+    single-process)."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([value], np.int64))
+    return int(np.max(gathered))
+
+
+def local_partitions(paths: Sequence[str]) -> list:
+    """The partition files THIS host reads: round-robin over the sorted
+    list (Spark's even-ish task assignment, minus locality)."""
+    paths = sorted(paths)
+    return paths[jax.process_index()::jax.process_count()]
+
+
+def from_partitioned_files(
+    paths: Sequence[str],
+    mesh=None,
+    *,
+    n_features: Optional[int] = None,
+    dtype=np.float32,
+    binarize_labels: bool = True,
+    loader: Optional[Callable[..., "libsvm.CSRData"]] = None,
+    axis: str = mesh_lib.DATA_AXIS,
+) -> mesh_lib.ShardedBatch:
+    """Load one LIBSVM partition set into a mesh-sharded batch.
+
+    ``loader(path, n_features=...) -> CSRData`` defaults to the LIBSVM
+    reader (native C++ parser when built); swap it for a parquet/npz
+    reader with the same return shape.  ``n_features`` pins the global
+    width; when omitted it is inferred as the max across ALL hosts'
+    partitions (one allgather).  Labels are mapped to {0,1} unless
+    ``binarize_labels=False`` (multinomial class ids).
+
+    Returns a :class:`~spark_agd_tpu.parallel.mesh.ShardedBatch` whose
+    mask excludes inter-host padding rows; feed it straight to
+    ``api.run`` / ``dist_smooth.make_dist_smooth``.
+    """
+    if not paths:
+        raise ValueError("no partition files")
+    loader = loader or libsvm.load_libsvm
+    mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+        {axis: len(jax.devices())})
+
+    parts = [loader(p, n_features=n_features) for p in local_partitions(paths)]
+    d = n_features or _allgather_max(
+        max((part.n_features for part in parts), default=0))
+    if d == 0:
+        raise ValueError("could not infer n_features (all partitions "
+                         "empty on this host and none given)")
+
+    ys, Xs = [], []
+    for part in parts:
+        ys.append(part.binarized_labels() if binarize_labels
+                  else np.asarray(part.labels))
+        Xs.append(part.to_dense(d, dtype=dtype))
+    n_local = int(sum(len(y) for y in ys))
+    X_local = (np.concatenate(Xs) if Xs
+               else np.zeros((0, d), dtype))
+    y_local = (np.concatenate(ys).astype(np.float32) if ys
+               else np.zeros((0,), np.float32))
+
+    if jax.process_count() == 1:
+        return mesh_lib.shard_batch(mesh, X_local, y_local, axis=axis)
+
+    # Equalize per-host block heights (allgather max), rounding up so the
+    # global row count splits evenly over the data axis; padding rows are
+    # mask-0 and exact no-ops in every kernel sum.  The even split is only
+    # guaranteed when the axis divides across processes evenly — the
+    # standard SPMD layout; reject anything else loudly.
+    n_dev_axis = mesh.shape[axis]
+    if n_dev_axis % jax.process_count():
+        raise ValueError(
+            f"mesh axis {axis!r} has {n_dev_axis} devices, not divisible "
+            f"by {jax.process_count()} processes; per-host shard assembly "
+            f"needs an even device-per-process split")
+    per_host_quantum = n_dev_axis // jax.process_count()
+    rows_host = _allgather_max(n_local)
+    rows_host = -(-rows_host // per_host_quantum) * per_host_quantum
+    pad = rows_host - n_local
+    mask_local = np.concatenate(
+        [np.ones(n_local, np.float32), np.zeros(pad, np.float32)])
+    X_local = np.concatenate(
+        [X_local, np.zeros((pad, d), X_local.dtype)])
+    y_local = np.concatenate([y_local, np.zeros(pad, np.float32)])
+
+    n_global = rows_host * jax.process_count()
+    row_spec = NamedSharding(mesh, P(axis))
+    Xg = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(axis, None)), X_local, (n_global, d))
+    yg = jax.make_array_from_process_local_data(
+        row_spec, y_local, (n_global,))
+    mg = jax.make_array_from_process_local_data(
+        row_spec, mask_local, (n_global,))
+    return mesh_lib.ShardedBatch(Xg, yg, mg)

@@ -1,0 +1,104 @@
+"""LIBSVM reader — ingest for the sparse benchmark configs.
+
+The reference reads data through Spark's text RDDs (``MLUtils.loadLibSVMFile``
+in typical spark-agd usage); BASELINE configs 1 and 3 (rcv1.binary,
+url_combined) are LIBSVM files.  This reader uses the native C++ parser
+(``native/libsvm_parser.cpp``) when available and a pure-Python tokenizer
+otherwise — same output either way: a CSR triple plus labels.
+
+Sparse-on-TPU strategy (SURVEY §7 hard part 3): the MXU wants dense tiles,
+so the default materialisation is row-dense (``to_dense``) for datasets
+whose D fits HBM (rcv1: ~47k features is fine at bf16/f32 for moderate
+batches); truly huge-D data stays CSR and flows through the segment-sum
+kernel in ``ops.sparse`` or streams via ``data.streaming``.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from .. import native
+
+
+class CSRData(NamedTuple):
+    """Labels + CSR features; the LabeledPoint collection analogue."""
+
+    labels: np.ndarray  # (n,) float64
+    indptr: np.ndarray  # (n+1,) int64
+    indices: np.ndarray  # (nnz,) int32, 0-based
+    values: np.ndarray  # (nnz,) float32
+    n_features: int
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.labels)
+
+    def to_dense(self, n_features: Optional[int] = None,
+                 dtype=np.float32) -> np.ndarray:
+        d = n_features or self.n_features
+        X = np.zeros((self.n_rows, d), dtype=dtype)
+        for i in range(self.n_rows):
+            s, e = self.indptr[i], self.indptr[i + 1]
+            X[i, self.indices[s:e]] = self.values[s:e]
+        return X
+
+    def binarized_labels(self) -> np.ndarray:
+        """Map {-1,+1} or {0,1} labels to {0,1} (the kernels' convention;
+        MLlib requires the same)."""
+        y = np.asarray(self.labels)
+        return (y > 0).astype(np.float64)
+
+
+def load_libsvm(path: str, n_features: Optional[int] = None,
+                force_python: bool = False) -> CSRData:
+    """Parse a LIBSVM file.  ``n_features`` overrides the inferred feature
+    count (pass it when a test split lacks the train split's tail
+    features)."""
+    parsed = None if force_python else native.parse_libsvm_native(path)
+    if parsed is None:
+        parsed = _parse_python(path)
+    labels, indptr, indices, values, inferred = parsed
+    return CSRData(labels, indptr, indices, values,
+                   int(n_features or inferred))
+
+
+def _parse_python(path: str):
+    """Pure-Python fallback tokenizer (slow but dependency-free)."""
+    labels, indptr, indices, values = [], [0], [], []
+    max_idx = -1
+    with io.open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                idx_s, val_s = tok.split(":", 1)
+                idx = int(idx_s) - 1
+                if idx < 0:
+                    raise ValueError(f"bad 1-based index in {tok!r}")
+                max_idx = max(max_idx, idx)
+                indices.append(idx)
+                values.append(float(val_s))
+            indptr.append(len(indices))
+    return (np.asarray(labels, np.float64),
+            np.asarray(indptr, np.int64),
+            np.asarray(indices, np.int32),
+            np.asarray(values, np.float32),
+            max_idx + 1)
+
+
+def save_libsvm(path: str, X, y) -> None:
+    """Write dense (X, y) as LIBSVM text (test/bench fixture helper)."""
+    X = np.asarray(X)
+    y = np.asarray(y)
+    with io.open(path, "w", encoding="utf-8") as f:
+        for i in range(X.shape[0]):
+            row = X[i]
+            nz = np.nonzero(row)[0]
+            toks = " ".join(f"{j + 1}:{row[j]:.9g}" for j in nz)
+            f.write(f"{y[i]:.9g} {toks}\n")

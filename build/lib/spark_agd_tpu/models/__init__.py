@@ -1,0 +1,24 @@
+"""Model layer: the ``GeneralizedLinearAlgorithm``-style callers the
+reference's optimizer was built to plug into (see ``glm.py``), plus the
+two-layer-MLP custom gradient of BASELINE config 5 (``mlp.py``)."""
+
+from .glm import (  # noqa: F401
+    GLMModel,
+    GeneralizedLinearAlgorithm,
+    LinearRegressionModel,
+    LinearRegressionWithAGD,
+    LogisticRegressionModel,
+    LogisticRegressionWithAGD,
+    SVMModel,
+    SVMWithAGD,
+    SoftmaxRegressionModel,
+    SoftmaxRegressionWithAGD,
+)
+from .mlp import (  # noqa: F401
+    MLPClassifierWithAGD,
+    MLPModel,
+    init_mlp_params,
+    make_mlp_loss_sum,
+    mlp_forward,
+    mlp_gradient,
+)

@@ -1,0 +1,1 @@
+"""spark_agd_tpu.ops subpackage."""

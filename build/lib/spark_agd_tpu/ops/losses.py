@@ -1,0 +1,250 @@
+"""Batched loss/gradient kernels — the TPU-native ``Gradient`` contract.
+
+The reference's ``Gradient`` plugin (spark-mllib 1.3.0, used per-example inside
+the ``treeAggregate`` seqOp at reference ``AcceleratedGradientDescent.scala:
+196-204``) computes one example's loss and accumulates its gradient in place.
+On TPU that per-example, in-place formulation is exactly wrong: the idiomatic
+kernel is a *batched* ``loss_and_grad(w, X, y) -> (loss_sum, grad_sum, n)``
+whose matmuls land on the MXU and whose elementwise tails XLA fuses into them.
+
+Every kernel here returns **sums**, not means — matching the seqOp/combOp
+accumulation of the reference; the mean (reference ``:207``) is applied by the
+caller after the cross-device reduction.  That split is load-bearing for the
+streaming path: macro-batch partial sums accumulate associatively before one
+global division.
+
+Numerical conventions follow the *pinned* spark-mllib 1.3.0 formulas (pin at
+reference ``build.sbt:7``) so the oracle-equivalence tests carry over:
+
+- ``LogisticGradient``  — binary; loss ``softplus(-x·w) - (1-y)(-x·w)``,
+  grad ``(sigmoid(x·w) - y)·x``  (labels in {0,1}).
+- ``LeastSquaresGradient`` — loss ``(x·w - y)^2`` (NOT halved — the 1.3
+  convention), grad ``2(x·w - y)·x``.
+- ``HingeGradient`` — labels {0,1} mapped to {-1,+1}; active when
+  ``s·(x·w) < 1``; loss ``1 - s(x·w)``, grad ``-s·x``.
+- ``SoftmaxGradient`` — NEW (Spark 1.3 had no multinomial): weight matrix
+  ``(D, K)``, loss ``-log softmax(x·W)[y]``, grad ``x ⊗ (softmax - onehot)``.
+- ``CustomGradient`` — any pytree-parameterised batch loss, differentiated
+  with ``jax.grad`` (the "custom Gradient for a two-layer MLP" path of
+  BASELINE config 5).
+
+All kernels are pure functions of ``(weights, X, y)`` and jit/vmap/shard_map
+safe.  Gradients are hand-derived closed forms (cheaper and explicit) and are
+unit-tested against ``jax.grad`` of the loss in ``tests/test_losses.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .sparse import matvec, rmatvec
+
+Array = jax.Array
+
+
+def _count(X, mask=None) -> Array:
+    """Batch example count (valid examples only, when masked), in the widest
+    enabled integer dtype.
+
+    The reference accumulates counts as Long (``0L``, reference ``:196``);
+    here a single kernel call sees one in-memory batch (N < 2^31 by
+    construction), and the *global* count across devices/macro-batches is
+    accumulated by the reduce/streaming layer — in int64 under x64, and as
+    host Python ints on the streaming path — so billion-row totals never
+    wrap.
+    """
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    if mask is None:
+        return jnp.asarray(X.shape[0], dt)
+    return jnp.sum(mask > 0).astype(dt)
+
+
+def _as_mask(mask, dtype):
+    """Cast a {0,1} per-example mask to the compute dtype; returns None when
+    no mask was given (callers branch and skip the multiplies).  Masks exist
+    so the sharding/data layers can pad batches to equal per-device sizes
+    without perturbing the (loss, grad, count) sums — padding rows simply
+    carry mask 0."""
+    if mask is None:
+        return None
+    return jnp.asarray(mask).astype(dtype)
+
+
+class Gradient:
+    """Protocol: batched smooth-loss plugin.
+
+    ``batch_loss_and_grad(weights, X, y) -> (loss_sum, grad_sum, count)``
+    where ``grad_sum`` has the same pytree structure as ``weights`` and
+    ``count`` is the number of examples in the batch (0-d array).
+
+    Equivalent of the spark-mllib ``Gradient`` abstract class as consumed at
+    reference ``AcceleratedGradientDescent.scala:198``, re-shaped from
+    per-example accumulation to one MXU-friendly batched evaluation.
+    """
+
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        """``mask`` (optional, (N,) of {0,1}): padding rows carry 0 and are
+        excluded from all three sums — the sharding layer's tool for
+        unequal shards."""
+        raise NotImplementedError
+
+    def prepare(self, X, y, mask=None):
+        """One-time data staging hook, called by the smooth factories at
+        data-placement time (OUTSIDE the optimizer loop).  Implementations
+        may return transformed operands (e.g. the Pallas kernel's
+        tile-padded layout) that their ``batch_loss_and_grad`` recognizes.
+        The default materializes a lazily-requested CSC twin
+        (``CSRMatrix.with_csc(lazy=True)``) — the single-device half of
+        that contract; ``mesh.shard_csr_batch`` handles the mesh half."""
+        from .sparse import CSRMatrix
+
+        if isinstance(X, CSRMatrix) and X.want_csc and not X.has_csc:
+            X = X.with_csc()
+        return X, y, mask
+
+    # ------------------------------------------------------------------
+    # Convenience: mean loss/grad over one in-memory batch (no mesh).
+    # ------------------------------------------------------------------
+    def mean_loss_and_grad(self, weights, X, y, mask=None):
+        loss_sum, grad_sum, n = self.batch_loss_and_grad(weights, X, y, mask)
+        from ..core import tvec
+
+        n = jnp.asarray(n, loss_sum.dtype)
+        return loss_sum / n, tvec.scale(1.0 / n, grad_sum)
+
+
+class MarginGradient(Gradient):
+    """A GLM loss that is a per-row function of the margin ``x·w``.
+
+    Subclasses define ``dots_loss_and_mult(dots, y) -> (per, mult)`` with
+    ``per`` the per-example loss and ``mult`` the per-example gradient
+    multiplier (``grad = X.T @ mult``).  This is the seam the
+    feature-sharded path needs: with D sharded over the mesh, the margin is
+    assembled by a psum *between* the two products (parallel/
+    feature_sharded.py), so the elementwise middle must be callable on its
+    own.  The row-sharded kernels below also use it, so the two layouts
+    cannot drift numerically.
+    """
+
+    def dots_loss_and_mult(self, dots, y):
+        raise NotImplementedError
+
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        dots = matvec(X, weights)
+        per, mult = self.dots_loss_and_mult(dots, y.astype(dots.dtype))
+        m = _as_mask(mask, dots.dtype)
+        if m is not None:
+            per = per * m
+            mult = mult * m
+        return jnp.sum(per), rmatvec(X, mult), _count(X, mask)
+
+
+class LogisticGradient(MarginGradient):
+    """Binary logistic loss (labels in {0,1}).
+
+    Mirrors spark-mllib 1.3.0 ``LogisticGradient`` (binary-only in 1.3;
+    reference use-sites: Suite:39, :251).  Stable via ``softplus``.
+    """
+
+    def dots_loss_and_mult(self, dots, y):
+        margins = -dots
+        per = jax.nn.softplus(margins) - (1.0 - y) * margins
+        mult = jax.nn.sigmoid(-margins) - y
+        return per, mult
+
+
+class LeastSquaresGradient(MarginGradient):
+    """Squared-error loss, 1.3 convention: ``diff^2`` / ``2·diff·x``.
+
+    (BASELINE config 2; not used in the reference's own tests but named by
+    SURVEY §2.2.)
+    """
+
+    def dots_loss_and_mult(self, dots, y):
+        diff = dots - y
+        return diff * diff, 2.0 * diff
+
+
+class HingeGradient(MarginGradient):
+    """SVM hinge loss; {0,1} labels rescaled to {-1,+1} (BASELINE config 3)."""
+
+    def dots_loss_and_mult(self, dots, y):
+        s = 2.0 * y - 1.0
+        margin = 1.0 - s * dots
+        active = margin > 0.0
+        # grad_i = -s_i x_i where active, else 0  ==  X^T(-s * active)
+        return jnp.where(active, margin, 0.0), jnp.where(active, -s, 0.0)
+
+
+class SoftmaxGradient(Gradient):
+    """Multinomial softmax regression with weight matrix ``(D, K)``.
+
+    New capability beyond spark-mllib 1.3 (which was binary-only — SURVEY
+    §2.2), required for BASELINE config 4 (MNIST-8M).  The ``(D, K)`` weight
+    matrix is the tensor-parallel target: shard K over the mesh ``model``
+    axis and the two matmuls below become sharded MXU ops with XLA inserting
+    the collectives.
+    """
+
+    def __init__(self, num_classes: int):
+        self.num_classes = int(num_classes)
+
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        logits = matvec(X, weights)  # (N, K)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)  # (N,)
+        picked = jnp.take_along_axis(
+            logits, y.astype(jnp.int32)[:, None], axis=-1
+        )[:, 0]
+        per = logz - picked
+        probs = jnp.exp(logits - logz[:, None])  # reuse logz; one pass
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), self.num_classes,
+                                dtype=logits.dtype)
+        resid = probs - onehot
+        m = _as_mask(mask, logits.dtype)
+        if m is not None:
+            per = per * m
+            resid = resid * m[:, None]
+        loss_sum = jnp.sum(per)
+        grad_sum = rmatvec(X, resid)  # (D, K)
+        return loss_sum, grad_sum, _count(X, mask)
+
+
+class CustomGradient(Gradient):
+    """Wrap any batch loss ``fn(weights_pytree, X, y) -> loss_sum``.
+
+    The gradient comes from ``jax.value_and_grad``; weights may be an
+    arbitrary pytree (MLP parameter trees — BASELINE config 5).  This is the
+    extension seam that replaces subclassing MLlib's ``Gradient``.
+    """
+
+    def __init__(self, loss_sum_fn: Callable[..., Array],
+                 supports_mask: bool = False):
+        """``supports_mask=True`` declares that ``loss_sum_fn`` accepts a
+        fourth ``mask`` argument and masks its own per-example terms; without
+        it, masked calls are rejected rather than silently mis-summed."""
+        self._vg = jax.value_and_grad(loss_sum_fn)
+        self._supports_mask = supports_mask
+
+    def batch_loss_and_grad(self, weights, X, y, mask=None):
+        if mask is not None:
+            if not self._supports_mask:
+                raise ValueError(
+                    "this CustomGradient's loss_sum_fn does not take a mask; "
+                    "construct it with supports_mask=True and handle the "
+                    "mask argument in the loss")
+            loss_sum, grad_sum = self._vg(weights, X, y, mask)
+        else:
+            loss_sum, grad_sum = self._vg(weights, X, y)
+        return loss_sum, grad_sum, _count(X, mask)
+
+
+# Registry for config/CLI surfaces.
+GRADIENTS = {
+    "logistic": LogisticGradient,
+    "least_squares": LeastSquaresGradient,
+    "hinge": HingeGradient,
+    "softmax": SoftmaxGradient,
+}

@@ -1,0 +1,283 @@
+"""CSR sparse matrix for TPU kernels (SURVEY §7 hard part 3).
+
+rcv1.binary (~47k features) and url_combined (~3.2M features) are far too
+sparse to densify at full scale.  The MXU cannot consume CSR directly, so
+the sparse path lowers to gather + ``segment_sum`` (matvec) and a scatter-
+add (rmatvec) — XLA compiles both to decent TPU code, and the row-id
+layout (COO-style, not indptr) is exactly what ``segment_sum`` wants and
+what shards cleanly by nnz ranges later.
+
+``CSRMatrix`` is a pytree (arrays are leaves, shape is static aux data) so
+it can close over jit/shard_map boundaries and ride inside the fused AGD
+loop like any dense operand.  The loss kernels dispatch on it through
+``ops.losses.matvec``/``rmatvec`` — the same ``Gradient`` classes serve
+dense and sparse data.
+
+TPU layout note — the CSC twin: the gradient product ``X.T @ mult`` is a
+scatter-add over *unsorted* column ids, and unsorted scatter is the one
+sparse primitive TPUs lower badly (serialized updates).  ``with_csc()``
+builds a second, column-sorted copy of the entries at data-placement
+time; ``rmatvec`` then becomes the same sorted ``segment_sum`` shape the
+forward product already uses — trading ~2x entry memory for a sorted
+reduction on the hot gradient path.  Both copies are inert padding-safe
+and produce identical sums up to f32 reassociation.  ``rows_sorted``
+marks layouts whose ``row_ids`` are nondecreasing (true for
+``from_csr_arrays``) so the forward ``segment_sum`` can claim
+``indices_are_sorted`` too.
+
+Padding contract: ``nnz`` may include padding entries (value 0.0) so
+nnz-sharded layouts can be rectangular; zero values contribute nothing to
+either product regardless of which row/col slot they point at.  Sorted
+layouts put padding at the LAST row/col slot to keep ids nondecreasing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class CSRMatrix:
+    """Row-sparse matrix in COO-with-row-ids form.
+
+    ``row_ids``/``col_ids``/``values`` are (nnz,) arrays; ``shape`` is
+    static.  Build from scipy-style CSR via ``from_csr_arrays``.  An
+    optional column-sorted twin (``csc_*``, see module docstring) serves
+    the transpose products; build it with ``with_csc()``.
+    """
+
+    def __init__(self, row_ids, col_ids, values, shape: Tuple[int, int],
+                 *, csc_row_ids=None, csc_col_ids=None, csc_values=None,
+                 rows_sorted: bool = False, want_csc: bool = False):
+        self.row_ids = row_ids
+        self.col_ids = col_ids
+        self.values = values
+        self.shape = tuple(shape)
+        self.csc_row_ids = csc_row_ids
+        self.csc_col_ids = csc_col_ids
+        self.csc_values = csc_values
+        self.rows_sorted = bool(rows_sorted)
+        self.want_csc = bool(want_csc)
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return ((self.row_ids, self.col_ids, self.values,
+                 self.csc_row_ids, self.csc_col_ids, self.csc_values),
+                (self.shape, self.rows_sorted, self.want_csc))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        shape, rows_sorted, want_csc = aux
+        rid, cid, val, crid, ccid, cval = leaves
+        return cls(rid, cid, val, shape, csc_row_ids=crid,
+                   csc_col_ids=ccid, csc_values=cval,
+                   rows_sorted=rows_sorted, want_csc=want_csc)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_csr_arrays(cls, indptr, indices, values, n_features: int,
+                        with_csc: bool = False) -> "CSRMatrix":
+        indptr = np.asarray(indptr)
+        n_rows = len(indptr) - 1
+        counts = np.diff(indptr)
+        row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), counts)
+        indices = np.asarray(indices, np.int32)
+        values = np.asarray(values)
+        csc = {}
+        if with_csc:  # sort on host, before any device transfer
+            order = np.argsort(indices, kind="stable")
+            csc = dict(csc_row_ids=jnp.asarray(row_ids[order]),
+                       csc_col_ids=jnp.asarray(indices[order]),
+                       csc_values=jnp.asarray(values[order]))
+        return cls(jnp.asarray(row_ids), jnp.asarray(indices),
+                   jnp.asarray(values), (n_rows, int(n_features)),
+                   rows_sorted=True, **csc)
+
+    def with_csc(self, lazy: bool = False) -> "CSRMatrix":
+        """Return a copy carrying the column-sorted twin of the entries.
+
+        ``lazy=True`` only MARKS the matrix as wanting the twin
+        (``want_csc``); materialization is deferred to data placement —
+        ``Gradient.prepare`` builds it for single-device runs, while
+        ``mesh.shard_csr_batch`` reads the flag and builds per-shard
+        twins directly, never paying for a global one it would discard.
+
+        Eager builds sort once on the host, never inside a compiled
+        program, and match the residency of the source arrays:
+        host-numpy entries get a host-numpy twin, device entries a
+        device twin.
+        """
+        if self.has_csc or (lazy and self.want_csc):
+            return self
+        if lazy:
+            return CSRMatrix(self.row_ids, self.col_ids, self.values,
+                             self.shape, rows_sorted=self.rows_sorted,
+                             want_csc=True)
+        on_device = isinstance(self.values, jax.Array)
+        put = jnp.asarray if on_device else (lambda a: a)
+        cid = np.asarray(self.col_ids)
+        order = np.argsort(cid, kind="stable")
+        return CSRMatrix(
+            self.row_ids, self.col_ids, self.values, self.shape,
+            csc_row_ids=put(np.asarray(self.row_ids)[order]),
+            csc_col_ids=put(cid[order]),
+            csc_values=put(np.asarray(self.values)[order]),
+            rows_sorted=self.rows_sorted)
+
+    @property
+    def has_csc(self) -> bool:
+        return self.csc_values is not None
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    # -- products ----------------------------------------------------------
+    def matvec(self, w):
+        """``X @ w`` -> (n_rows,): gather + segment-sum over rows."""
+        prods = self.values * jnp.take(w, self.col_ids, axis=0)
+        return jax.ops.segment_sum(prods, self.row_ids,
+                                   num_segments=self.shape[0],
+                                   indices_are_sorted=self.rows_sorted)
+
+    def rmatvec(self, v):
+        """``X.T @ v`` -> (n_features,): sorted segment-sum over columns
+        when the CSC twin is present, else scatter-add.  Output dtype
+        follows promotion rules, matching the dense ``X.T @ v``."""
+        if self.has_csc:
+            contrib = self.csc_values * jnp.take(v, self.csc_row_ids,
+                                                 axis=0)
+            return jax.ops.segment_sum(
+                contrib, self.csc_col_ids, num_segments=self.shape[1],
+                indices_are_sorted=True)
+        contrib = self.values * jnp.take(v, self.row_ids, axis=0)
+        out_dt = jnp.result_type(self.values, v)
+        return jnp.zeros(self.shape[1], out_dt).at[self.col_ids].add(contrib)
+
+    def matmat(self, W):
+        """``X @ W`` for (D, K) dense W -> (n_rows, K)."""
+        prods = self.values[:, None] * jnp.take(W, self.col_ids, axis=0)
+        return jax.ops.segment_sum(prods, self.row_ids,
+                                   num_segments=self.shape[0],
+                                   indices_are_sorted=self.rows_sorted)
+
+    def rmatmat(self, V):
+        """``X.T @ V`` for (n_rows, K) dense V -> (n_features, K)."""
+        if self.has_csc:
+            contrib = self.csc_values[:, None] * jnp.take(
+                V, self.csc_row_ids, axis=0)
+            return jax.ops.segment_sum(
+                contrib, self.csc_col_ids, num_segments=self.shape[1],
+                indices_are_sorted=True)
+        contrib = self.values[:, None] * jnp.take(V, self.row_ids, axis=0)
+        out_dt = jnp.result_type(self.values, V)
+        return jnp.zeros((self.shape[1], V.shape[1]),
+                         out_dt).at[self.col_ids].add(contrib)
+
+
+@jax.tree_util.register_pytree_node_class
+class RowShardedCSR:
+    """A CSR batch laid out for the mesh ``data`` axis (sparse DP).
+
+    The reference's distributed pass works on any RDD of sparse vectors
+    (``Gradient.compute`` takes a ``Vector``, reference
+    ``AcceleratedGradientDescent.scala:196-204``); this is the TPU layout
+    that restores that capability for mesh parallelism.  Rows are assigned
+    to shards (nnz-balanced by default — see ``parallel.mesh.
+    shard_csr_batch``), each shard's entries are re-indexed to LOCAL row
+    ids and padded to a common ``nnz_per_shard`` so the stacked arrays are
+    rectangular; inside ``shard_map`` every device reconstructs its slice
+    as an ordinary :class:`CSRMatrix` of shape ``(rows_per_shard, D)`` —
+    one sparse kernel implementation serves every layout.
+
+    ``row_ids``/``col_ids``/``values`` are ``(n_shards * nnz_per_shard,)``
+    device arrays sharded over the data axis; padding entries are value
+    0.0 pointing at the last local row / col slot (inert in both
+    products, and id-order-preserving — see the module padding contract).
+    ``shape`` is the GLOBAL logical shape (unpadded row count); per-shard
+    row slots beyond the real rows carry mask 0 in the accompanying
+    ``ShardedBatch.mask``.  ``csc_*``, when present, is each shard's
+    column-sorted entry copy (``mesh.shard_csr_batch`` builds it when the
+    input carries one).
+    """
+
+    def __init__(self, row_ids, col_ids, values, shape: Tuple[int, int],
+                 rows_per_shard: int, n_shards: int,
+                 *, csc_row_ids=None, csc_col_ids=None, csc_values=None,
+                 rows_sorted: bool = False):
+        self.row_ids = row_ids
+        self.col_ids = col_ids
+        self.values = values
+        self.shape = tuple(shape)
+        self.rows_per_shard = int(rows_per_shard)
+        self.n_shards = int(n_shards)
+        self.csc_row_ids = csc_row_ids
+        self.csc_col_ids = csc_col_ids
+        self.csc_values = csc_values
+        self.rows_sorted = bool(rows_sorted)
+
+    def tree_flatten(self):
+        return ((self.row_ids, self.col_ids, self.values,
+                 self.csc_row_ids, self.csc_col_ids, self.csc_values),
+                (self.shape, self.rows_per_shard, self.n_shards,
+                 self.rows_sorted))
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        shape, rows_per_shard, n_shards, rows_sorted = aux
+        rid, cid, val, crid, ccid, cval = leaves
+        return cls(rid, cid, val, shape=shape,
+                   rows_per_shard=rows_per_shard, n_shards=n_shards,
+                   csc_row_ids=crid, csc_col_ids=ccid, csc_values=cval,
+                   rows_sorted=rows_sorted)
+
+    @property
+    def has_csc(self) -> bool:
+        return self.csc_values is not None
+
+    @property
+    def sharding(self):
+        """The values array's sharding (all three leaves are placed
+        identically) — lets ``api.run`` recover the mesh the same way it
+        does from a dense ``ShardedBatch.X``."""
+        return self.values.sharding
+
+    @property
+    def nnz_per_shard(self) -> int:
+        return int(self.values.shape[0]) // self.n_shards
+
+    def local_csr(self, row_ids, col_ids, values,
+                  csc_row_ids=None, csc_col_ids=None,
+                  csc_values=None) -> CSRMatrix:
+        """Reassemble ONE shard's slice (as seen inside ``shard_map``)
+        into a local CSRMatrix of shape ``(rows_per_shard, D)``, carrying
+        the shard's CSC twin when the layout has one."""
+        return CSRMatrix(row_ids, col_ids, values,
+                         (self.rows_per_shard, self.shape[1]),
+                         csc_row_ids=csc_row_ids, csc_col_ids=csc_col_ids,
+                         csc_values=csc_values,
+                         rows_sorted=self.rows_sorted)
+
+
+def matvec(X, w):
+    """Polymorphic ``X @ w`` (dense array or CSRMatrix) used by the loss
+    kernels; 2-D ``w`` routes to matmat."""
+    if isinstance(X, CSRMatrix):
+        return X.matmat(w) if w.ndim == 2 else X.matvec(w)
+    return X @ w
+
+
+def rmatvec(X, v):
+    """Polymorphic ``X.T @ v``."""
+    if isinstance(X, CSRMatrix):
+        return X.rmatmat(v) if v.ndim == 2 else X.rmatvec(v)
+    return X.T @ v
+

@@ -1,0 +1,273 @@
+"""Feature-dimension (D-axis) sharding — scaling the axis the reference
+cannot.
+
+The reference's only answer to a wide model is a bigger broadcast: the
+whole weight vector ships to every executor per evaluation, guarded by the
+1MB-task-size test (reference Suite:244-259) — at url_combined scale
+(D = 3,231,961) that is ~13 MB *per evaluation per executor* over the
+network.  The TPU-native inversion: shard the weight vector over the mesh
+``model`` axis so each chip holds D/n of it (and of the optimizer state,
+and of the column-sliced data), and assemble only the (N,)-vector of
+margins with one psum per evaluation.
+
+Layout (classic model-parallel GLM):
+
+- host pre-shards the CSR matrix by column range; each shard's entries are
+  re-indexed to local columns and padded to a common nnz so the stacked
+  arrays are rectangular (padding value 0.0 at the last row/col slot is
+  inert in both products and keeps ids nondecreasing);
+- inside ``shard_map``: ``dots_partial = segment_sum(values * w_local[
+  col_local], row_ids)`` — each chip's contribution to every row's margin;
+  one ``psum`` over ``model`` assembles full margins everywhere (THE only
+  collective);
+- the per-row loss/multiplier middle (``MarginGradient.dots_loss_and_mult``
+  — the same code the row-sharded kernels run, so layouts cannot drift) is
+  computed replicated;
+- ``grad_local`` lands already sharded: a SORTED column segment-sum over
+  each shard's column-sorted entry twin (the ops.sparse CSC rationale;
+  scatter-add only when the twin is disabled) — the gradient, prox step,
+  and all AT recurrences stay D-sharded with zero further communication;
+  elementwise optimizer math partitions over the mesh for free under
+  GSPMD.
+
+Cost shape per evaluation: one psum of (N,) — vs the reference's full-D
+broadcast + full-D tree-reduce.  For N ≪ D (url_combined: 2.4M rows vs
+3.2M features — and any minibatch regime) this is strictly less traffic,
+and it is the layout that keeps working when D no longer fits one chip.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .. import native
+from ..ops.losses import MarginGradient
+from ..ops.sparse import CSRMatrix
+from . import mesh as mesh_lib
+
+
+class FeatureShardedBatch(NamedTuple):
+    """Column-sharded CSR batch on a mesh.  ``row_ids``/``col_local``/
+    ``values`` are (n_shards * nnz_shard,) device arrays sharded over the
+    ``model`` axis; ``n_rows``/``n_features``/``d_local`` are static.
+    ``positions`` (host array, (n_features,)) maps global column c to its
+    padded position ``shard * d_local + local`` — columns are assigned to
+    shards by greedy nnz balancing, NOT contiguous ranges, so a power-law
+    column distribution (url_combined's regime) cannot pile most entries
+    onto one shard.
+
+    Per-shard entries are sorted by row id (padding points at the last
+    row), and ``csc_*`` — when built, the default — is each shard's
+    entry copy sorted by LOCAL COLUMN, so both the margin segment-sum
+    and the gradient's column reduction run with
+    ``indices_are_sorted=True`` instead of a scatter-add (the
+    ops.sparse CSC-twin rationale, applied to the D-sharded layout)."""
+
+    row_ids: jax.Array
+    col_local: jax.Array
+    values: jax.Array
+    y: jax.Array  # (N,) replicated
+    mask: Optional[jax.Array]  # (N,) replicated, or None
+    positions: np.ndarray  # host-side column -> padded-position map
+    n_rows: int
+    n_features: int
+    d_local: int  # columns per shard (D padded to n_shards * d_local)
+    csc_row_ids: Optional[jax.Array] = None
+    csc_col_local: Optional[jax.Array] = None
+    csc_values: Optional[jax.Array] = None
+
+    @property
+    def has_csc(self) -> bool:
+        return self.csc_values is not None
+
+
+def shard_csr_by_columns(
+    indptr, indices, values, n_features: int, y,
+    mesh: Mesh, mask=None, axis: str = mesh_lib.MODEL_AXIS,
+    with_csc: bool = True,
+) -> FeatureShardedBatch:
+    """Host-side layout: assign columns to shards in nnz-balanced
+    serpentine order, re-index entries to (shard, local), pad shards to a
+    common nnz, place on the mesh.  ``with_csc=False`` drops the
+    column-sorted gradient twin (halves entry memory, reverts the
+    gradient to scatter-add)."""
+    indptr = np.asarray(indptr)
+    indices = np.asarray(indices)
+    values = np.asarray(values, np.float32)
+    if len(indices) and (indices.min() < 0 or indices.max() >= n_features):
+        raise ValueError(
+            f"column index out of range: [{indices.min()}, {indices.max()}]"
+            f" vs n_features={n_features} — refusing a layout that would "
+            "silently corrupt the padding tail")
+    n_rows = len(indptr) - 1
+    counts = np.diff(indptr)
+    row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), counts)
+
+    n_shards = mesh.shape[axis]
+    d_local = -(-n_features // n_shards)  # ceil
+
+    # Greedy nnz balance: walk columns heaviest-first, placing each on the
+    # currently lightest shard with remaining capacity.  Max shard load ≈
+    # max(heaviest column, total/n_shards) — the best any column-granular
+    # layout can do under power-law occupancy (url_combined's regime).
+    # C++ core with bit-identical Python fallback (native.greedy_balance);
+    # the pure-Python loop costs seconds at D = 3.2M (native ~7x faster).
+    col_nnz = np.bincount(indices, minlength=n_features)
+    shard_of_col, local_of_col = native.greedy_balance(
+        col_nnz, n_shards, d_local)
+    positions = shard_of_col * d_local + local_of_col
+
+    e_shard = shard_of_col[indices]
+    e_local = local_of_col[indices].astype(np.int32)
+
+    eorder = np.argsort(e_shard, kind="stable")
+    shard_sorted_e = e_shard[eorder]
+    starts = np.searchsorted(shard_sorted_e, np.arange(n_shards))
+    ends = np.searchsorted(shard_sorted_e, np.arange(n_shards),
+                           side="right")
+    per_shard = ends - starts
+    nnz_shard = max(int(per_shard.max()) if len(values) else 1, 1)
+
+    # Padding points at the last row / last local column (inert 0.0
+    # values) so per-shard ids stay nondecreasing for the sorted
+    # segment-sums.  Entries within a shard keep original order = sorted
+    # by row (stable shard sort of row-sorted input).
+    R = np.full((n_shards, nnz_shard), max(n_rows - 1, 0), np.int32)
+    C = np.zeros((n_shards, nnz_shard), np.int32)
+    V = np.zeros((n_shards, nnz_shard), np.float32)
+    if with_csc:
+        Rc = np.zeros((n_shards, nnz_shard), np.int32)
+        Cc = np.full((n_shards, nnz_shard), d_local - 1, np.int32)
+        Vc = np.zeros((n_shards, nnz_shard), np.float32)
+    for s in range(n_shards):
+        sel = eorder[starts[s]:ends[s]]
+        k = len(sel)
+        R[s, :k] = row_ids[sel]
+        C[s, :k] = e_local[sel]
+        V[s, :k] = values[sel]
+        if with_csc:  # column-sorted twin of the same entries
+            sel_c = sel[np.argsort(e_local[sel], kind="stable")]
+            Rc[s, :k] = row_ids[sel_c]
+            Cc[s, :k] = e_local[sel_c]
+            Vc[s, :k] = values[sel_c]
+
+    spec = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    csc = {}
+    if with_csc:
+        csc = dict(csc_row_ids=jax.device_put(Rc.reshape(-1), spec),
+                   csc_col_local=jax.device_put(Cc.reshape(-1), spec),
+                   csc_values=jax.device_put(Vc.reshape(-1), spec))
+    return FeatureShardedBatch(
+        row_ids=jax.device_put(R.reshape(-1), spec),
+        col_local=jax.device_put(C.reshape(-1), spec),
+        values=jax.device_put(V.reshape(-1), spec),
+        y=jax.device_put(np.asarray(y, np.float32), rep),
+        mask=(None if mask is None
+              else jax.device_put(np.asarray(mask, np.float32), rep)),
+        positions=positions,
+        n_rows=n_rows, n_features=int(n_features), d_local=int(d_local),
+        **csc)
+
+
+def shard_weights(w, batch: FeatureShardedBatch, mesh: Mesh,
+                  axis: str = mesh_lib.MODEL_AXIS) -> jax.Array:
+    """Place a (D,) weight vector D-sharded: scatter into the batch's
+    padded positions and shard over ``axis``.  Unused positions stay
+    exactly zero through every prox in ``ops.prox`` (all are odd maps
+    fixing 0), so ``unshard_weights`` is lossless."""
+    n_shards = mesh.shape[axis]
+    d_pad = n_shards * batch.d_local
+    w = np.asarray(w, np.float32)
+    wp = np.zeros(d_pad, np.float32)
+    wp[batch.positions] = w
+    return jax.device_put(wp, NamedSharding(mesh, P(axis)))
+
+
+def unshard_weights(w_sharded, batch: FeatureShardedBatch) -> np.ndarray:
+    return np.asarray(w_sharded)[batch.positions]
+
+
+def make_feature_sharded_smooth(
+    gradient: MarginGradient,
+    batch: FeatureShardedBatch,
+    *,
+    mesh: Mesh,
+    axis: str = mesh_lib.MODEL_AXIS,
+) -> Tuple:
+    """Build ``(smooth, smooth_loss)`` over a column-sharded batch.
+
+    ``smooth(w_sharded) -> (mean_loss, mean_grad_sharded)`` — the gradient
+    comes back with the same D-sharding as the weights, so the whole AGD
+    loop runs on sharded state.
+    """
+    if not isinstance(gradient, MarginGradient):
+        raise TypeError(
+            "feature sharding needs a margin-form GLM loss "
+            f"(MarginGradient); got {type(gradient).__name__}")
+    has_mask = batch.mask is not None
+    n_rows = batch.n_rows
+    d_local = batch.d_local
+    if has_mask:
+        n_valid = float(np.asarray(jnp.sum(batch.mask > 0)))
+    else:
+        n_valid = float(n_rows)
+
+    sharded = P(axis)
+    rep = P()
+    n_csc = 3 if batch.has_csc else 0
+    in_specs = (sharded,) * (4 + n_csc) + (rep,) \
+        + ((rep,) if has_mask else ())
+
+    @jax.jit
+    def _eval(w, row_ids, col_local, values, *rest):
+        def body(w_l, r, c, v, *rest_l):
+            csc_l, tail = rest_l[:n_csc], rest_l[n_csc:]
+            y_r, ms_l = tail[0], tail[1:]
+            # this chip's column slice as a local CSR — the ONE sparse
+            # kernel implementation (ops.sparse) serves here too; entries
+            # are row-sorted and the csc twin column-sorted by layout
+            csc_kw = (dict(csc_row_ids=csc_l[0], csc_col_ids=csc_l[1],
+                           csc_values=csc_l[2]) if csc_l else {})
+            Xl = CSRMatrix(r, c, v, (n_rows, d_local), rows_sorted=True,
+                           **csc_kw)
+            dots_partial = Xl.matvec(w_l)
+            # THE collective: assemble full margins on every chip
+            dots = lax.psum(dots_partial, axis)
+            per, mult = gradient.dots_loss_and_mult(
+                dots, y_r.astype(dots.dtype))
+            if ms_l:
+                per = per * ms_l[0]
+                mult = mult * ms_l[0]
+            loss_sum = jnp.sum(per)  # identical on every chip post-psum
+            # gradient lands already sharded: a sorted column reduction
+            # (csc twin) or scatter into local columns (without it)
+            return loss_sum, Xl.rmatvec(mult)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(rep, sharded),
+            check_vma=False,
+        )(w, row_ids, col_local, values, *rest)
+
+    args = (batch.row_ids, batch.col_local, batch.values) \
+        + ((batch.csc_row_ids, batch.csc_col_local, batch.csc_values)
+           if batch.has_csc else ()) \
+        + (batch.y,) + ((batch.mask,) if has_mask else ())
+
+    def smooth(w):
+        ls, gs = _eval(w, *args)
+        return ls / n_valid, gs / n_valid
+
+    def smooth_loss(w):
+        return _eval(w, *args)[0] / n_valid
+
+    return smooth, smooth_loss

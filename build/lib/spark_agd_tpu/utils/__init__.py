@@ -1,0 +1,14 @@
+"""Aux subsystems (SURVEY §5): checkpoint/resume, structured logging,
+profiling.  The reference inherits all of this from Spark or omits it; here
+each is a small first-class module."""
+
+from .checkpoint import (  # noqa: F401
+    CheckpointedResult,
+    fresh_warm_state,
+    load_checkpoint,
+    run_agd_checkpointed,
+    save_checkpoint,
+    warm_from_result,
+)
+from .logging import iteration_records, log_result, make_host_logger  # noqa: F401
+from .profiling import annotate, timed, trace  # noqa: F401

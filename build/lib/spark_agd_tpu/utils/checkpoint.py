@@ -13,9 +13,7 @@ history.  Loading needs a *template* pytree (normally ``w0``) to rebuild the
 tree structure — the file stores leaves positionally, not a pickled treedef,
 so checkpoints are plain data (no code execution on load).
 
-``run_agd_checkpointed`` drives ``core.agd.run_agd`` (fused, default) or
-``core.host_agd.run_agd_host`` (``driver="host"`` — required for
-host-level streamed smooths) in segments
+``run_agd_checkpointed`` drives the fused ``core.agd.run_agd`` in segments
 of ``segment_iters`` compiled iterations, checkpointing between segments and
 resuming from ``path`` if a checkpoint exists.  Segment boundaries are
 invisible to the math: the warm carry is exact (including the ``nIter > 1``
@@ -166,22 +164,13 @@ def run_agd_checkpointed(
     path: str,
     segment_iters: int = 10,
     smooth_loss=None,
-    driver: str = "fused",
 ) -> CheckpointedResult:
-    """AGD with periodic checkpoints: run ``segment_iters`` outer
-    iterations per launch, persist the carry after each.  Kill the
-    process at any point; rerunning the same call continues from the
-    last completed segment.
-
-    ``driver="fused"`` (default) jits ``core.agd.run_agd`` once per
-    segment shape — for device-resident smooths.  ``driver="host"``
-    drives ``core.host_agd.run_agd_host`` — REQUIRED for host-level
-    smooths (the streamed macro-batch fold, ``data.streaming``), whose
-    Python loop cannot live inside a traced program."""
+    """Fused AGD with periodic checkpoints: compile once per segment shape,
+    run ``segment_iters`` device-side iterations per launch, persist the
+    carry after each.  Kill the process at any point; rerunning the same
+    call continues from the last completed segment."""
     if segment_iters <= 0:
         raise ValueError("segment_iters must be positive")
-    if driver not in ("fused", "host"):
-        raise ValueError(f"unknown driver {driver!r}: 'fused' | 'host'")
     fp = problem_fingerprint(w0, config)
     loaded = load_checkpoint(path, w0, expect_fingerprint=fp)
     if loaded is not None:
@@ -205,17 +194,11 @@ def run_agd_checkpointed(
     seg_fns = {}
 
     def run_segment(warm_state, k):
-        cfg_k = dataclasses.replace(config, num_iterations=k)
-        if driver == "host":
-            from ..core import host_agd
-
-            return host_agd.run_agd_host(
-                smooth, prox, reg_value, warm_state.x, cfg_k,
-                smooth_loss=smooth_loss, warm=warm_state)
         if k not in seg_fns:
+            cfg_k = dataclasses.replace(config, num_iterations=k)
             seg_fns[k] = jax.jit(
-                lambda ws, c=cfg_k: agd.run_agd(
-                    smooth, prox, reg_value, ws.x, c,
+                lambda ws: agd.run_agd(
+                    smooth, prox, reg_value, ws.x, cfg_k,
                     smooth_loss=smooth_loss, warm=ws))
         return seg_fns[k](warm_state)
 

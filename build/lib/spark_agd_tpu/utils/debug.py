@@ -1,0 +1,70 @@
+"""Numerical-failure debugging: the sanitizer row of SURVEY §5.
+
+The reference's only runtime guard is the NaN/Inf loss check that
+aborts the loop (reference ``AcceleratedGradientDescent.scala:309-312``
+— carried over as ``core.agd``'s abort flag).  That tells you THAT a
+run went non-finite, not WHERE.  Two wrappers, one check set:
+
+- ``checked_smooth(smooth)`` — EAGER wrapper: calls raise
+  ``jax.errors.JaxRuntimeError`` naming the first non-finite quantity.
+  For host-driven paths (``core.host_agd``, streamed smooths) and
+  interactive debugging.  Not jittable: the error check must read a
+  concrete value.
+- ``checking_smooth(smooth)`` — embedded-check variant for COMPILED
+  programs: the checks ride inside the traced computation, and the
+  caller functionalizes the WHOLE program with ``checkify.checkify``
+  (which handles ``lax.while_loop``), e.g.::
+
+      sm_dbg = checking_smooth(sm)
+      run = checkify.checkify(
+          jax.jit(lambda w: agd.run_agd(sm_dbg, px, rv, w, cfg)))
+      err, res = run(w0)
+      err.throw()   # raises with the named failing leaf, or no-ops
+
+The production path stays exactly as compiled — only the wrapped copy
+is instrumented.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+
+def checking_smooth(smooth: Callable[[Any], Tuple[jax.Array, Any]],
+                    name: str = "smooth") -> Callable:
+    """``smooth`` with embedded ``checkify.check``s on the loss and every
+    gradient leaf (named by pytree key path).  Use inside a program the
+    caller wraps with ``checkify.checkify`` — see module docstring."""
+
+    def inner(w):
+        loss, grad = smooth(w)
+        checkify.check(jnp.all(jnp.isfinite(loss)),
+                       f"{name}: loss non-finite")
+        for path, leaf in jax.tree_util.tree_flatten_with_path(grad)[0]:
+            label = jax.tree_util.keystr(path) or "<root>"
+            checkify.check(
+                jnp.all(jnp.isfinite(leaf)),
+                f"{name}: gradient leaf {label} non-finite")
+        return loss, grad
+
+    return inner
+
+
+def checked_smooth(smooth: Callable[[Any], Tuple[jax.Array, Any]],
+                   name: str = "smooth") -> Callable:
+    """Eager-raising wrapper around :func:`checking_smooth` — same
+    signature as ``smooth``; raises on the first non-finite loss or
+    gradient leaf.  For host-driven/streamed paths; for the fused
+    compiled loop use :func:`checking_smooth` (module docstring)."""
+    checked = checkify.checkify(checking_smooth(smooth, name))
+
+    def wrapped(w):
+        err, out = checked(w)
+        checkify.check_error(err)
+        return out
+
+    return wrapped

@@ -1,0 +1,94 @@
+"""Structured per-iteration observability.
+
+The reference's entire logging surface is two calls: ``logWarning`` on a
+non-finite loss (reference ``AcceleratedGradientDescent.scala:309-312``) and
+``logInfo`` with the last 10 losses at completion (``:334-335``) — every
+other per-iteration quantity (L, theta, step, restarts) is computed and
+discarded.  SURVEY §5 flags that as the metrics gap; the fused loop already
+returns those values as ``AGDResult`` diagnostic arrays, and this module
+turns them into records and log lines.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("spark_agd_tpu")
+
+
+def iteration_records(result) -> List[dict]:
+    """One dict per executed iteration from an ``AGDResult``: iter (1-based,
+    like the reference's nIter), loss, L, theta, step, restarted."""
+    n = int(result.num_iters)
+    hist = np.asarray(result.loss_history)[:n]
+    ls = np.asarray(result.diag_l)[:n]
+    thetas = np.asarray(result.diag_theta)[:n]
+    steps = np.asarray(result.diag_step)[:n]
+    restarted = np.asarray(result.diag_restarted)[:n]
+    return [
+        dict(iter=i + 1, loss=float(hist[i]), L=float(ls[i]),
+             theta=float(thetas[i]), step=float(steps[i]),
+             restarted=bool(restarted[i]))
+        for i in range(n)
+    ]
+
+
+def log_result(result, *, log: Optional[logging.Logger] = None,
+               jsonl: bool = False) -> None:
+    """Emit per-iteration lines plus the reference's completion/abort lines.
+
+    ``jsonl=True`` formats each iteration as one JSON object per line (the
+    machine-readable channel); default is a readable key=value line.
+    """
+    log = log or logger
+    for rec in iteration_records(result):
+        if jsonl:
+            log.info(json.dumps(rec))
+        else:
+            log.info(
+                "iter=%d loss=%.6g L=%.4g theta=%.4g step=%.4g%s",
+                rec["iter"], rec["loss"], rec["L"], rec["theta"],
+                rec["step"], " restart" if rec["restarted"] else "")
+    if bool(result.aborted_non_finite):
+        # the reference's logWarning on numerical failure (:309-312)
+        log.warning("AcceleratedGradientDescent: loss is infinite or NaN; "
+                    "aborted after %d iterations", int(result.num_iters))
+    n = int(result.num_iters)
+    hist = np.asarray(result.loss_history)[:n]
+    # the reference's completion line: last 10 losses (:334-335)
+    log.info("AcceleratedGradientDescent.run finished. Last 10 losses %s",
+             ", ".join(f"{v:.6g}" for v in hist[-10:]))
+
+
+def make_host_logger(*, log: Optional[logging.Logger] = None,
+                     every: int = 1):
+    """An ``on_iteration`` callback for ``core.host_agd.run_agd_host``:
+    logs one structured line per ``every`` iterations as the run executes
+    (the streaming/1B-row regime, where waiting for the end is not an
+    option)."""
+    log = log or logger
+
+    def on_iteration(carry: dict):
+        it = int(carry["prior_iters"])
+        # a run's final callback (converged, aborted, or iteration-cap)
+        # always logs — an operator tailing the stream must be able to
+        # tell "finished" from "hung" regardless of `every`
+        final = carry.get("stopped") or carry.get("last")
+        if it % every and not final:
+            return
+        suffix = ""
+        if carry.get("aborted"):
+            suffix = " ABORTED-nonfinite"
+        elif carry.get("stopped"):
+            suffix = " converged"
+        elif carry.get("last"):
+            suffix = " done(iteration cap)"
+        log.info("iter=%d loss=%.6g L=%.4g theta=%.4g%s",
+                 it, float(carry["loss"]), float(carry["big_l"]),
+                 float(carry["theta"]), suffix)
+
+    return on_iteration

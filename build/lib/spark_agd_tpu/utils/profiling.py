@@ -1,0 +1,48 @@
+"""Tracing / profiling hooks (SURVEY §5: the reference has none in-tree and
+leans on the Spark UI; the TPU equivalents are the JAX profiler for device
+timelines and simple block-until-ready wall timing for iteration rates)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Tuple
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace (XLA ops, TPU timeline) viewable in
+    TensorBoard / Perfetto.  Usage::
+
+        with profiling.trace("/tmp/agd-trace"):
+            api.run(...)
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (host-side annotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def timed(fn: Callable, *args, warmup: int = 1,
+          repeats: int = 3) -> Tuple[float, object]:
+    """Wall-clock a jitted callable honestly: ``warmup`` calls absorb
+    compilation, then the median of ``repeats`` block-until-ready timings.
+    Returns ``(seconds, last_result)``."""
+    out = None
+    for _ in range(max(0, warmup)):
+        out = jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2], out

@@ -232,3 +232,84 @@ class TestLoggingUtils:
                 on_iteration=utils.make_host_logger(every=1000))
         assert res.num_iters < 100
         assert "converged" in caplog.text
+
+
+class TestHostDriverCheckpoint:
+    """driver='host': checkpointed AGD over a HOST-level smooth (the
+    streamed macro-batch fold) — the fused driver cannot trace it."""
+
+    def _problem(self):
+        from spark_agd_tpu.data import streaming
+
+        rng = np.random.default_rng(19)
+        n, d, npr = 600, 40, 6
+        indptr = np.arange(n + 1) * npr
+        indices = rng.integers(0, d, n * npr).astype(np.int32)
+        values = rng.normal(size=n * npr).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        ds = streaming.StreamingDataset.from_csr(
+            indptr, indices, values, d, y, batch_rows=256)
+        sm, sl = streaming.make_streaming_smooth(LogisticGradient(), ds)
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.05)
+        return sm, sl, px, rv, d
+
+    def test_segmented_equals_straight(self, tmp_path):
+        sm, sl, px, rv, d = self._problem()
+        cfg = agd.AGDConfig(num_iterations=7, convergence_tol=0.0)
+        straight = host_agd.run_agd_host(
+            sm, px, rv, jnp.zeros(d, jnp.float32), cfg, smooth_loss=sl)
+        out = utils.checkpoint.run_agd_checkpointed(
+            sm, px, rv, jnp.zeros(d, jnp.float32), cfg,
+            path=str(tmp_path / "h.npz"), segment_iters=3,
+            smooth_loss=sl, driver="host")
+        assert out.num_iters == straight.num_iters
+        np.testing.assert_allclose(out.loss_history,
+                                   straight.loss_history, rtol=1e-7)
+        np.testing.assert_allclose(np.asarray(out.weights),
+                                   np.asarray(straight.weights),
+                                   rtol=1e-6)
+
+    def test_kill_and_resume_parity(self, tmp_path):
+        """Stop after the first segment (the 'kill'), rerun the same
+        call: the total trajectory must equal an uninterrupted run."""
+        sm, sl, px, rv, d = self._problem()
+        cfg = agd.AGDConfig(num_iterations=6, convergence_tol=0.0)
+        path = str(tmp_path / "h2.npz")
+
+        class Stop(Exception):
+            pass
+
+        real = utils.checkpoint.save_checkpoint
+        calls = {"n": 0}
+
+        def save_then_die(*a, **k):
+            real(*a, **k)
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise Stop()  # process "dies" right after segment 1
+
+        import unittest.mock as mock
+        with mock.patch.object(utils.checkpoint, "save_checkpoint",
+                               save_then_die):
+            with pytest.raises(Stop):
+                utils.checkpoint.run_agd_checkpointed(
+                    sm, px, rv, jnp.zeros(d, jnp.float32), cfg,
+                    path=path, segment_iters=2, smooth_loss=sl,
+                    driver="host")
+        resumed = utils.checkpoint.run_agd_checkpointed(
+            sm, px, rv, jnp.zeros(d, jnp.float32), cfg, path=path,
+            segment_iters=2, smooth_loss=sl, driver="host")
+        assert resumed.resumed_from == 2
+        assert resumed.num_iters == 6
+        straight = host_agd.run_agd_host(
+            sm, px, rv, jnp.zeros(d, jnp.float32), cfg, smooth_loss=sl)
+        np.testing.assert_allclose(resumed.loss_history,
+                                   straight.loss_history, rtol=1e-7)
+
+    def test_rejects_unknown_driver(self, tmp_path):
+        sm, sl, px, rv, d = self._problem()
+        with pytest.raises(ValueError, match="driver"):
+            utils.checkpoint.run_agd_checkpointed(
+                sm, px, rv, jnp.zeros(d, jnp.float32),
+                agd.AGDConfig(num_iterations=2), path=str(tmp_path / "x"),
+                driver="banana")
