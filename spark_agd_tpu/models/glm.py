@@ -90,6 +90,20 @@ class GLMModel:
     def predict(self, X):
         raise NotImplementedError
 
+    def predict_stream(self, dataset):
+        """Iterate predictions over a ``data.streaming.StreamingDataset``
+        — scoring's twin of the streamed training path, for data that
+        never fits in memory.  Yields one NumPy array per macro-batch,
+        padding rows (mask 0) already dropped; concatenate for the full
+        vector or consume lazily."""
+        import numpy as np
+
+        for X, _, mask in dataset:
+            pred = np.asarray(self.predict(X))
+            if mask is not None:
+                pred = pred[np.asarray(mask) > 0]
+            yield pred
+
     def __repr__(self):
         return (f"{type(self).__name__}(d={self.weights.shape[0]}, "
                 f"intercept={self.intercept:.4g})")
