@@ -263,11 +263,13 @@ class PallasMarginGradient(MarginGradient):
     - CSR inputs, over-wide features (past the VMEM ceiling), and raw
       TRACER inputs fall back to the wrapped jnp kernel.  The tracer
       fallback is deliberate: a tracer means the call site skipped
-      ``prepare`` (e.g. per-shard evaluation inside the mesh shard_map),
-      and padding in-trace would re-stage the full matrix every smooth
-      evaluation of the compiled loop — strictly worse than XLA's
-      two-pass lowering.  Mesh + Pallas therefore currently runs the XLA
-      path per shard; a per-shard prepare is future work.
+      ``prepare``, and padding in-trace would re-stage the full matrix
+      every smooth evaluation of the compiled loop — strictly worse
+      than XLA's two-pass lowering.  Mesh data parallelism does NOT hit
+      this fallback: ``parallel.dist_smooth`` recognizes the wrapper
+      and relays the batch out once into per-shard tile-aligned slices
+      (``_make_shard_map_pallas``), so the fused kernel runs inside the
+      shard_map body.
     - ``interpret=None`` auto-selects: compiled on TPU, interpreter
       elsewhere (tests).
     """

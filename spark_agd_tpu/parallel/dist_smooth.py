@@ -106,8 +106,108 @@ def _make_auto(gradient, X, y, mask):
     return smooth, smooth_loss
 
 
+def _make_shard_map_pallas(gradient, X, y, mask, mesh, data_axis):
+    """Fused single-HBM-pass kernel under data parallelism.
+
+    The generic shard_map body hands ``PallasMarginGradient`` a traced
+    row block, which its ``batch_loss_and_grad`` must decline (in-trace
+    padding would re-stage X per evaluation) — so mesh runs used to fall
+    back to the XLA two-pass lowering per shard.  This builder removes
+    that gap: the global batch is re-laid out ONCE at placement time so
+    every shard's slice is tile-aligned — rows per shard padded to a
+    multiple of the VMEM-budgeted block, width padded to the lane —
+    entirely shard-local (pads only unsharded axes; no collectives),
+    and the shard_map body then feeds the fused kernel a ``PaddedDense``
+    view of its local slice directly.  One X read per shard per
+    evaluation + the same single psum.
+
+    Returns None when the layout does not apply (non-2D/over-wide X,
+    or a dtype the kernel does not take); the caller falls back.
+    """
+    from ..ops.pallas_kernels import (
+        _LANE, _SUBLANE, PaddedDense, choose_block_rows,
+        fused_margin_loss_grad, _pad_to)
+
+    if not isinstance(X, jax.Array) or X.ndim != 2 \
+            or X.dtype not in (jnp.float32, jnp.bfloat16):
+        return None
+    k = mesh.shape[data_axis]
+    n, d = X.shape
+    if n % k:
+        return None  # shard_batch pads to an even split; anything else
+        # is a hand-built batch this layout does not understand
+    rl = n // k
+    dp = _pad_to(d, _LANE)
+    # honor the wrapper's explicit block override (the same knob the
+    # single-device prepare() path uses)
+    br = gradient._block_rows or choose_block_rows(dp, X.dtype.itemsize)
+    if br < _SUBLANE:
+        return None  # past the single-pass VMEM ceiling
+    rlp = -(-rl // br) * br
+
+    row = P(data_axis)
+    xsh = NamedSharding(mesh, P(data_axis, None))
+    rsh = NamedSharding(mesh, row)
+    if mask is None:
+        import numpy as np
+
+        mask = jax.device_put(np.ones(n, np.float32), rsh)
+
+    @functools.partial(jax.jit, out_shardings=(xsh, rsh, rsh))
+    def relayout(Xg, yg, mg):
+        # pad only the per-shard row tail and the width — both
+        # unsharded axes after the (k, rl, d) reshape, so the relayout
+        # is shard-local by construction
+        X3 = jnp.pad(Xg.reshape(k, rl, d),
+                     ((0, 0), (0, rlp - rl), (0, dp - d)))
+        y3 = jnp.pad(yg.astype(jnp.float32).reshape(k, rl),
+                     ((0, 0), (0, rlp - rl)))
+        m3 = jnp.pad(mg.astype(jnp.float32).reshape(k, rl),
+                     ((0, 0), (0, rlp - rl)))
+        return (X3.reshape(k * rlp, dp), y3.reshape(-1), m3.reshape(-1))
+
+    Xp, yp, mp = relayout(X, y, mask)
+
+    in_specs = (P(), P(data_axis, None), row, row)
+    out_specs = (P(), P(), P())
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)
+    def _eval(w, Xs, ys, ms):
+        from ..ops.losses import _count
+
+        padded = PaddedDense(Xs, ys[:, None], ms[:, None],
+                             _count(Xs, ms), rlp, d)
+        ls, gs = fused_margin_loss_grad(
+            gradient.inner, w, padded, interpret=gradient._interpret,
+            block_rows=br)
+        dt = jnp.result_type(w)
+        ls = lax.psum(ls.astype(dt), data_axis)
+        gs = lax.psum(gs.astype(dt), data_axis)
+        n_tot = lax.psum(padded.n_valid, data_axis)
+        return ls, gs, n_tot
+
+    def smooth(w):
+        ls, gs, n_tot = _eval(w, Xp, yp, mp)
+        return _finish(ls, gs, n_tot)
+
+    def smooth_loss(w):
+        ls, _, n_tot = _eval(w, Xp, yp, mp)
+        return ls / jnp.asarray(n_tot, ls.dtype)
+
+    return smooth, smooth_loss
+
+
 def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
     """Explicit SPMD: per-shard kernel + one psum — seqOp/combOp in one op."""
+    from ..ops.pallas_kernels import PallasMarginGradient
+
+    if isinstance(gradient, PallasMarginGradient):
+        built = _make_shard_map_pallas(gradient, X, y, mask, mesh,
+                                       data_axis)
+        if built is not None:
+            return built
     has_mask = mask is not None
     row = P(data_axis)
     xspec = P(data_axis, *([None] * (X.ndim - 1)))
