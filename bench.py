@@ -46,7 +46,6 @@ Diagnostics go to stderr; stdout is exactly one JSON line.
 from __future__ import annotations
 
 import json
-import math
 import os
 import subprocess
 import sys
@@ -128,14 +127,35 @@ def probe_backend():
     return d
 
 
-def make_data(seed=7):
-    rng = np.random.default_rng(seed)
-    X = rng.standard_normal((N_ROWS, N_FEATURES)).astype(np.float32)
-    w_true = rng.standard_normal(N_FEATURES).astype(np.float32) / math.sqrt(
-        N_FEATURES)
-    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
-    y = (rng.random(N_ROWS) < p).astype(np.float32)
-    return X, y
+def make_data_device(seed=7):
+    """Generate the bench dataset ON the accelerator (no bulk H2D).
+
+    ``data.device_synth.class_logistic`` is elementwise-only, so the host
+    twin generated by ``make_data_host`` has bit-identical labels and
+    ulp-identical features — the f64 CPU oracle and the TPU run see the
+    same logical dataset while only a PRNG key ever crosses the
+    host↔device link (which is the environment's least reliable part:
+    round-1/2 outages were bulk-staging hangs, AVAILABILITY.md).
+    """
+    import jax
+
+    from spark_agd_tpu.data import device_synth
+
+    key = jax.random.PRNGKey(seed)
+    return device_synth.device_gen(
+        lambda k: device_synth.class_logistic(k, N_ROWS, N_FEATURES), key)
+
+
+def make_data_host(seed=7):
+    """The CPU-backend twin of ``make_data_device`` (same bits)."""
+    import jax
+
+    from spark_agd_tpu.data import device_synth
+
+    key = jax.random.PRNGKey(seed)
+    Xh, yh = device_synth.host_gen(
+        lambda k: device_synth.class_logistic(k, N_ROWS, N_FEATURES), key)
+    return np.asarray(Xh), np.asarray(yh)
 
 
 def _make_step(gradient, Xd, yd, num_iterations):
@@ -305,16 +325,21 @@ def bench_cpu(X, y):
 
 
 def run_bench():
+    import jax
     import jax.numpy as jnp
 
+    from spark_agd_tpu.data import device_synth
+
+    device_synth.ensure_cpu_backend()  # before first backend touch
     device = probe_backend()
     log(f"data: {N_ROWS}x{N_FEATURES} f32 "
-        f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB)")
-    X, y = make_data()
-    # One H2D transfer; every consumer below shares the device arrays.
-    Xd32, yd = jnp.asarray(X), jnp.asarray(y)
+        f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB), generated on-device")
+    t0 = time.perf_counter()
+    Xd32, yd = make_data_device()
+    jax.block_until_ready(Xd32)
+    log(f"on-device generation {time.perf_counter() - t0:.1f}s")
     Xd = Xd32.astype(jnp.bfloat16) if BENCH_DTYPE == "bf16" else Xd32
-    w0 = jnp.zeros(X.shape[1], jnp.float32)
+    w0 = jnp.zeros(N_FEATURES, jnp.float32)
     xla, xla_hist, compile_s = bench_tpu(Xd, yd, w0, device)
     pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
     # The other dtype's XLA number rides along (bf16 halves the dominant
@@ -330,7 +355,10 @@ def run_bench():
             alt, _, _ = bench_tpu(Xd32.astype(alt_dt), yd, w0, device)
         except Exception as e:  # noqa: BLE001 — comparison point only
             log(f"alt-dtype run failed: {type(e).__name__}: {e}")
-    cpu_ips, cpu_res = bench_cpu(X, y)
+    t0 = time.perf_counter()
+    Xh, yh = make_data_host()
+    log(f"host-twin generation {time.perf_counter() - t0:.1f}s")
+    cpu_ips, cpu_res = bench_cpu(Xh, yh)
     check_parity(Xd32, yd, w0, cpu_res.loss_history)
 
     # Loose sanity check on the default-precision headline trajectory —

@@ -7,35 +7,35 @@ display-ads ~13 numeric + 26 categorical features (stand-in: 1,024 hashed
 dense features).  Labels are drawn from a planted linear/MLP model so the
 optimization problem is non-degenerate and the loss trajectories are
 meaningful, not noise-fitting.
+
+All generators run ON DEVICE (``jax.random`` on the default backend) —
+the data is produced in the HBM that will consume it, and the host↔device
+link carries only PRNG keys.  See ``spark_agd_tpu.data.device_synth`` for
+why this matters on the tunneled bench environment (multi-GiB
+``device_put`` is the least reliable primitive there) and why it is also
+the TPU-native design.
 """
 
 from __future__ import annotations
 
-import numpy as np
+import jax
 
+from spark_agd_tpu.data import device_synth as synth
 from spark_agd_tpu.ops.sparse import CSRMatrix
 
 
 def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
-                    seed: int, binary_labels=True):
+                    seed: int):
     """Random CSR with exactly nnz_per_row entries/row and labels from a
-    planted sparse logistic model."""
-    rng = np.random.default_rng(seed)
-    nnz = n_rows * nnz_per_row
-    col_ids = rng.integers(0, n_features, nnz).astype(np.int32)
-    row_ids = np.repeat(np.arange(n_rows, dtype=np.int32), nnz_per_row)
-    values = rng.standard_normal(nnz).astype(np.float32)
-    # planted weights over ALL features, scaled so each row's margin has
-    # unit variance (sum of nnz_per_row products of two unit normals) —
-    # every row carries signal, none is a coin flip
-    w = (rng.standard_normal(n_features).astype(np.float32)
-         / np.sqrt(nnz_per_row))
-    margins = np.zeros(n_rows, np.float32)
-    np.add.at(margins, row_ids, values * w[col_ids])
-    p = 1.0 / (1.0 + np.exp(-margins))
-    y = (rng.random(n_rows) < p).astype(np.float32)
+    planted sparse logistic model, generated on device."""
+    row_ids, col_ids, values, y = jax.jit(
+        synth.planted_sparse_parts,
+        static_argnums=(1, 2, 3))(jax.random.PRNGKey(seed), n_rows,
+                                  n_features, nnz_per_row)
     # rows are sorted by construction; carry the column-sorted twin so the
-    # gradient path runs sorted segment-sums on TPU (ops.sparse docstring)
+    # gradient path runs sorted segment-sums on TPU (ops.sparse docstring).
+    # Lazy: Gradient.prepare / shard_csr_batch materializes it at
+    # placement (on device, via jnp.argsort).
     X = CSRMatrix(row_ids, col_ids, values, (n_rows, n_features),
                   rows_sorted=True).with_csc(lazy=True)
     return X, y
@@ -54,23 +54,15 @@ def url_like(scale: float = 1.0, seed: int = 1):
 def dense_linreg(scale: float = 1.0, seed: int = 2):
     """BASELINE config 2: synthetic dense 10M x 1K least squares."""
     n = max(1024, int(10_000_000 * scale))
-    d = 1000
-    rng = np.random.default_rng(seed)
-    X = rng.standard_normal((n, d)).astype(np.float32)
-    w = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
-    y = X @ w + 0.1 * rng.standard_normal(n).astype(np.float32)
-    return X, y.astype(np.float32)
+    return jax.jit(synth.planted_dense_linreg, static_argnums=(1, 2))(
+        jax.random.PRNGKey(seed), n, 1000)
 
 
 def mnist8m_like(scale: float = 1.0, seed: int = 3):
     """BASELINE config 4 geometry: 8.1M x 784, 10 classes."""
     n = max(1024, int(8_100_000 * scale))
-    d, k = 784, 10
-    rng = np.random.default_rng(seed)
-    X = rng.standard_normal((n, d)).astype(np.float32)
-    W = rng.standard_normal((d, k)).astype(np.float32) / np.sqrt(d)
-    logits = X @ W + rng.gumbel(size=(n, k)).astype(np.float32)
-    return X, np.argmax(logits, axis=1).astype(np.int32)
+    return jax.jit(synth.planted_softmax, static_argnums=(1, 2, 3))(
+        jax.random.PRNGKey(seed), n, 784, 10)
 
 
 def criteo_like(scale: float = 1.0, seed: int = 4):
@@ -78,12 +70,5 @@ def criteo_like(scale: float = 1.0, seed: int = 4):
     labels from a planted two-layer MLP (so the MLP config has signal a
     linear model cannot fully capture)."""
     n = max(1024, int(1_000_000 * scale))
-    d, h = 1024, 32
-    rng = np.random.default_rng(seed)
-    X = rng.standard_normal((n, d)).astype(np.float32)
-    W1 = rng.standard_normal((d, h)).astype(np.float32) / np.sqrt(d)
-    W2 = rng.standard_normal(h).astype(np.float32) / np.sqrt(h)
-    margins = np.tanh(X @ W1) @ W2
-    p = 1.0 / (1.0 + np.exp(-4.0 * margins))
-    y = (rng.random(n) < p).astype(np.int32)
-    return X, y
+    return jax.jit(synth.planted_mlp, static_argnums=(1, 2, 3))(
+        jax.random.PRNGKey(seed), n, 1024, 32)

@@ -125,24 +125,30 @@ def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
 def _cast_features(X, dtype: str):
     """Features to bf16 (values only — ids/labels/masks stay as-is): the
     TPU-native dtype, halving the dominant HBM traffic.  Weights and all
-    accumulation stay f32 through the kernels' promotion rules."""
+    accumulation stay f32 through the kernels' promotion rules.  Device-
+    resident features cast on device (no host round-trip)."""
     if dtype != "bf16":
         return X
+    import jax
+    import jax.numpy as jnp
     import ml_dtypes
     from spark_agd_tpu.ops.sparse import CSRMatrix
 
-    bf16 = ml_dtypes.bfloat16
+    def cast(a):
+        if isinstance(a, jax.Array):
+            return a.astype(jnp.bfloat16)
+        return np.asarray(a).astype(ml_dtypes.bfloat16)
+
     if isinstance(X, CSRMatrix):
         csc = {}
         if X.has_csc:
             csc = dict(csc_row_ids=X.csc_row_ids,
                        csc_col_ids=X.csc_col_ids,
-                       csc_values=np.asarray(X.csc_values).astype(bf16))
-        return CSRMatrix(X.row_ids, X.col_ids,
-                         np.asarray(X.values).astype(bf16), X.shape,
+                       csc_values=cast(X.csc_values))
+        return CSRMatrix(X.row_ids, X.col_ids, cast(X.values), X.shape,
                          rows_sorted=X.rows_sorted, want_csc=X.want_csc,
                          **csc)
-    return np.asarray(X).astype(bf16)
+    return cast(X)
 
 
 def run_config(config: BenchConfig, scale: float, iters: int,
@@ -291,6 +297,11 @@ def main(argv=None):
                   "error": f"make_data: {type(e).__name__}: {e}"[:500]})
             failures += 1
             continue
+        # The generated master is shared across every variant (the f32
+        # passes use it as-is; bf16/pallas passes hold master + cast
+        # copy, a ~1.5x-dataset HBM peak).  Each config's tpu_scale is
+        # sized with >=2x headroom so that peak fits one chip — see the
+        # per-config comments above.
         variants = [(dt, args.pallas, args.gd_cap) for dt in dtypes]
         if args.pallas_extra and cfg.pallas_ok and not args.pallas:
             variants.append(("f32", True, 0))

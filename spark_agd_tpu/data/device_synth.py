@@ -1,0 +1,157 @@
+"""On-device synthetic data generation (no host↔device bulk transfer).
+
+Why this module exists: the benchmark/check harnesses originally built
+datasets with host NumPy and staged them via one big ``device_put``.  On
+the tunneled single-chip environment the host↔device link is the least
+reliable component (observed: multi-GiB transfers hang indefinitely while
+on-device RNG generates 1 GiB in seconds and compiles go through fine).
+Generating the data *on the device that will consume it* removes the bulk
+transfer entirely — only scalars and PRNG keys cross the link — and is
+also the right TPU-native design: HBM is filled at HBM bandwidth by the
+chip's own PRNG instead of at tunnel bandwidth by the host.
+
+Cross-backend determinism: JAX's threefry PRNG produces identical random
+BITS for the same key on every backend.  Derived *floats* can differ by
+an ulp across backends (transcendental lowering), so any value that
+gates a discrete outcome (a label threshold) must be computed from raw
+bits/uniforms with exact arithmetic only.  ``class_logistic`` follows
+that rule — labels come from ``bernoulli(0.5)`` (exact compare against
+0.5), features from elementwise ops — so a CPU "host twin" of a TPU
+dataset has bit-identical labels and ulp-identical features.  That is
+what lets ``bench.py`` run its float64 host oracle on the same logical
+dataset without ever transferring it.
+
+Reference mapping: these generators replace the role of MLlib's
+``GradientDescentSuite.generateGDInput`` (reference
+``AcceleratedGradientDescentSuite.scala:46``) at benchmark scale — the
+synthetic fixture data the suite trains on, here produced where the
+FLOPs are.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_cpu_backend() -> None:
+    """Make sure the host CPU platform is registered alongside the
+    accelerator.
+
+    Driver environments pin ``JAX_PLATFORMS=axon`` (or ``tpu``), which
+    *unregisters* the CPU backend — but the host twin (`host_gen`) and
+    degraded fallbacks need it.  Appending ``,cpu`` before the first
+    backend touch restores it; a no-op when unset (all platforms) or
+    already listed.
+    """
+    cur = jax.config.jax_platforms
+    if cur and "cpu" not in [p.strip() for p in cur.split(",")]:
+        jax.config.update("jax_platforms", cur + ",cpu")
+
+
+def cpu_device():
+    ensure_cpu_backend()
+    return jax.local_devices(backend="cpu")[0]
+
+
+def class_logistic(key, n: int, d: int,
+                   sep: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+    """Two-class Gaussian mixture whose Bayes posterior IS a logistic
+    model: y ~ Bernoulli(1/2), x | y ~ N(±mu, I) with ``‖mu‖ ≈ sep``.
+
+    Elementwise-only (no matmuls/reductions) so a host twin is
+    bit-identical in labels and ulp-identical in features — see module
+    docstring.  Returns ``(X f32[n,d], y f32[n])`` with y in {0, 1}.
+    """
+    kx, ky, km = jax.random.split(key, 3)
+    y = jax.random.bernoulli(ky, 0.5, (n,))
+    mu = (sep / math.sqrt(d)) * jax.random.normal(km, (d,), jnp.float32)
+    signs = jnp.where(y, 1.0, -1.0).astype(jnp.float32)
+    X = jax.random.normal(kx, (n, d), jnp.float32) \
+        + signs[:, None] * mu[None, :]
+    return X, y.astype(jnp.float32)
+
+
+def device_gen(fn, *args, device=None):
+    """Run generator ``fn(*args)`` jitted on ``device`` (default: the
+    default backend's first device).  Fresh jit per call site keeps the
+    compile caches of different target devices independent."""
+    if device is None:
+        return jax.jit(fn)(*args)
+    with jax.default_device(device):
+        return jax.jit(fn)(*args)
+
+
+def host_gen(fn, *args):
+    """Run generator ``fn`` on the host CPU backend and return the
+    results as host-committed arrays (cheap ``np.asarray`` views)."""
+    return device_gen(fn, *args, device=cpu_device())
+
+
+# ---------------------------------------------------------------------------
+# Benchmark-geometry generators (device-side twins of benchmarks.datasets)
+# ---------------------------------------------------------------------------
+
+def planted_dense_linreg(key, n: int, d: int,
+                         noise: float = 0.1) -> Tuple[jax.Array, jax.Array]:
+    """Dense least-squares with a planted weight vector."""
+    kx, kw, ke = jax.random.split(key, 3)
+    X = jax.random.normal(kx, (n, d), jnp.float32)
+    w = jax.random.normal(kw, (d,), jnp.float32) / math.sqrt(d)
+    y = X @ w + noise * jax.random.normal(ke, (n,), jnp.float32)
+    return X, y
+
+
+def planted_softmax(key, n: int, d: int,
+                    k: int) -> Tuple[jax.Array, jax.Array]:
+    """Dense multiclass data: labels drawn from the planted softmax model
+    via the Gumbel-max trick (exactly a categorical sample)."""
+    kx, kw, kg = jax.random.split(key, 3)
+    X = jax.random.normal(kx, (n, d), jnp.float32)
+    W = jax.random.normal(kw, (d, k), jnp.float32) / math.sqrt(d)
+    logits = X @ W + jax.random.gumbel(kg, (n, k), jnp.float32)
+    return X, jnp.argmax(logits, axis=1).astype(jnp.int32)
+
+
+def planted_mlp(key, n: int, d: int, h: int,
+                gain: float = 4.0) -> Tuple[jax.Array, jax.Array]:
+    """Binary labels from a planted two-layer tanh MLP (signal a linear
+    model cannot fully capture — BASELINE config 5's stand-in)."""
+    kx, k1, k2, ku = jax.random.split(key, 4)
+    X = jax.random.normal(kx, (n, d), jnp.float32)
+    W1 = jax.random.normal(k1, (d, h), jnp.float32) / math.sqrt(d)
+    W2 = jax.random.normal(k2, (h,), jnp.float32) / math.sqrt(h)
+    margins = jnp.tanh(X @ W1) @ W2
+    p = jax.nn.sigmoid(gain * margins)
+    y = (jax.random.uniform(ku, (n,)) < p).astype(jnp.int32)
+    return X, y
+
+
+def planted_sparse_parts(key, n_rows: int, n_features: int,
+                         nnz_per_row: int):
+    """Device-side COO parts for a planted sparse logistic problem.
+
+    Returns ``(row_ids, col_ids, values, y)`` — row-sorted by
+    construction (ids repeat in blocks of ``nnz_per_row``).  The margin
+    uses a segment-sum, not a scatter, so generation itself is
+    TPU-friendly.  The caller wraps the parts in ``CSRMatrix`` (and can
+    request a device-built CSC twin — `ops.sparse.CSRMatrix.with_csc`
+    sorts with ``jnp.argsort`` when the entries live on device).
+    """
+    kc, kv, kw, ku = jax.random.split(key, 4)
+    nnz = n_rows * nnz_per_row
+    col_ids = jax.random.randint(kc, (nnz,), 0, n_features, jnp.int32)
+    row_ids = jnp.repeat(jnp.arange(n_rows, dtype=jnp.int32), nnz_per_row)
+    values = jax.random.normal(kv, (nnz,), jnp.float32)
+    # planted weights scaled so each row's margin has unit variance
+    w = jax.random.normal(kw, (n_features,), jnp.float32) \
+        / math.sqrt(nnz_per_row)
+    margins = jax.ops.segment_sum(values * jnp.take(w, col_ids),
+                                  row_ids, num_segments=n_rows,
+                                  indices_are_sorted=True)
+    p = jax.nn.sigmoid(margins)
+    y = (jax.random.uniform(ku, (n_rows,)) < p).astype(jnp.float32)
+    return row_ids, col_ids, values, y

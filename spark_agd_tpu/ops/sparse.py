@@ -106,10 +106,12 @@ class CSRMatrix:
         ``mesh.shard_csr_batch`` reads the flag and builds per-shard
         twins directly, never paying for a global one it would discard.
 
-        Eager builds sort once on the host, never inside a compiled
-        program, and match the residency of the source arrays:
-        host-numpy entries get a host-numpy twin, device entries a
-        device twin.
+        Eager builds sort once at placement time, never inside a
+        compiled program, and match the residency of the source arrays:
+        host-numpy entries sort on the host and get a host-numpy twin;
+        device entries sort ON DEVICE (``jnp.argsort``) — the twin is
+        built where the data lives, with no host round-trip over the
+        (possibly slow) host↔device link.
         """
         if self.has_csc or (lazy and self.want_csc):
             return self
@@ -117,15 +119,21 @@ class CSRMatrix:
             return CSRMatrix(self.row_ids, self.col_ids, self.values,
                              self.shape, rows_sorted=self.rows_sorted,
                              want_csc=True)
-        on_device = isinstance(self.values, jax.Array)
-        put = jnp.asarray if on_device else (lambda a: a)
+        if isinstance(self.values, jax.Array):
+            order = jnp.argsort(self.col_ids, stable=True)
+            return CSRMatrix(
+                self.row_ids, self.col_ids, self.values, self.shape,
+                csc_row_ids=jnp.take(self.row_ids, order),
+                csc_col_ids=jnp.take(self.col_ids, order),
+                csc_values=jnp.take(self.values, order),
+                rows_sorted=self.rows_sorted)
         cid = np.asarray(self.col_ids)
         order = np.argsort(cid, kind="stable")
         return CSRMatrix(
             self.row_ids, self.col_ids, self.values, self.shape,
-            csc_row_ids=put(np.asarray(self.row_ids)[order]),
-            csc_col_ids=put(cid[order]),
-            csc_values=put(np.asarray(self.values)[order]),
+            csc_row_ids=np.asarray(self.row_ids)[order],
+            csc_col_ids=cid[order],
+            csc_values=np.asarray(self.values)[order],
             rows_sorted=self.rows_sorted)
 
     @property

@@ -1,0 +1,122 @@
+"""Tests for on-device synthetic generation (`data.device_synth`) and the
+device-side CSC twin sort — the transfer-free staging layer the bench
+harness runs on (AVAILABILITY.md: bulk H2D is the environment's least
+reliable primitive, so benchmark data is generated where it is consumed).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_agd_tpu.data import device_synth as synth
+from spark_agd_tpu.ops.sparse import CSRMatrix
+
+
+class TestClassLogistic:
+    def test_geometry_and_signal(self):
+        X, y = synth.device_gen(
+            lambda k: synth.class_logistic(k, 4096, 32),
+            jax.random.PRNGKey(0))
+        assert X.shape == (4096, 32) and X.dtype == jnp.float32
+        assert set(np.unique(np.asarray(y))) <= {0.0, 1.0}
+        assert 0.4 < float(y.mean()) < 0.6  # Bernoulli(1/2) labels
+        # class-conditional means differ along a direction: the planted
+        # signal exists (a logistic model can separate better than chance)
+        Xn, yn = np.asarray(X), np.asarray(y)
+        delta = Xn[yn == 1].mean(0) - Xn[yn == 0].mean(0)
+        assert np.linalg.norm(delta) > 0.5  # ~2·sep/√d · √d = 2·sep
+
+    def test_host_twin_identical(self):
+        """host_gen must reproduce device_gen exactly (same backend here —
+        the cross-backend contract is labels bit-identical, features
+        ulp-identical; on one backend both are exact)."""
+        key = jax.random.PRNGKey(7)
+        Xd, yd = synth.device_gen(
+            lambda k: synth.class_logistic(k, 512, 16), key)
+        Xh, yh = synth.host_gen(
+            lambda k: synth.class_logistic(k, 512, 16), key)
+        np.testing.assert_array_equal(np.asarray(yd), np.asarray(yh))
+        np.testing.assert_array_equal(np.asarray(Xd), np.asarray(Xh))
+
+    def test_bench_twins_match(self):
+        """bench.py's device/host dataset pair must be the same logical
+        dataset (labels exactly, features to ulps)."""
+        import bench
+
+        old = bench.N_ROWS, bench.N_FEATURES
+        bench.N_ROWS, bench.N_FEATURES = 256, 8
+        try:
+            Xd, yd = bench.make_data_device()
+            Xh, yh = bench.make_data_host()
+        finally:
+            bench.N_ROWS, bench.N_FEATURES = old
+        np.testing.assert_array_equal(np.asarray(yd), yh)
+        np.testing.assert_allclose(np.asarray(Xd), Xh, rtol=1e-6)
+
+    def test_ensure_cpu_backend_noop_when_unset(self):
+        # under the test env jax_platforms is 'cpu'; must stay usable
+        synth.ensure_cpu_backend()
+        assert synth.cpu_device().platform == "cpu"
+
+
+class TestPlantedGenerators:
+    def test_sparse_parts_sorted_and_planted(self):
+        rows, cols, vals, y = synth.device_gen(
+            lambda k: synth.planted_sparse_parts(k, 1024, 4096, 16),
+            jax.random.PRNGKey(1))
+        rows = np.asarray(rows)
+        assert (np.diff(rows) >= 0).all()  # row-sorted by construction
+        assert rows.shape == cols.shape == vals.shape == (1024 * 16,)
+        assert set(np.unique(np.asarray(y))) <= {0.0, 1.0}
+        assert 0.2 < float(np.asarray(y).mean()) < 0.8
+
+    def test_dense_generators_shapes(self):
+        k = jax.random.PRNGKey(2)
+        X, y = synth.device_gen(
+            lambda kk: synth.planted_dense_linreg(kk, 256, 32), k)
+        assert X.shape == (256, 32) and y.shape == (256,)
+        X, y = synth.device_gen(
+            lambda kk: synth.planted_softmax(kk, 256, 32, 7), k)
+        assert y.dtype == jnp.int32
+        assert set(np.unique(np.asarray(y))) <= set(range(7))
+        X, y = synth.device_gen(
+            lambda kk: synth.planted_mlp(kk, 256, 32, 8), k)
+        assert set(np.unique(np.asarray(y))) <= {0, 1}
+
+
+class TestDeviceCscTwin:
+    def test_device_sort_matches_host_sort(self):
+        """with_csc on device arrays (jnp.argsort path) must produce the
+        same twin as the host path — including stable-sort order, so the
+        padding-at-last-slot contract survives."""
+        rng = np.random.default_rng(3)
+        n, d, nnz = 64, 40, 512
+        rows = np.sort(rng.integers(0, n, nnz)).astype(np.int32)
+        cols = rng.integers(0, d, nnz).astype(np.int32)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        host = CSRMatrix(rows, cols, vals, (n, d),
+                         rows_sorted=True).with_csc()
+        dev = CSRMatrix(jnp.asarray(rows), jnp.asarray(cols),
+                        jnp.asarray(vals), (n, d),
+                        rows_sorted=True).with_csc()
+        assert isinstance(dev.csc_values, jax.Array)
+        np.testing.assert_array_equal(np.asarray(dev.csc_col_ids),
+                                      np.asarray(host.csc_col_ids))
+        np.testing.assert_array_equal(np.asarray(dev.csc_row_ids),
+                                      np.asarray(host.csc_row_ids))
+        np.testing.assert_array_equal(np.asarray(dev.csc_values),
+                                      np.asarray(host.csc_values))
+
+    def test_device_csc_products_match(self):
+        rng = np.random.default_rng(4)
+        n, d, nnz = 32, 24, 256
+        rows = np.sort(rng.integers(0, n, nnz)).astype(np.int32)
+        cols = rng.integers(0, d, nnz).astype(np.int32)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        X = CSRMatrix(jnp.asarray(rows), jnp.asarray(cols),
+                      jnp.asarray(vals), (n, d), rows_sorted=True)
+        Xc = X.with_csc()
+        v = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(Xc.rmatvec(v)),
+                                   np.asarray(X.rmatvec(v)),
+                                   rtol=2e-5, atol=2e-5)
