@@ -158,7 +158,7 @@ def make_data_host(seed=7):
     return np.asarray(Xh), np.asarray(yh)
 
 
-def _make_step(gradient, Xd, yd, num_iterations):
+def _make_step(gradient, Xd, yd, num_iterations, loss_mode="x"):
     import jax
 
     from spark_agd_tpu.core import agd, smooth as smooth_lib
@@ -169,7 +169,8 @@ def _make_step(gradient, Xd, yd, num_iterations):
     sm = smooth_lib.make_smooth(gradient, Xd, yd, mask)
     sl = smooth_lib.make_smooth_loss(gradient, Xd, yd, mask)
     px, rv = smooth_lib.make_prox(L2Prox(), REG)
-    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=num_iterations)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=num_iterations,
+                        loss_mode=loss_mode)
     return jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
 
 
@@ -355,6 +356,24 @@ def run_bench():
             alt, _, _ = bench_tpu(Xd32.astype(alt_dt), yd, w0, device)
         except Exception as e:  # noqa: BLE001 — comparison point only
             log(f"alt-dtype run failed: {type(e).__name__}: {e}")
+    # Loss-mode ride-along (SURVEY §7 hard part 5 — "benchmark both"):
+    # 'x_strict' recomputes the loss-history pass like the reference
+    # (cost parity: its gap to the headline IS the measured win of
+    # fusing the third pass away); 'y' is the cheaper variant the
+    # reference left commented out.  Opt-in like the alt dtype.
+    loss_modes = {}
+    if device.platform == "tpu" and \
+            os.environ.get("BENCH_LOSS_MODES") == "1":
+        from spark_agd_tpu.ops.losses import LogisticGradient
+        for lm in ("x_strict", "y"):
+            try:
+                step = _make_step(LogisticGradient(), Xd, yd,
+                                  NUM_ITERS_TPU, loss_mode=lm)
+                res, run_s, _ = _time_step(step, w0)
+                loss_modes[lm] = round(int(res.num_iters) / run_s, 2)
+                log(f"loss_mode={lm}: {loss_modes[lm]} iters/sec")
+            except Exception as e:  # noqa: BLE001 — comparison point only
+                log(f"loss_mode={lm} failed: {type(e).__name__}: {e}")
     t0 = time.perf_counter()
     Xh, yh = make_data_host()
     log(f"host-twin generation {time.perf_counter() - t0:.1f}s")
@@ -402,6 +421,8 @@ def run_bench():
         out[f"{alt_name}_hbm_bw_frac"] = (
             None if alt["hbm_bw_frac"] is None
             else round(alt["hbm_bw_frac"], 3))
+    for lm, ips in loss_modes.items():
+        out[f"loss_mode_{lm}_iters_per_sec"] = ips
     if device.platform != "tpu":
         out["error"] = "degraded: not running on a TPU backend"
     return out

@@ -194,7 +194,12 @@ def main(argv=None):
                    help="seconds the watchdog allows jax.devices() "
                         "(observed queue: ~25 min then UNAVAILABLE)")
     p.add_argument("--probe-budget", type=float, default=420)
-    p.add_argument("--bench-budget", type=float, default=1800)
+    p.add_argument("--bench-budget", type=float, default=2700,
+                   help="covers headline + pallas + parity + the alt-"
+                        "dtype and loss-mode ride-alongs (each a full "
+                        "compile): ~6 compiles at the observed worst-"
+                        "case ~5 min/compile must fit, else the watchdog "
+                        "discards already-measured results")
     p.add_argument("--checks-budget", type=float, default=1800)
     p.add_argument("--configs-budget", type=float, default=1200,
                    help="per-config budget (each config re-arms it)")
@@ -243,6 +248,8 @@ def main(argv=None):
         stage("bench", args.bench_budget)
         os.environ.setdefault("BENCH_ALT_DTYPE", "1")  # in-process: no
         # worker timeout to protect, so measure both dtypes
+        os.environ.setdefault("BENCH_LOSS_MODES", "1")  # + the reference-
+        # cost-parity ('x_strict') and cheap ('y') loss-history modes
         import bench
 
         try:
