@@ -82,6 +82,31 @@ class TestCollectiveCount:
         for op in ("all-gather", "collective-permute", "all-to-all"):
             assert count_ops(hlo5, op) == 0
 
+    def test_loss_mode_pass_counts(self, dp_problem):
+        """SURVEY §3.1's cost table, pinned in the compiled program: the
+        reference pays a THIRD distributed pass per iteration for its
+        loss history (``:302-307``); ``loss_mode='x'`` fuses it away
+        (reuses the backtracking trial's f(x)), ``'x_strict'`` recomputes
+        it for reference cost parity, ``'y'`` is the cheap commented-out
+        variant.  The modes' all-reduce counts must reflect exactly
+        that: strict = one extra reduce phase, y = no extra."""
+        sm, sl, w0 = dp_problem
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+
+        def n_reduces(mode):
+            cfg = agd.AGDConfig(num_iterations=10, convergence_tol=0.0,
+                                loss_mode=mode)
+            hlo = compiled_text(
+                lambda w: agd.run_agd(sm, px, rv, w, cfg,
+                                      smooth_loss=sl), w0)
+            return count_ops(hlo, "all-reduce")
+
+        n_x, n_strict, n_y = (n_reduces(m) for m in ("x", "x_strict", "y"))
+        assert n_strict > n_x, (
+            f"x_strict must pay an extra reduce phase per iteration "
+            f"(reference's third pass): strict={n_strict} x={n_x}")
+        assert n_y <= n_x, f"y-mode must not cost more: y={n_y} x={n_x}"
+
     def test_no_host_transfers_in_loop(self, dp_problem):
         """No outfeed/infeed/send/recv anywhere in the compiled loop —
         the fused program never talks to the host mid-run (the
