@@ -19,7 +19,18 @@ yet, and ``jax_num_cpu_devices`` is the modern replacement for
 ``--xla_force_host_platform_device_count``.
 """
 
-import jax
+import os
+
+# The suite is CPU-only by design; child processes it spawns (the
+# 2-process multihost smoke, bench-worker tests) must not re-run the
+# tunneled-TPU registration in THEIR sitecustomize — when the tunnel is
+# wedged that registration hangs at interpreter startup (AVAILABILITY.md)
+# and the child never reaches its own platform config.  The parent
+# process already survived registration by the time conftest runs;
+# dropping the trigger var here makes every child start clean.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)

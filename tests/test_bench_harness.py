@@ -1,0 +1,165 @@
+"""Tests for the benchmark harness's FAILURE machinery — the paths the
+round's perf evidence depends on when the TPU tunnel misbehaves
+(AVAILABILITY.md): bench.py's degraded-but-parseable fallback chain and
+tpu_all.py's watchdog + H2D-wedge marker protocol.
+
+Round 1 failed precisely here (BENCH_r01.json: rc=1, parsed null), so
+the recovery machinery is load-bearing and gets its own coverage.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load("bench_under_test", os.path.join(REPO, "bench.py"))
+
+
+@pytest.fixture(scope="module")
+def tpu_all():
+    return _load("tpu_all_under_test", os.path.join(REPO, "tpu_all.py"))
+
+
+class TestBenchFallbackChain:
+    def test_cpu_fallback_after_worker_failures(self, bench, monkeypatch,
+                                                capsys):
+        """Both worker attempts fail -> in-process CPU fallback must still
+        emit ONE parseable JSON line with a degraded error marker and a
+        real measurement (the driver parses exactly this)."""
+        monkeypatch.setattr(bench, "_run_worker", lambda tag: None)
+        monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+        monkeypatch.setattr(bench, "N_ROWS", 2048)
+        monkeypatch.setattr(bench, "NUM_ITERS_TPU", 3)
+        monkeypatch.setattr(bench, "NUM_ITERS_CPU", 2)
+        monkeypatch.setattr(bench, "PARITY_ITERS", 2)
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+        assert exc.value.code == 1  # degraded -> nonzero, but parseable:
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.strip()]
+        out = json.loads(lines[-1])
+        assert out["error"].startswith("degraded-to-cpu")
+        assert out["unit"] == "iters/sec"
+        assert out["value"] > 0  # a real measured number, not a stub
+        assert out["vs_baseline"] > 0
+
+    def test_error_json_always_parseable(self, bench):
+        out = bench._error_json("x" * 1000)
+        assert json.loads(json.dumps(out))["value"] == 0.0
+        assert len(out["error"]) <= 500
+
+    def test_worker_rejects_garbage_stdout(self, bench, monkeypatch):
+        """A worker that prints non-JSON (library noise) must read as a
+        failed attempt, not crash the orchestrator."""
+
+        class FakeProc:
+            returncode = 0
+            stdout = b"some warning\nnot json at all\n"
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        assert bench._run_worker("t") is None
+
+    def test_worker_keeps_degraded_record(self, bench, monkeypatch):
+        """A degraded-but-complete record (e.g. CPU-only box) must be
+        KEPT — retrying cannot improve it."""
+        rec = {"value": 1.0, "error": "degraded: not a TPU"}
+
+        class FakeProc:
+            returncode = 1
+            stdout = json.dumps(rec).encode() + b"\n"
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        assert bench._run_worker("t") == rec
+
+    def test_chip_peaks_table(self, bench):
+        assert bench.chip_peaks("TPU v5 lite") == (197.0, 819.0)
+        assert bench.chip_peaks("TPU v6e") == (918.0, 1640.0)
+        assert bench.chip_peaks("Tesla V100") is None
+
+
+class TestWatchdog:
+    def test_fires_on_stalled_stage(self, tmp_path):
+        """A stage that blocks past its budget must take the process down
+        with the dedicated exit code (fresh interpreter: os._exit kills)."""
+        script = (
+            "import importlib.util, threading, time\n"
+            f"spec = importlib.util.spec_from_file_location('ta', "
+            f"{os.path.join(REPO, 'tpu_all.py')!r})\n"
+            "ta = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(ta)\n"
+            "threading.Thread(target=ta._watchdog_loop, daemon=True)"
+            ".start()\n"
+            "ta.stage('stall', 1)\n"
+            "time.sleep(30)\n"
+            "print('NOT KILLED')\n"
+        )
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, timeout=60)
+        assert proc.returncode == 97, proc.stderr.decode()[-500:]
+        assert b"NOT KILLED" not in proc.stdout
+
+    def test_stage_disarms_then_rearms(self, tpu_all):
+        tpu_all.stage("a", 100)
+        assert tpu_all._WD["deadline"] is not None
+        tpu_all.stage("b")  # no budget -> disarmed
+        assert tpu_all._WD["deadline"] is None
+        tpu_all._WD["stage"] = ""
+
+
+class TestH2DMarkerProtocol:
+    def test_marker_skips_and_clears(self, tpu_all, tmp_path, monkeypatch,
+                                     cpu_devices):
+        """A marker left by a cycle that died mid-H2D-probe must make the
+        next cycle skip the H2D probe (no-H2D mode) AND clear the marker
+        so the cycle after re-measures."""
+        import argparse
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("TPU_H2D_MBPS", raising=False)
+        monkeypatch.setattr(tpu_all, "PROBE_RNG_SHAPE", (256, 1024))
+        open(tpu_all.H2D_MARKER, "w").close()
+        args = argparse.Namespace(tag="t", probe_budget=300)
+        dev = cpu_devices[0]
+        tpu_all._probe_stage(dev, 0.1, args)
+        assert os.environ.pop("TPU_H2D_MBPS") == "0"
+        assert not os.path.exists(tpu_all.H2D_MARKER)  # re-probe next time
+        rec = json.loads(open("TPU_PROBE_t.json").read())
+        assert rec["h2d_mibps"] == 0.0
+        assert "prior cycle died" in rec["h2d_note"]
+        tpu_all._WD["deadline"] = None
+
+    def test_probe_records_h2d_rate(self, tpu_all, tmp_path, monkeypatch,
+                                    cpu_devices):
+        import argparse
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.delenv("TPU_H2D_MBPS", raising=False)
+        monkeypatch.setattr(tpu_all, "PROBE_RNG_SHAPE", (256, 1024))
+        args = argparse.Namespace(tag="t2", probe_budget=300)
+        tpu_all._probe_stage(cpu_devices[0], 0.1, args)
+        rec = json.loads(open("TPU_PROBE_t2.json").read())
+        assert rec["h2d_mibps"] > 0
+        assert rec["rng_1gib_s"] > 0
+        assert float(os.environ.pop("TPU_H2D_MBPS")) == rec["h2d_mibps"]
+        assert not os.path.exists(tpu_all.H2D_MARKER)
+        tpu_all._WD["deadline"] = None

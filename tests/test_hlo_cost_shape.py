@@ -102,9 +102,12 @@ class TestCollectiveCount:
             return count_ops(hlo, "all-reduce")
 
         n_x, n_strict, n_y = (n_reduces(m) for m in ("x", "x_strict", "y"))
-        assert n_strict > n_x, (
-            f"x_strict must pay an extra reduce phase per iteration "
-            f"(reference's third pass): strict={n_strict} x={n_x}")
+        # one extra evaluation = one extra reduce phase of 1-3 all-reduces
+        # (same merge latitude as test_smooth_eval_single_reduce_phase)
+        assert n_x < n_strict <= n_x + 3, (
+            f"x_strict must pay exactly one extra reduce phase per "
+            f"iteration (reference's third pass): strict={n_strict} "
+            f"x={n_x}")
         assert n_y <= n_x, f"y-mode must not cost more: y={n_y} x={n_x}"
 
     def test_no_host_transfers_in_loop(self, dp_problem):

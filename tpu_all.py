@@ -35,6 +35,7 @@ import time
 # died mid-probe tells the NEXT cycle the tunnel's bulk path is wedged.
 H2D_MARKER = ".tpu_h2d_probe_inflight"
 WATCHDOG_EXIT = 97
+PROBE_RNG_SHAPE = (1 << 18, 1024)  # 1 GiB f32 (tests shrink this)
 
 
 def log(msg):
@@ -110,8 +111,8 @@ def _probe_stage(d, claim_s, args):
     jax.block_until_ready(r)
     rec["tiny_compile_s"] = round(time.perf_counter() - t0, 2)
     t0 = time.perf_counter()
-    X = jax.random.normal(jax.random.PRNGKey(0), (1 << 18, 1024),
-                          jnp.float32)  # 1 GiB
+    X = jax.random.normal(jax.random.PRNGKey(0), PROBE_RNG_SHAPE,
+                          jnp.float32)
     jax.block_until_ready(X)
     rec["rng_1gib_s"] = round(time.perf_counter() - t0, 2)
     t0 = time.perf_counter()
