@@ -330,8 +330,15 @@ def run_bench():
     import jax.numpy as jnp
 
     from spark_agd_tpu.data import device_synth
+    from spark_agd_tpu.utils import compile_cache
 
     device_synth.ensure_cpu_backend()  # before first backend touch
+    try:
+        # retry/fallback runs reuse this run's executables instead of
+        # recompiling; purely an optimization, never a gate
+        compile_cache.enable()
+    except Exception as e:  # noqa: BLE001
+        log(f"compilation cache unavailable: {type(e).__name__}: {e}")
     device = probe_backend()
     log(f"data: {N_ROWS}x{N_FEATURES} f32 "
         f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB), generated on-device")

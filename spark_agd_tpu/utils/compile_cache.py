@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache (compile once, reuse across runs).
+
+The fused AGD program's first compile costs 20–40 s on TPU (more over a
+tunneled backend), and every fresh process pays it again — the reference
+has no analogue (the JVM re-JITs per run), but a framework whose unit of
+execution is one big compiled program should not.  Enabling the disk
+cache makes every later process (a retried benchmark cycle, a
+hyper-parameter sweep, a resumed job) deserialize the executable instead
+of recompiling, which on the pooled single-chip bench environment
+converts directly into measurement time (AVAILABILITY.md: chip claims
+are scarce; recompiles burn them).
+
+Thin by design: one call, idempotent, safe on every backend (backends
+without executable serialization just log a JAX warning and skip).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "spark_agd_tpu", "xla")
+
+
+def enable(path: Optional[str] = None, *,
+           min_compile_time_secs: float = 1.0) -> str:
+    """Turn on JAX's persistent compilation cache at ``path``.
+
+    Call before the first compile (later calls still help later
+    compiles).  ``min_compile_time_secs`` skips caching trivial programs
+    (set 0 to cache everything, as tests do).  Returns the cache dir.
+    """
+    import jax
+
+    path = path or os.environ.get("SPARK_AGD_COMPILE_CACHE", DEFAULT_DIR)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_time_secs)
+    # The cache object initializes lazily at the FIRST compile and then
+    # latches; if anything compiled before enable() (e.g. the
+    # environment's sitecustomize touching the backend), the new dir
+    # would silently never take effect.  Reset so it re-initializes.
+    from jax.experimental.compilation_cache import compilation_cache
+    compilation_cache.reset_cache()
+    return path

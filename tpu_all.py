@@ -220,8 +220,16 @@ def main(argv=None):
     import jax
 
     from spark_agd_tpu.data import device_synth
+    from spark_agd_tpu.utils import compile_cache
 
     device_synth.ensure_cpu_backend()  # host twins need the cpu backend
+    try:
+        # a retried cycle must not pay every compile again out of its
+        # scarce claim time — but the cache is an optimization, never a
+        # gate (e.g. read-only HOME must not burn the claim)
+        log(f"compilation cache: {compile_cache.enable()}")
+    except Exception as e:  # noqa: BLE001
+        log(f"compilation cache unavailable: {type(e).__name__}: {e}")
     stage("claim", args.claim_budget)
     devs = jax.devices()  # THE claim; may queue behind the pool
     stage("claimed")  # disarm NOW — a claim that lands at 1699s of a
