@@ -1,0 +1,18 @@
+#!/bin/bash
+# Retry tpu_all.py until all artifacts exist; log each cycle.
+# The per-stage watchdog inside tpu_all.py (exit 97) converts hangs into
+# fast retries; this outer timeout is only a belt-and-braces backstop.
+cd /root/repo
+n=0
+while true; do
+  n=$((n+1))
+  echo "=== cycle $n start $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+  timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag r02 >> /tmp/tpu_watch.log 2>&1
+  rc=$?
+  echo "=== cycle $n end rc=$rc $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+  if [ -f BENCH_MANUAL_r02.json ] && [ -f TPU_CHECKS_r02.json ] && [ -f BENCH_CONFIGS_r02.json ] && [ $rc -eq 0 ]; then
+    echo "=== ALL ARTIFACTS DONE $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+    break
+  fi
+  sleep 30
+done
