@@ -1,0 +1,82 @@
+"""Tests for the five-config harness CLI wrapper (`benchmarks.run.main`)
+— the exact per-config invocation `tpu_all.py` makes under a chip claim,
+so its argument validation, artifact appending, and variant expansion
+get coverage off-chip."""
+
+import json
+
+import pytest
+
+from benchmarks import run as bench_run
+
+
+class TestMain:
+    def test_writes_artifact_lines(self, tmp_path, capsys):
+        out = tmp_path / "rec.json"
+        with pytest.raises(SystemExit) as exc:
+            bench_run.main(["--config", "1", "--scale", "0.0003",
+                            "--iters", "2", "--out", str(out)])
+        assert exc.value.code == 0
+        lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert len(lines) == 1
+        rec = lines[0]
+        assert rec["config"] == 1 and rec["iters"] >= 1
+        # stdout carries the same records for the log
+        stdout_recs = [json.loads(ln) for ln in
+                       capsys.readouterr().out.splitlines() if ln.strip()]
+        assert stdout_recs == lines
+
+    def test_out_appends_across_invocations(self, tmp_path):
+        """tpu_all truncates once then relies on append-per-invocation."""
+        out = tmp_path / "rec.json"
+        for cfg in ("1", "5"):
+            with pytest.raises(SystemExit):
+                bench_run.main(["--config", cfg, "--scale", "0.0003",
+                                "--iters", "2", "--out", str(out)])
+        recs = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert [r["config"] for r in recs] == [1, 5]
+
+    def test_dtype_and_pallas_extra_variants(self, tmp_path):
+        """--dtype f32,bf16 --pallas-extra on an eligible config yields
+        exactly three records: f32, bf16, and the fused-kernel f32."""
+        out = tmp_path / "rec.json"
+        with pytest.raises(SystemExit) as exc:
+            bench_run.main(["--config", "2", "--scale", "0.0003",
+                            "--iters", "2", "--dtype", "f32,bf16",
+                            "--pallas-extra", "--out", str(out)])
+        assert exc.value.code == 0
+        recs = [json.loads(ln) for ln in out.read_text().splitlines()]
+        assert [(r["dtype"], r["pallas"]) for r in recs] == [
+            ("f32", False), ("bf16", False), ("f32", True)]
+        losses = [r["final_loss"] for r in recs]
+        # same dataset (device gen is deterministic per config seed)
+        assert max(losses) - min(losses) < 1e-2
+
+    def test_rejects_unknown_config_and_dtype(self):
+        with pytest.raises(SystemExit) as exc:
+            bench_run.main(["--config", "9"])
+        assert exc.value.code == 2  # argparse error
+        with pytest.raises(SystemExit) as exc:
+            bench_run.main(["--config", "1", "--dtype", "f16"])
+        assert exc.value.code == 2
+
+    def test_failed_config_records_error_and_continues(self, tmp_path,
+                                                       monkeypatch):
+        out = tmp_path / "rec.json"
+
+        import dataclasses
+
+        def boom(scale, seed=0):
+            raise RuntimeError("dataset exploded")
+
+        broken = dataclasses.replace(bench_run.CONFIGS[0], make_data=boom)
+        monkeypatch.setattr(bench_run, "CONFIGS",
+                            [broken] + bench_run.CONFIGS[1:])
+        with pytest.raises(SystemExit) as exc:
+            bench_run.main(["--config", "0", "--scale", "0.0003",
+                            "--iters", "2", "--out", str(out)])
+        assert exc.value.code == 1  # at least one failure
+        recs = [json.loads(ln) for ln in out.read_text().splitlines()]
+        errs = [r for r in recs if r.get("error")]
+        assert len(errs) == 1 and "dataset exploded" in errs[0]["error"]
+        assert sum(1 for r in recs if not r.get("error")) >= 4

@@ -1,8 +1,11 @@
 #!/bin/bash
-# Retry tpu_all.py until all artifacts exist; log each cycle.
+# Retry tpu_all.py until all round artifacts exist; log each cycle.
 # The per-stage watchdog inside tpu_all.py (exit 97) converts hangs into
 # fast retries; this outer timeout is only a belt-and-braces backstop.
-cd /root/repo
+# Stops as soon as the three artifacts exist — even if the producing
+# cycle reported failures (a deterministic check failure must keep its
+# evidence, not re-burn chip claims forever); rc is logged for triage.
+cd /root/repo || exit 1
 n=0
 while true; do
   n=$((n+1))
@@ -10,8 +13,8 @@ while true; do
   timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag r02 >> /tmp/tpu_watch.log 2>&1
   rc=$?
   echo "=== cycle $n end rc=$rc $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
-  if [ -f BENCH_MANUAL_r02.json ] && [ -f TPU_CHECKS_r02.json ] && [ -f BENCH_CONFIGS_r02.json ] && [ $rc -eq 0 ]; then
-    echo "=== ALL ARTIFACTS DONE $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+  if [ -f BENCH_MANUAL_r02.json ] && [ -f TPU_CHECKS_r02.json ] && [ -f BENCH_CONFIGS_r02.json ]; then
+    echo "=== ALL ARTIFACTS PRESENT (last rc=$rc) $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
     break
   fi
   sleep 30
