@@ -25,8 +25,8 @@ import numpy as np
 
 from ..core import tvec
 from ..ops.losses import Gradient
-from ..ops.sparse import CSRMatrix
-from ..parallel import mesh as mesh_lib
+from ..ops.sparse import CSRMatrix, RowShardedCSR
+from ..parallel import dist_smooth, mesh as mesh_lib
 
 
 def iter_array_batches(X, y, batch_rows: int,
@@ -54,7 +54,7 @@ def _max_batch_nnz(indptr, batch_rows: int) -> int:
 
 def iter_csr_batches(indptr, indices, values, n_features: int, y,
                      batch_rows: int, mask=None,
-                     with_csc: bool = True,
+                     with_csc=True,
                      nnz_pad: Optional[int] = None) -> Iterator[Tuple]:
     """Slice host CSR arrays into fixed-shape macro-batches.
 
@@ -64,10 +64,14 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
     explicitly when batches from SEVERAL sources must share one compiled
     shape (``StreamingDataset.from_libsvm_parts``).  Padding follows the
     ops.sparse contract: inert 0.0 entries at the LAST row/col slot (ids
-    stay nondecreasing), padded row slots masked 0.  ``with_csc`` builds
-    each batch's column-sorted twin on the host — the per-batch argsort
-    overlaps device compute inside :func:`fold_stream`'s double
-    buffering.
+    stay nondecreasing), padded row slots masked 0.  ``with_csc=True``
+    builds each batch's column-sorted twin on the host — the per-batch
+    argsort overlaps device compute inside :func:`fold_stream`'s double
+    buffering.  ``with_csc="lazy"`` only MARKS the batch as wanting the
+    twin (``CSRMatrix.want_csc``) — the right choice for MESH streaming,
+    where ``shard_csr_batch`` builds per-shard twins itself and a global
+    one would be argsort work thrown away.  ``False`` disables twins
+    (gradient falls back to scatter-add).
     """
     indptr = np.asarray(indptr)
     indices = np.asarray(indices, np.int32)
@@ -98,7 +102,7 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
         cid[:k] = indices[lo:hi]
         val[:k] = values[lo:hi]
         csc = {}
-        if with_csc:
+        if with_csc is True:
             order = np.argsort(cid[:k], kind="stable")
             crid = np.full(nnz_pad, batch_rows - 1, np.int32)
             ccid = np.full(nnz_pad, n_features - 1, np.int32)
@@ -108,6 +112,8 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
             cval[:k] = val[:k][order]
             csc = dict(csc_row_ids=crid, csc_col_ids=ccid,
                        csc_values=cval)
+        elif with_csc == "lazy":
+            csc = dict(want_csc=True)
         Xb = CSRMatrix(rid, cid, val, (batch_rows, int(n_features)),
                        rows_sorted=True, **csc)
         yb = np.zeros(batch_rows, y.dtype)
@@ -218,6 +224,7 @@ def make_streaming_smooth(
     *,
     mesh=None,
     pad_to: Optional[int] = None,
+    csr_nnz_per_shard: Optional[int] = None,
 ):
     """Build host-level ``(smooth, smooth_loss)`` that stream macro-batches.
 
@@ -225,10 +232,25 @@ def make_streaming_smooth(
     kernel shape instead of one per ragged tail, then placed on ``mesh``
     (sharded over its data axis) or the default device.  Returns means, like
     every other smooth builder.
+
+    Sparse + mesh (the north-star regime: more sparse rows than the pod's
+    HBM): each CSR macro-batch is row-sharded over the mesh's data axis
+    (nnz-balanced, ``parallel.mesh.shard_csr_batch``) and evaluated by the
+    same shard_map+psum kernel as the in-memory sparse mesh path.  One
+    compiled shape serves every batch: shards pad to a fixed
+    ``csr_nnz_per_shard`` budget — default ``1.25 x batch_nnz / n_shards``
+    lane-rounded, which covers the greedy balancer's worst case
+    (mean + heaviest row) unless one row dominates the batch; a batch
+    that cannot fit raises with the knob's name.  Build the dataset with
+    ``with_csc="lazy"`` for this path: per-shard column-sorted twins are
+    built by the sharder, so an eager global twin is per-batch argsort
+    work thrown away.
     """
 
     @jax.jit
     def batch_sums(w, X, y, mask):
+        if isinstance(X, RowShardedCSR):
+            return _csr_mesh_sums(w, X, y, mask, with_grad=True)
         return gradient.batch_loss_and_grad(w, X, y, mask)
 
     # Loss-only twin: the gradient is a jit *output* in batch_sums, so XLA
@@ -236,18 +258,36 @@ def make_streaming_smooth(
     # rmatvec (size-D work per macro-batch) vanish entirely.
     @jax.jit
     def batch_loss_sums(w, X, y, mask):
+        if isinstance(X, RowShardedCSR):
+            ls, n = _csr_mesh_sums(w, X, y, mask, with_grad=False)
+            return ls, n
         ls, _, n = gradient.batch_loss_and_grad(w, X, y, mask)
         return ls, n
 
+    def _csr_mesh_sums(w, X, y, mask, *, with_grad):
+        # trace-time dispatch: the shard_map wrapper is built once per
+        # compiled shape (dist_smooth.csr_shard_sums docstring)
+        ev = dist_smooth.csr_shard_sums(
+            gradient, X, y, mask, mesh, mesh_lib.DATA_AXIS,
+            with_grad=with_grad)
+        return ev(w, *dist_smooth.csr_shard_args(X, y, mask))
+
+    budget = [csr_nnz_per_shard]  # resolved from the first batch
+
     def _place(X, y, mask):
         if isinstance(X, CSRMatrix):
+            if mesh is not None:
+                # row-shard this macro-batch like the in-memory sparse
+                # mesh path; the fixed budget keeps one kernel shape
+                if budget[0] is None:
+                    n_shards = mesh.shape[mesh_lib.DATA_AXIS]
+                    budget[0] = max(128, -(-int(X.nnz * 1.25 / n_shards)
+                                           // 128) * 128)
+                b = mesh_lib.shard_csr_batch(mesh, X, y, mask,
+                                             nnz_per_shard=budget[0])
+                return b.X, b.y, b.mask
             # iter_csr_batches already padded to fixed shape; just move
             # the leaves (csc twin included) onto the device
-            if mesh is not None:
-                raise NotImplementedError(
-                    "mesh-sharded CSR streaming is not supported yet; "
-                    "stream single-device or pre-shard with "
-                    "parallel.mesh.shard_csr_batch")
             return (jax.tree_util.tree_map(jnp.asarray, X),
                     jnp.asarray(y), jnp.asarray(mask))
         X = np.asarray(X)

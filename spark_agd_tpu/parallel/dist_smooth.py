@@ -241,6 +241,53 @@ def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
     return smooth, smooth_loss
 
 
+def csr_shard_sums(gradient, X, y, mask, mesh, data_axis,
+                   with_grad: bool = True):
+    """One distributed (Σloss, Σgrad, n) pass over a ``RowShardedCSR``.
+
+    The seqOp/combOp core shared by the in-memory mesh path
+    (:func:`_make_shard_map_csr`) and the mesh CSR *streaming* path
+    (``data.streaming``): each device reconstructs its entry slice as a
+    local ``CSRMatrix`` (``RowShardedCSR.local_csr``), runs the same
+    batched kernel as the single-device sparse path, and one psum
+    combines the sums.  ``with_grad=False`` psums only (loss, n) — the
+    unused per-shard gradient (the size-D rmatvec) is dead code inside
+    the enclosing jit and vanishes.  May be called inside a jit trace
+    (the streaming kernels do); the shard_map wrapper is created at
+    trace time, once per shape.
+    """
+    if mask is None:
+        raise ValueError(
+            "RowShardedCSR requires its padding mask; build the batch "
+            "with parallel.mesh.shard_csr_batch")
+    row = P(data_axis)
+    n_csc = 3 if X.has_csc else 0
+    in_specs = (P(),) + (row,) * (5 + n_csc)
+    out_specs = (P(), P(), P()) if with_grad else (P(), P())
+
+    def _body(w, rid, cid, val, ys, ms, *csc):
+        Xl = X.local_csr(rid, cid, val, *csc)
+        ls, gs, n = gradient.batch_loss_and_grad(w, Xl, ys, ms)
+        ls = lax.psum(ls, data_axis)
+        n = lax.psum(n, data_axis)
+        if not with_grad:
+            return ls, n
+        gs = tvec.tmap(lambda g: lax.psum(g, data_axis), gs)
+        return ls, gs, n
+
+    return functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)(_body)
+
+
+def csr_shard_args(X: RowShardedCSR, y, mask) -> tuple:
+    """The flat argument tuple :func:`csr_shard_sums`'s in_specs are laid
+    out for — ONE definition, used by every call site, so the spec/arg
+    alignment cannot silently diverge."""
+    return (X.row_ids, X.col_ids, X.values, y, mask) + (
+        (X.csc_row_ids, X.csc_col_ids, X.csc_values) if X.has_csc else ())
+
+
 def _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis):
     """Sparse DP: per-device local CSR kernel + the same single psum.
 
@@ -252,28 +299,8 @@ def _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis):
     The mask is mandatory: per-shard row padding must be excluded from
     the (loss, grad, count) sums.
     """
-    if mask is None:
-        raise ValueError(
-            "RowShardedCSR requires its padding mask; build the batch "
-            "with parallel.mesh.shard_csr_batch")
-    row = P(data_axis)
-    n_csc = 3 if X.has_csc else 0
-    in_specs = (P(),) + (row,) * (5 + n_csc)
-    out_specs = (P(), P(), P())
-
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False)
-    def _eval(w, rid, cid, val, ys, ms, *csc):
-        Xl = X.local_csr(rid, cid, val, *csc)
-        ls, gs, n = gradient.batch_loss_and_grad(w, Xl, ys, ms)
-        ls = lax.psum(ls, data_axis)
-        gs = tvec.tmap(lambda g: lax.psum(g, data_axis), gs)
-        n = lax.psum(n, data_axis)
-        return ls, gs, n
-
-    args = (X.row_ids, X.col_ids, X.values, y, mask) + (
-        (X.csc_row_ids, X.csc_col_ids, X.csc_values) if X.has_csc else ())
+    _eval = csr_shard_sums(gradient, X, y, mask, mesh, data_axis)
+    args = csr_shard_args(X, y, mask)
 
     def smooth(w):
         ls, gs, n = _eval(w, *args)

@@ -182,6 +182,7 @@ def shard_csr_batch(
     mask=None,
     axis: str = DATA_AXIS,
     balance: bool = True,
+    nnz_per_shard: Optional[int] = None,
 ) -> ShardedBatch:
     """Shard a CSR batch's ROWS over the mesh ``axis`` (sparse DP).
 
@@ -207,6 +208,11 @@ def shard_csr_batch(
     Returns a ``ShardedBatch`` whose ``X`` is a
     :class:`~spark_agd_tpu.ops.sparse.RowShardedCSR`; its ``mask`` is
     always present (padding slots must be masked).
+
+    ``nnz_per_shard`` pins the padded per-shard entry count instead of
+    deriving it from this batch — the streaming path passes one budget
+    for EVERY macro-batch so all batches share a single compiled kernel
+    shape.  Raises ``ValueError`` when the batch cannot fit the budget.
     """
     n_rows, n_features = X.shape
     if n_rows == 0:
@@ -214,6 +220,17 @@ def shard_csr_batch(
     row_ids = np.asarray(X.row_ids)
     col_ids = np.asarray(X.col_ids)
     values = np.asarray(X.values)
+    if nnz_per_shard is not None:
+        # Streamed macro-batches arrive pre-padded with inert 0.0 entries
+        # piled onto the LAST row slot (iter_csr_batches contract); fed
+        # to the balancer they masquerade as one enormous row and blow
+        # the budget.  Zero entries contribute nothing to either product
+        # (ops.sparse padding contract), so drop them before balancing —
+        # each shard re-pads to the budget below anyway.
+        keep = values != 0
+        if not keep.all():
+            row_ids, col_ids, values = (row_ids[keep], col_ids[keep],
+                                        values[keep])
     y = np.asarray(y)
     n_shards = mesh.shape[axis]
     rps = -(-n_rows // n_shards)  # rows per shard (ceil)
@@ -241,6 +258,14 @@ def shard_csr_batch(
     starts = np.searchsorted(shard_sorted, np.arange(n_shards))
     ends = np.searchsorted(shard_sorted, np.arange(n_shards), side="right")
     nnz_shard = max(int((ends - starts).max()) if len(values) else 1, 1)
+    if nnz_per_shard is not None:
+        if nnz_shard > nnz_per_shard:
+            raise ValueError(
+                f"a shard holds {nnz_shard} entries > nnz_per_shard="
+                f"{nnz_per_shard}; raise the budget (streaming callers: "
+                f"make_streaming_smooth's csr_nnz_per_shard — one "
+                f"compiled shape must fit every macro-batch)")
+        nnz_shard = int(nnz_per_shard)
 
     with_csc = X.has_csc or X.want_csc
     # Padding slots point at the LAST local row / col (inert 0.0 values)

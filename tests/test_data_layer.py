@@ -354,7 +354,10 @@ class TestStreaming:
                 (rng.random(n) < 0.5).astype(np.float32),
                 batch_rows=32, nnz_pad=16))
 
-    def test_streamed_csr_mesh_rejected(self, rng):
+    def test_streamed_csr_mesh_supported(self, rng):
+        """Mesh-sharded CSR streaming is a first-class path (full
+        coverage in tests/test_streaming_mesh.py); the tiniest case must
+        work end to end — one real entry, two shards."""
         ds = streaming.StreamingDataset.from_csr(
             np.array([0, 1]), np.array([0], np.int32),
             np.array([1.0], np.float32), 4,
@@ -362,8 +365,8 @@ class TestStreaming:
         m = sat.make_mesh({"data": 2})
         sm, _ = streaming.make_streaming_smooth(
             losses.LogisticGradient(), ds, mesh=m)
-        with pytest.raises(NotImplementedError, match="CSR streaming"):
-            sm(jnp.zeros(4, jnp.float32))
+        f, g = sm(jnp.zeros(4, jnp.float32))
+        np.testing.assert_allclose(float(f), np.log(2.0), rtol=1e-6)
 
     def test_fold_stream_overlaps_transfer_with_compute(self):
         """The pipeline contract (VERDICT r1 weak #5): batch i+1 must be

@@ -1,0 +1,69 @@
+"""Summarize the TPU watcher log into one JSON evidence line per cycle.
+
+    python tools/attempts_summary.py [/tmp/tpu_watch.log] > BENCH_ATTEMPTS_r02.json
+
+Each cycle record: start/end (UTC HH:MM:SS), rc, duration, the last
+stage reached, and whether a claim was acquired.  This converts the
+retry loop's log into a committed artifact showing exactly how chip
+availability was spent — the difference between "no numbers" and
+"no numbers, and here is every attempt".
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def parse(lines):
+    cycles = []
+    cur = None
+    for ln in lines:
+        m = re.match(r"=== cycle (\d+) start (\S+) ===", ln)
+        if m:
+            cur = {"cycle": int(m.group(1)), "start": m.group(2),
+                   "claim_acquired": False, "stages": []}
+            cycles.append(cur)
+            continue
+        if cur is None:
+            continue
+        m = re.match(r"=== cycle \d+ end rc=(\d+) (\S+) ===", ln)
+        if m:
+            cur["rc"] = int(m.group(1))
+            cur["end"] = m.group(2)
+            cur = None
+            continue
+        if ln.startswith("{"):
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if "stage" in rec:
+                cur["stages"].append(rec["stage"])
+            continue
+        m = re.match(r"claim acquired in ([0-9.]+)s", ln)
+        if m:
+            cur["claim_acquired"] = True
+            cur["claim_s"] = float(m.group(1))
+        if "UNAVAILABLE" in ln:
+            cur["error"] = "UNAVAILABLE"
+        if ln.startswith("WATCHDOG:"):
+            cur["error"] = ln.strip()
+    for c in cycles:
+        c["last_stage"] = c["stages"][-1] if c["stages"] else None
+        del c["stages"]
+    return cycles
+
+
+def main(argv):
+    path = argv[1] if len(argv) > 1 else "/tmp/tpu_watch.log"
+    with open(path, errors="replace") as f:
+        cycles = parse(f.readlines())
+    for c in cycles:
+        print(json.dumps(c))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
