@@ -102,7 +102,9 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
         cid[:k] = indices[lo:hi]
         val[:k] = values[lo:hi]
         csc = {}
-        if with_csc is True:
+        if with_csc == "lazy":
+            csc = dict(want_csc=True)
+        elif with_csc:
             order = np.argsort(cid[:k], kind="stable")
             crid = np.full(nnz_pad, batch_rows - 1, np.int32)
             ccid = np.full(nnz_pad, n_features - 1, np.int32)
@@ -112,8 +114,6 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
             cval[:k] = val[:k][order]
             csc = dict(csc_row_ids=crid, csc_col_ids=ccid,
                        csc_values=cval)
-        elif with_csc == "lazy":
-            csc = dict(want_csc=True)
         Xb = CSRMatrix(rid, cid, val, (batch_rows, int(n_features)),
                        rows_sorted=True, **csc)
         yb = np.zeros(batch_rows, y.dtype)
@@ -144,7 +144,7 @@ class StreamingDataset:
 
     @classmethod
     def from_csr(cls, indptr, indices, values, n_features: int, y,
-                 batch_rows: int, mask=None, with_csc: bool = True,
+                 batch_rows: int, mask=None, with_csc=True,
                  nnz_pad: Optional[int] = None):
         """Macro-batches over host CSR arrays (``data.libsvm.CSRData``'s
         fields) — the sparse twin of ``from_arrays``; see
@@ -155,7 +155,7 @@ class StreamingDataset:
 
     @classmethod
     def from_libsvm_parts(cls, paths, n_features: int, batch_rows: int,
-                          with_csc: bool = True,
+                          with_csc=True,
                           nnz_pad: Optional[int] = None,
                           binarize_labels: bool = True):
         """Stream LIBSVM partition files (e.g. a Spark job's part-*
@@ -273,12 +273,23 @@ def make_streaming_smooth(
         return ev(w, *dist_smooth.csr_shard_args(X, y, mask))
 
     budget = [csr_nnz_per_shard]  # resolved from the first batch
+    warned_eager_twin = []  # warn once per smooth, not per batch
 
     def _place(X, y, mask):
         if isinstance(X, CSRMatrix):
             if mesh is not None:
                 # row-shard this macro-batch like the in-memory sparse
                 # mesh path; the fixed budget keeps one kernel shape
+                if X.has_csc and not warned_eager_twin:
+                    warned_eager_twin.append(True)
+                    import warnings
+
+                    warnings.warn(
+                        "mesh CSR streaming with an EAGER per-batch CSC "
+                        "twin: the sharder rebuilds per-shard twins and "
+                        "discards the global one — build the dataset "
+                        "with with_csc='lazy' to skip the wasted "
+                        "per-batch argsort", stacklevel=2)
                 if budget[0] is None:
                     n_shards = mesh.shape[mesh_lib.DATA_AXIS]
                     budget[0] = max(128, -(-int(X.nnz * 1.25 / n_shards)
