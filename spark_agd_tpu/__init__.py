@@ -40,6 +40,7 @@ from .api import (  # noqa: F401
     run,
     run_minibatch_agd,
     run_minibatch_sgd,
+    make_sweep_runner,
     sweep,
 )
 from .core.agd import AGDConfig, AGDResult  # noqa: F401

@@ -215,6 +215,50 @@ def run_minibatch_agd(
     return run(data, gradient, updater, **kwargs)
 
 
+def make_sweep_runner(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    loss_mode: str = "x",
+):
+    """Build ``fit(initial_weights, reg_params) -> batched AGDResult``,
+    compiled ONCE — the ``make_runner`` twin of :func:`sweep` for
+    repeated paths (cross-validation folds, warm-started grids)."""
+    if isinstance(data, mesh_lib.ShardedBatch):
+        raise ValueError("sweep is single-device; pass raw (X, y[, mask])")
+    X, y, mask = _normalize_data(data)
+    # the single-device branch of the shared builder: one prepare(), one
+    # staged copy (see _build_smooth's prepare-once invariant)
+    sm, sl = _build_smooth(gradient, (X, y, mask), None, "shard_map")
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+
+    def fit_one(reg, w0):
+        px, rv = smooth_lib.make_prox(updater, reg)
+        return agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
+
+    step = jax.jit(jax.vmap(fit_one, in_axes=(0, None)))
+
+    def fit(initial_weights, reg_params):
+        regs = jnp.asarray(reg_params, jnp.float32)
+        if regs.ndim != 1:
+            raise ValueError("reg_params must be 1-D")
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        return step(regs, w0)
+
+    return fit
+
+
 def sweep(
     data: Data,
     gradient: Gradient,
@@ -250,33 +294,16 @@ def sweep(
 
     Single-device evaluation (the sweep axis IS the parallel axis);
     shard the data axis too by composing with ``mesh`` in a follow-up.
+    Re-traces per call like :func:`run`; use :func:`make_sweep_runner`
+    for repeated fits.
     """
     if initial_weights is None:
         raise ValueError("initial_weights is required")
-    X, y, mask = _normalize_data(data)
-    if isinstance(data, mesh_lib.ShardedBatch):
-        raise ValueError("sweep is single-device; pass raw (X, y[, mask])")
-    if not isinstance(X, CSRMatrix):
-        X = jnp.asarray(X)
-    y = jnp.asarray(y)
-    mask = None if mask is None else jnp.asarray(mask)
-    X, y, mask = gradient.prepare(X, y, mask)
-    sm = smooth_lib.make_smooth(gradient, X, y, mask)
-    sl = smooth_lib.make_smooth_loss(gradient, X, y, mask)
-    cfg = agd.AGDConfig(
-        convergence_tol=convergence_tol, num_iterations=num_iterations,
-        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
-        may_restart=may_restart, loss_mode=loss_mode)
-    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-
-    def fit_one(reg):
-        px, rv = smooth_lib.make_prox(updater, reg)
-        return agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
-
-    regs = jnp.asarray(reg_params, jnp.float32)
-    if regs.ndim != 1:
-        raise ValueError("reg_params must be 1-D")
-    return jax.jit(jax.vmap(fit_one))(regs)
+    fit = make_sweep_runner(
+        data, gradient, updater, convergence_tol=convergence_tol,
+        num_iterations=num_iterations, l0=l0, l_exact=l_exact, beta=beta,
+        alpha=alpha, may_restart=may_restart, loss_mode=loss_mode)
+    return fit(initial_weights, reg_params)
 
 
 class AcceleratedGradientDescent:
