@@ -213,15 +213,63 @@ class GeneralizedLinearAlgorithm:
             return w[1:], float(w[0])
         return w, 0.0
 
-    def train(self, X, y, initial_weights=None):
-        """Fit and return the typed model.  ``initial_weights`` (optional)
-        is in *augmented* space when ``add_intercept`` (intercept first,
+    def _prepare_fit(self, X, initial_weights):
+        """Shared fit preamble: the (possibly intercept-augmented) design
+        matrix and starting weights.  ``initial_weights`` is in
+        *augmented* space when ``add_intercept`` (intercept first,
         matching the reference's manual column at Suite:47-49)."""
         data_X = _add_intercept(X) if self.add_intercept else X
         w0 = (self._zero_weights(X) if initial_weights is None
               else initial_weights)
+        return data_X, w0
+
+    def train(self, X, y, initial_weights=None):
+        """Fit and return the typed model (see ``_prepare_fit`` for the
+        ``initial_weights`` convention)."""
+        data_X, w0 = self._prepare_fit(X, initial_weights)
         weights = self.optimizer.optimize((data_X, y), w0)
         return self._create_model(*self._split_intercept(weights))
+
+    def train_path(self, X, y, reg_params, initial_weights=None):
+        """Fit the regularization path: K typed models from ONE compiled
+        program (``api.sweep`` — the dataset stays in HBM once, the K
+        margin products batch onto the MXU).  The trainer's configured
+        ``reg_param`` is ignored; ``reg_params`` supplies the grid.
+
+        Returns ``(models, result)``: the per-strength models in
+        ``reg_params`` order plus the batched ``AGDResult`` (loss
+        histories, iteration counts, diagnostics per lane).
+        """
+        opt = self.optimizer
+        if opt._mesh not in (None, False):
+            raise ValueError(
+                "train_path (api.sweep) is single-device; drop the "
+                "trainer's mesh or fit strengths individually")
+        reg_params = list(reg_params)
+        if isinstance(opt._updater, IdentityProx) and any(
+                float(r) != 0.0 for r in reg_params):
+            # e.g. a default LinearRegressionWithAGD(), whose ctor picks
+            # the identity prox when reg_param=0: sweeping a grid through
+            # it would silently fit K identical unregularized models
+            raise ValueError(
+                "the trainer's updater is IdentityProx (no penalty), so "
+                "reg_params would be ignored; construct the trainer with "
+                "an explicit updater (e.g. L2Prox()) to sweep a "
+                "regularization path")
+        data_X, w0 = self._prepare_fit(X, initial_weights)
+        res = api.sweep(
+            (data_X, y), opt._gradient, opt._updater, reg_params,
+            convergence_tol=opt._convergence_tol,
+            num_iterations=opt._num_iterations, initial_weights=w0,
+            l0=opt._l0, l_exact=opt._l_exact, beta=opt._beta,
+            alpha=opt._alpha, may_restart=opt._may_restart,
+            loss_mode=opt._loss_mode)
+        w_all = jnp.asarray(res.weights)
+        models = [
+            self._create_model(*self._split_intercept(w_all[k]))
+            for k in range(w_all.shape[0])
+        ]
+        return models, res
 
 
 class LogisticRegressionWithAGD(GeneralizedLinearAlgorithm):

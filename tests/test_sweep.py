@@ -134,3 +134,74 @@ class TestSweep:
             api.sweep((X, y), losses.LogisticGradient(),
                       prox.SquaredL2Updater(), [[0.1]],
                       initial_weights=w0)
+
+
+class TestTrainPath:
+    def test_models_match_individual_training(self, problem):
+        from spark_agd_tpu.models import LogisticRegressionWithAGD
+
+        X, y, _ = problem
+        regs = [0.01, 0.3]
+
+        def make_trainer():
+            t = LogisticRegressionWithAGD()
+            t.optimizer.set_num_iterations(5).set_convergence_tol(0.0)
+            t.optimizer.set_mesh(False)
+            return t
+
+        models, res = make_trainer().train_path(X, y, regs)
+        assert len(models) == 2
+        assert np.asarray(res.num_iters).shape == (2,)
+        for k, reg in enumerate(regs):
+            t = make_trainer()
+            t.optimizer.set_reg_param(reg)
+            m_ref = t.train(X, y)
+            # data-dependent branches (backtrack accepts / restarts) can
+            # flip at 1-ulp boundaries under the batched matmul's
+            # reassociation, legitimately moving the iterate path — so
+            # gate loosely on weights; exact lane parity on the stable
+            # problem is TestSweep.test_lanes_match_individual_runs
+            np.testing.assert_allclose(np.asarray(models[k].weights),
+                                       np.asarray(m_ref.weights),
+                                       rtol=5e-2, atol=5e-3)
+            assert abs(models[k].intercept - m_ref.intercept) < 5e-2
+        # predictions are usable straight off the path
+        preds = models[0].predict(X)
+        assert set(np.unique(np.asarray(preds))) <= {0.0, 1.0}
+
+    def test_softmax_path_shapes(self, rng):
+        from spark_agd_tpu.models import SoftmaxRegressionWithAGD
+
+        X = rng.standard_normal((120, 9)).astype(np.float32)
+        y = rng.integers(0, 4, 120).astype(np.int32)
+        t = SoftmaxRegressionWithAGD(4)
+        t.optimizer.set_num_iterations(3).set_convergence_tol(0.0)
+        t.optimizer.set_mesh(False)
+        models, res = t.train_path(X, y, [0.0, 0.1, 1.0])
+        assert len(models) == 3
+        assert models[0].weights.shape == (9, 4)
+        assert models[0].intercept.shape == (4,)
+        assert res.weights.shape[0] == 3
+
+    def test_mesh_trainer_rejected(self, problem, cpu_devices):
+        from spark_agd_tpu.models import LogisticRegressionWithAGD
+        from spark_agd_tpu.parallel import mesh as mesh_lib
+
+        X, y, _ = problem
+        t = LogisticRegressionWithAGD(
+            mesh=mesh_lib.make_mesh({"data": 2},
+                                    devices=cpu_devices[:2]))
+        with pytest.raises(ValueError, match="single-device"):
+            t.train_path(X, y, [0.1])
+
+    def test_identity_prox_grid_rejected(self, problem):
+        from spark_agd_tpu.models import LinearRegressionWithAGD
+
+        X, y, _ = problem
+        t = LinearRegressionWithAGD()  # ctor froze IdentityProx (reg=0)
+        t.optimizer.set_mesh(False)
+        with pytest.raises(ValueError, match="IdentityProx"):
+            t.train_path(X, y.astype(np.float32), [0.0, 0.1])
+        # an all-zero grid through the identity prox is legitimate
+        models, _ = t.train_path(X, y.astype(np.float32), [0.0])
+        assert len(models) == 1
