@@ -407,6 +407,34 @@ class AcceleratedGradientDescent:
             loss_mode=self._loss_mode)
         return weights
 
+    def sweep(self, data: Data, reg_params, initial_weights: Any):
+        """Regularization path with this object's configuration: K
+        strengths in one compiled program (module-level :func:`sweep`).
+        ``set_reg_param`` is ignored — the grid supplies the strengths.
+        The config forwarding lives HERE so every optimizer knob reaches
+        the sweep the way ``optimize`` forwards it."""
+        if self._mesh not in (None, False):
+            raise ValueError(
+                "sweep is single-device; drop the optimizer's mesh or "
+                "fit strengths individually")
+        from .ops.prox import IdentityProx
+
+        reg_params = list(reg_params)
+        if isinstance(self._updater, IdentityProx) and any(
+                float(r) != 0.0 for r in reg_params):
+            raise ValueError(
+                "the updater is IdentityProx (no penalty), so reg_params "
+                "would be ignored; use an explicit updater (e.g. "
+                "L2Prox()) to sweep a regularization path")
+        return sweep(
+            data, self._gradient, self._updater, reg_params,
+            convergence_tol=self._convergence_tol,
+            num_iterations=self._num_iterations,
+            initial_weights=initial_weights,
+            l0=self._l0, l_exact=self._l_exact, beta=self._beta,
+            alpha=self._alpha, may_restart=self._may_restart,
+            loss_mode=self._loss_mode)
+
 
 def run_minibatch_sgd(
     data: Data,

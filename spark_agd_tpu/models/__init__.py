@@ -1,7 +1,16 @@
 """Model layer: the ``GeneralizedLinearAlgorithm``-style callers the
-reference's optimizer was built to plug into (see ``glm.py``), plus the
-two-layer-MLP custom gradient of BASELINE config 5 (``mlp.py``)."""
+reference's optimizer was built to plug into (see ``glm.py``), the
+two-layer-MLP custom gradient of BASELINE config 5 (``mlp.py``), and the
+``mllib.evaluation`` metric equivalents (``evaluation.py``)."""
 
+from .evaluation import (  # noqa: F401
+    binary_metrics,
+    confusion_matrix,
+    log_loss,
+    multiclass_metrics,
+    regression_metrics,
+    roc_auc,
+)
 from .glm import (  # noqa: F401
     GLMModel,
     GeneralizedLinearAlgorithm,

@@ -1,0 +1,201 @@
+"""Model evaluation metrics — the ``mllib.evaluation`` surface, TPU-native.
+
+The reference trains models through MLlib's ``GeneralizedLinearAlgorithm``
+and its users evaluate them with ``org.apache.spark.mllib.evaluation``
+(``BinaryClassificationMetrics`` / ``RegressionMetrics`` /
+``MulticlassMetrics``).  That package is external to the reference repo
+(same status as the Gradient/Updater contract, SURVEY §2.2) but part of
+what a migrating user expects to find.  These are the batched
+equivalents: every metric is a pure jittable ``jnp`` reduction — AUC is
+the rank-based Mann-Whitney statistic (one on-device sort, average ranks
+for ties; no threshold sweep), the confusion matrix is one segment-sum —
+so evaluation runs on the same device (and at the same scale) as
+training, instead of Spark's per-threshold RDD passes.
+
+All functions take an optional ``mask`` (1.0 = valid) so padded batches
+(``shard_batch`` / streaming) evaluate exactly like unpadded data.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked(v, mask):
+    if mask is None:
+        return v, v.shape[0]
+    m = jnp.asarray(mask, v.dtype)
+    return v * m, jnp.sum(m)
+
+
+def _avg_ranks(scores, tie_break=None):
+    """1-based ranks with ties sharing their group's AVERAGE rank (the
+    Mann-Whitney convention) — one sort + two segment passes, O(N log N)
+    on device.  ``tie_break`` (optional secondary key) both orders
+    equal-score rows and SPLITS their tie group — ``roc_auc`` uses it to
+    keep masked sink rows strictly below equal-valued valid rows."""
+    n = scores.shape[0]
+    if tie_break is None:
+        order = jnp.argsort(scores, stable=True)
+    else:
+        order = jnp.lexsort((tie_break, scores))
+    s_sorted = scores[order]
+    # group ids: increment where the sorted value changes
+    change = s_sorted[1:] != s_sorted[:-1]
+    if tie_break is not None:
+        t_sorted = tie_break[order]
+        change = change | (t_sorted[1:] != t_sorted[:-1])
+    new_group = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32), change.astype(jnp.int32)])
+    gid = jnp.cumsum(new_group) - 1
+    pos = jnp.arange(1, n + 1, dtype=jnp.float32)  # 1-based sorted rank
+    gsum = jax.ops.segment_sum(pos, gid, num_segments=n,
+                               indices_are_sorted=True)
+    gcnt = jax.ops.segment_sum(jnp.ones_like(pos), gid, num_segments=n,
+                               indices_are_sorted=True)
+    avg = gsum / jnp.maximum(gcnt, 1.0)
+    ranks_sorted = avg[gid]
+    return jnp.zeros(n, jnp.float32).at[order].set(ranks_sorted)
+
+
+def roc_auc(scores, labels, mask: Optional[jax.Array] = None):
+    """Area under the ROC curve via the rank statistic:
+    ``AUC = (Σ ranks(positives) − P(P+1)/2) / (P·N)``.
+
+    Exactly the threshold-sweep trapezoid with average-rank tie handling
+    (what ``BinaryClassificationMetrics.areaUnderROC`` converges to with
+    per-score thresholds), in one device sort instead of an RDD pass per
+    threshold.  Masked rows are excluded by pushing them below every
+    valid score.  Returns NaN when either class is empty.
+    """
+    scores = jnp.asarray(scores, jnp.float32)
+    y = jnp.asarray(labels, jnp.float32)
+    if mask is not None:
+        m = (jnp.asarray(mask, jnp.float32) > 0).astype(jnp.float32)
+        # sink masked rows to -inf; the mask as tie-break key keeps them
+        # STRICTLY below any valid row (even a valid -inf, and immune to
+        # the f32 `min - 1 == min` collision at |min| >= 2^24)
+        scores = jnp.where(m > 0, scores, -jnp.inf)
+        y = y * m
+        valid = m
+        ranks = _avg_ranks(scores, tie_break=m)
+    else:
+        valid = jnp.ones_like(y)
+        ranks = _avg_ranks(scores)
+    n_pos = jnp.sum(y)
+    n_val = jnp.sum(valid)
+    n_neg = n_val - n_pos
+    # masked rows occupy the LOWEST ranks (the sink): every valid row's
+    # rank counts the masked block, so subtract it from positives' ranks
+    n_masked = jnp.asarray(scores.shape[0], jnp.float32) - n_val
+    rank_sum_pos = jnp.sum(ranks * y) - n_masked * n_pos
+    auc = (rank_sum_pos - n_pos * (n_pos + 1.0) / 2.0) \
+        / jnp.maximum(n_pos * n_neg, 1.0)
+    return jnp.where((n_pos > 0) & (n_neg > 0), auc, jnp.nan)
+
+
+def log_loss(probs, labels, mask: Optional[jax.Array] = None,
+             eps: float = 1e-7):
+    """Mean binary cross-entropy of predicted probabilities."""
+    p = jnp.clip(jnp.asarray(probs, jnp.float32), eps, 1.0 - eps)
+    y = jnp.asarray(labels, jnp.float32)
+    ll = -(y * jnp.log(p) + (1.0 - y) * jnp.log1p(-p))
+    ll, n = _masked(ll, mask)
+    return jnp.sum(ll) / jnp.maximum(n, 1)
+
+
+def binary_metrics(scores, labels, mask: Optional[jax.Array] = None,
+                   threshold: float = 0.5) -> dict:
+    """``BinaryClassificationMetrics``-style summary at one threshold
+    plus threshold-free AUC.  ``scores`` are probabilities or margins
+    (AUC is rank-based, so either works; the thresholded metrics assume
+    ``scores > threshold`` predicts class 1)."""
+    scores = jnp.asarray(scores, jnp.float32)
+    y = jnp.asarray(labels, jnp.float32)
+    pred = (scores > threshold).astype(jnp.float32)
+    tp, _ = _masked(pred * y, mask)
+    fp, _ = _masked(pred * (1.0 - y), mask)
+    fn, _ = _masked((1.0 - pred) * y, mask)
+    correct, n = _masked((pred == y).astype(jnp.float32), mask)
+    tp, fp, fn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    recall = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2.0 * precision * recall / jnp.maximum(precision + recall,
+                                                jnp.float32(1e-30))
+    return {
+        "accuracy": jnp.sum(correct) / jnp.maximum(n, 1),
+        "precision": precision,
+        "recall": recall,
+        "f1": f1,
+        "auc_roc": roc_auc(scores, y, mask),
+    }
+
+
+def regression_metrics(predictions, targets,
+                       mask: Optional[jax.Array] = None) -> dict:
+    """``RegressionMetrics`` equivalents: mse/rmse/mae/r2 and the
+    explained-variance score ``1 − Var(t−p)/Var(t)`` (population
+    variances; r2 uses the residual SUM of squares, so the two differ
+    exactly when the residuals have nonzero mean)."""
+    p = jnp.asarray(predictions, jnp.float32)
+    t = jnp.asarray(targets, jnp.float32)
+    err = p - t
+    se, n = _masked(err * err, mask)
+    ae, _ = _masked(jnp.abs(err), mask)
+    n = jnp.maximum(n, 1)
+    err_m, _ = _masked(err, mask)
+    err_mean = jnp.sum(err_m) / n
+    ve, _ = _masked((err - err_mean) ** 2, mask)
+    tm, _ = _masked(t, mask)
+    t_mean = jnp.sum(tm) / n
+    tv, _ = _masked((t - t_mean) ** 2, mask)
+    mse = jnp.sum(se) / n
+    var_t = jnp.maximum(jnp.sum(tv) / n, jnp.float32(1e-30))
+    return {
+        "mse": mse,
+        "rmse": jnp.sqrt(mse),
+        "mae": jnp.sum(ae) / n,
+        "r2": 1.0 - mse / var_t,
+        "explained_variance": 1.0 - (jnp.sum(ve) / n) / var_t,
+    }
+
+
+def confusion_matrix(predictions, labels, num_classes: int,
+                     mask: Optional[jax.Array] = None):
+    """(K, K) counts[true, pred] via one segment-sum."""
+    p = jnp.asarray(predictions, jnp.int32)
+    y = jnp.asarray(labels, jnp.int32)
+    idx = y * num_classes + p
+    w = (jnp.ones(p.shape[0], jnp.float32) if mask is None
+         else jnp.asarray(mask, jnp.float32))
+    flat = jax.ops.segment_sum(w, idx,
+                               num_segments=num_classes * num_classes)
+    return flat.reshape(num_classes, num_classes)
+
+
+def multiclass_metrics(predictions, labels, num_classes: int,
+                       mask: Optional[jax.Array] = None) -> dict:
+    """``MulticlassMetrics`` equivalents from one confusion matrix:
+    accuracy, per-class precision/recall/f1, macro averages."""
+    cm = confusion_matrix(predictions, labels, num_classes, mask)
+    total = jnp.maximum(jnp.sum(cm), 1.0)
+    diag = jnp.diagonal(cm)
+    col = jnp.sum(cm, axis=0)  # predicted-as-k counts
+    row = jnp.sum(cm, axis=1)  # true-k counts
+    precision = diag / jnp.maximum(col, 1.0)
+    recall = diag / jnp.maximum(row, 1.0)
+    f1 = 2.0 * precision * recall / jnp.maximum(precision + recall,
+                                                jnp.float32(1e-30))
+    return {
+        "accuracy": jnp.sum(diag) / total,
+        "confusion": cm,
+        "precision_per_class": precision,
+        "recall_per_class": recall,
+        "f1_per_class": f1,
+        "macro_precision": jnp.mean(precision),
+        "macro_recall": jnp.mean(recall),
+        "macro_f1": jnp.mean(f1),
+    }

@@ -240,30 +240,10 @@ class GeneralizedLinearAlgorithm:
         ``reg_params`` order plus the batched ``AGDResult`` (loss
         histories, iteration counts, diagnostics per lane).
         """
-        opt = self.optimizer
-        if opt._mesh not in (None, False):
-            raise ValueError(
-                "train_path (api.sweep) is single-device; drop the "
-                "trainer's mesh or fit strengths individually")
-        reg_params = list(reg_params)
-        if isinstance(opt._updater, IdentityProx) and any(
-                float(r) != 0.0 for r in reg_params):
-            # e.g. a default LinearRegressionWithAGD(), whose ctor picks
-            # the identity prox when reg_param=0: sweeping a grid through
-            # it would silently fit K identical unregularized models
-            raise ValueError(
-                "the trainer's updater is IdentityProx (no penalty), so "
-                "reg_params would be ignored; construct the trainer with "
-                "an explicit updater (e.g. L2Prox()) to sweep a "
-                "regularization path")
         data_X, w0 = self._prepare_fit(X, initial_weights)
-        res = api.sweep(
-            (data_X, y), opt._gradient, opt._updater, reg_params,
-            convergence_tol=opt._convergence_tol,
-            num_iterations=opt._num_iterations, initial_weights=w0,
-            l0=opt._l0, l_exact=opt._l_exact, beta=opt._beta,
-            alpha=opt._alpha, may_restart=opt._may_restart,
-            loss_mode=opt._loss_mode)
+        # config forwarding (and the IdentityProx / mesh guards) live on
+        # the optimizer object, next to optimize()'s
+        res = self.optimizer.sweep((data_X, y), reg_params, w0)
         w_all = jnp.asarray(res.weights)
         models = [
             self._create_model(*self._split_intercept(w_all[k]))

@@ -1,0 +1,159 @@
+"""Metrics (`models.evaluation`) vs hand-computed NumPy references —
+including the tie-handling and mask contracts the jitted one-sort AUC
+must get exactly right."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu.models import evaluation as ev
+
+
+def np_auc(scores, labels):
+    """Reference AUC: average over all (pos, neg) pairs with ties = 1/2
+    (the Mann-Whitney definition)."""
+    s = np.asarray(scores, np.float64)
+    y = np.asarray(labels)
+    pos, neg = s[y == 1], s[y == 0]
+    if not len(pos) or not len(neg):
+        return np.nan
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    return (wins + 0.5 * ties) / (len(pos) * len(neg))
+
+
+class TestRocAuc:
+    def test_matches_pairwise_definition(self, rng):
+        s = rng.standard_normal(400).astype(np.float32)
+        y = (rng.random(400) < 0.4).astype(np.float32)
+        got = float(ev.roc_auc(s, y))
+        assert got == pytest.approx(np_auc(s, y), abs=1e-6)
+
+    def test_ties_average(self, rng):
+        s = rng.integers(0, 5, 500).astype(np.float32)  # heavy ties
+        y = (rng.random(500) < 0.5).astype(np.float32)
+        got = float(ev.roc_auc(s, y))
+        assert got == pytest.approx(np_auc(s, y), abs=1e-6)
+
+    def test_perfect_and_inverted(self):
+        y = np.array([0, 0, 1, 1], np.float32)
+        assert float(ev.roc_auc(np.array([0.1, 0.2, 0.8, 0.9]), y)) \
+            == pytest.approx(1.0)
+        assert float(ev.roc_auc(np.array([0.9, 0.8, 0.2, 0.1]), y)) \
+            == pytest.approx(0.0)
+
+    def test_masked_equals_subset(self, rng):
+        s = rng.standard_normal(300).astype(np.float32)
+        y = (rng.random(300) < 0.5).astype(np.float32)
+        m = (rng.random(300) < 0.7).astype(np.float32)
+        got = float(ev.roc_auc(s, y, mask=m))
+        want = np_auc(s[m > 0], y[m > 0])
+        assert got == pytest.approx(want, abs=1e-6)
+
+    def test_masked_large_magnitude_scores(self):
+        """f32 regression: with |min score| >= 2^24, a `min - 1` sink
+        would COLLIDE with the valid minimum (f32(1e8) - 1 == f32(1e8))
+        and corrupt the rank statistic; the -inf sink + mask tie-break
+        must give the exact subset answer (here 0.0, not -0.5)."""
+        s = np.array([1e8, 2e8, 3e8], np.float32)
+        y = np.array([1.0, 0.0, 1.0], np.float32)
+        m = np.array([1.0, 1.0, 0.0], np.float32)
+        assert float(ev.roc_auc(s, y, mask=m)) == pytest.approx(0.0)
+        # and with a NaN in the masked slot (padded garbage)
+        s2 = np.array([0.3, 0.7, np.nan], np.float32)
+        assert float(ev.roc_auc(s2, y, mask=m)) == pytest.approx(0.0)
+
+    def test_degenerate_single_class(self):
+        assert np.isnan(float(ev.roc_auc(
+            np.array([0.1, 0.9]), np.array([1.0, 1.0]))))
+
+    def test_jittable(self, rng):
+        s = rng.standard_normal(128).astype(np.float32)
+        y = (rng.random(128) < 0.5).astype(np.float32)
+        got = float(jax.jit(ev.roc_auc)(jnp.asarray(s), jnp.asarray(y)))
+        assert got == pytest.approx(np_auc(s, y), abs=1e-6)
+
+
+class TestBinaryMetrics:
+    def test_against_numpy(self, rng):
+        s = rng.random(200).astype(np.float32)
+        y = (rng.random(200) < 0.5).astype(np.float32)
+        m = ev.binary_metrics(s, y)
+        pred = (s > 0.5)
+        tp = np.sum(pred & (y == 1))
+        fp = np.sum(pred & (y == 0))
+        fn = np.sum(~pred & (y == 1))
+        assert float(m["accuracy"]) == pytest.approx(np.mean(pred == y))
+        assert float(m["precision"]) == pytest.approx(tp / (tp + fp))
+        assert float(m["recall"]) == pytest.approx(tp / (tp + fn))
+        assert 0.0 <= float(m["f1"]) <= 1.0
+        assert float(m["auc_roc"]) == pytest.approx(np_auc(s, y),
+                                                    abs=1e-6)
+
+    def test_log_loss(self):
+        p = np.array([0.9, 0.1, 0.8], np.float32)
+        y = np.array([1.0, 0.0, 0.0], np.float32)
+        want = -np.mean([np.log(0.9), np.log(0.9), np.log(0.2)])
+        assert float(ev.log_loss(p, y)) == pytest.approx(want, rel=1e-5)
+
+
+class TestRegressionMetrics:
+    def test_against_numpy(self, rng):
+        t = rng.standard_normal(300).astype(np.float32)
+        p = (t + 0.3 * rng.standard_normal(300) + 0.1).astype(np.float32)
+        m = ev.regression_metrics(p, t)
+        err = p - t
+        assert float(m["mse"]) == pytest.approx(np.mean(err ** 2),
+                                                rel=1e-5)
+        assert float(m["rmse"]) == pytest.approx(
+            np.sqrt(np.mean(err ** 2)), rel=1e-5)
+        assert float(m["mae"]) == pytest.approx(np.mean(np.abs(err)),
+                                                rel=1e-5)
+        assert float(m["r2"]) == pytest.approx(
+            1 - np.mean(err ** 2) / np.var(t), rel=1e-4)
+        assert float(m["explained_variance"]) == pytest.approx(
+            1 - np.var(err) / np.var(t), rel=1e-4)
+
+    def test_mask(self, rng):
+        t = rng.standard_normal(100).astype(np.float32)
+        p = rng.standard_normal(100).astype(np.float32)
+        m = (rng.random(100) < 0.6).astype(np.float32)
+        got = ev.regression_metrics(p, t, mask=m)
+        want = ev.regression_metrics(p[m > 0], t[m > 0])
+        for k in got:
+            assert float(got[k]) == pytest.approx(float(want[k]),
+                                                  rel=1e-4)
+
+
+class TestMulticlass:
+    def test_confusion_and_metrics(self, rng):
+        k = 4
+        y = rng.integers(0, k, 500)
+        p = np.where(rng.random(500) < 0.7, y, rng.integers(0, k, 500))
+        m = ev.multiclass_metrics(p, y, k)
+        cm = np.zeros((k, k))
+        for yi, pi in zip(y, p):
+            cm[yi, pi] += 1
+        np.testing.assert_array_equal(np.asarray(m["confusion"]), cm)
+        assert float(m["accuracy"]) == pytest.approx(np.mean(p == y))
+        prec0 = cm[0, 0] / max(cm[:, 0].sum(), 1)
+        assert float(m["precision_per_class"][0]) == pytest.approx(prec0)
+        rec0 = cm[0, 0] / max(cm[0, :].sum(), 1)
+        assert float(m["recall_per_class"][0]) == pytest.approx(rec0)
+
+    def test_model_integration(self, rng):
+        """End to end: train a tiny softmax model, evaluate it — the
+        accuracy on separable planted data must beat chance."""
+        from spark_agd_tpu.models import SoftmaxRegressionWithAGD
+
+        n, d, k = 600, 8, 3
+        centers = rng.standard_normal((k, d)).astype(np.float32) * 2
+        y = rng.integers(0, k, n)
+        X = (centers[y] + rng.standard_normal((n, d))).astype(np.float32)
+        t = SoftmaxRegressionWithAGD(k)
+        t.optimizer.set_num_iterations(15).set_convergence_tol(0.0)
+        t.optimizer.set_mesh(False)
+        model = t.train(X, y)
+        m = ev.multiclass_metrics(model.predict(X), y, k)
+        assert float(m["accuracy"]) > 0.7
