@@ -40,6 +40,8 @@ from .api import (  # noqa: F401
     run,
     run_minibatch_agd,
     run_minibatch_sgd,
+    CVResult,
+    cross_validate,
     make_sweep_runner,
     sweep,
 )

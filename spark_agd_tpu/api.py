@@ -20,7 +20,7 @@ reference's executor parallelism with the driver round-trips deleted; pass
 from __future__ import annotations
 
 import math
-from typing import Any, Optional, Tuple, Union
+from typing import Any, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -306,6 +306,121 @@ def sweep(
     return fit(initial_weights, reg_params)
 
 
+class CVResult(NamedTuple):
+    """``cross_validate`` output: everything is device-resident and
+    indexed ``[fold, strength]``."""
+
+    val_loss: jax.Array       # (F, R) mean smooth loss on the held-out fold
+    train_result: Any         # batched AGDResult, leading axes (F, R)
+    mean_val_loss: jax.Array  # (R,) averaged over folds
+    best_index: jax.Array     # () argmin of mean_val_loss
+    fold_ids: jax.Array       # (N,) the fold assignment used
+
+
+def cross_validate(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    reg_params,
+    n_folds: int = 5,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    initial_weights: Any = None,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    loss_mode: str = "x",
+    seed: int = 0,
+) -> CVResult:
+    """K-fold cross-validation over a regularization grid — ALL
+    ``n_folds x len(reg_params)`` fits AND their held-out evaluations in
+    ONE compiled program.
+
+    The lane axis is the flattened (fold, strength) grid: every lane
+    trains on its fold's complement through a traced mask (the kernels'
+    ``mask`` argument — the same mechanism that excludes padding), then
+    evaluates the smooth loss on the held-out fold inside the same
+    program.  The dataset lives in HBM once, shared by every lane; the
+    margin matvecs batch onto the MXU exactly as in :func:`sweep`.  A
+    Spark grid search is F·R sequential jobs with F·R·iterations
+    broadcast/reduce round-trips; this is one launch.
+
+    Folds are a deterministic (``seed``) uniform assignment.  Rows
+    masked out by an input ``(X, y, mask)`` triple stay excluded from
+    BOTH training and validation everywhere.
+    """
+    if initial_weights is None:
+        raise ValueError("initial_weights is required")
+    if n_folds < 2:
+        raise ValueError("n_folds must be >= 2")
+    if isinstance(data, mesh_lib.ShardedBatch):
+        raise ValueError(
+            "cross_validate is single-device; pass raw (X, y[, mask])")
+    X, y, base_mask = _normalize_data(data)
+    n = X.shape[0]
+    if not isinstance(X, CSRMatrix):
+        X = jnp.asarray(X)
+    y = jnp.asarray(y)
+    base_mask = (jnp.ones(n, jnp.float32) if base_mask is None
+                 else jnp.asarray(base_mask, jnp.float32))
+    X, y, _ = gradient.prepare(X, y, None)
+    if getattr(X, "shape", (None,))[0] != n:
+        raise ValueError(
+            "cross_validate drives masks through the kernels, so a "
+            "gradient whose prepare() re-pads rows (e.g. the fused "
+            "Pallas layouts) is not supported here; use the plain "
+            "XLA gradients")
+
+    # balanced assignment (round-robin over a random permutation): fold
+    # sizes differ by at most 1, so no fold is empty for n >= n_folds —
+    # an empty fold would silently score 0.0 validation loss
+    perm = jax.random.permutation(jax.random.PRNGKey(seed), n)
+    fold_ids = jnp.zeros(n, jnp.int32).at[perm].set(
+        jnp.arange(n, dtype=jnp.int32) % n_folds)
+    regs = jnp.asarray(reg_params, jnp.float32)
+    if regs.ndim != 1:
+        raise ValueError("reg_params must be 1-D")
+    n_regs = regs.shape[0]
+    fold_lane = jnp.repeat(jnp.arange(n_folds, dtype=jnp.int32), n_regs)
+    reg_lane = jnp.tile(regs, n_folds)
+
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+
+    def fit_one(fold_k, reg):
+        train_mask = base_mask * (fold_ids != fold_k)
+        val_mask = base_mask * (fold_ids == fold_k)
+        sm = lambda w: gradient.mean_loss_and_grad(w, X, y, train_mask)
+        sl = lambda w: _mean_loss(gradient, w, X, y, train_mask)
+        px, rv = smooth_lib.make_prox(updater, reg)
+        res = agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
+        val = _mean_loss(gradient, res.weights, X, y, val_mask)
+        return val, res
+
+    val_flat, res_flat = jax.jit(jax.vmap(fit_one))(fold_lane, reg_lane)
+    val_loss = val_flat.reshape(n_folds, n_regs)
+    train_result = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_folds, n_regs) + a.shape[1:]), res_flat)
+    mean_val = jnp.mean(val_loss, axis=0)
+    return CVResult(val_loss=val_loss, train_result=train_result,
+                    mean_val_loss=mean_val,
+                    best_index=jnp.argmin(mean_val), fold_ids=fold_ids)
+
+
+def _mean_loss(gradient, w, X, y, mask):
+    ls, _, cnt = gradient.batch_loss_and_grad(w, X, y, mask)
+    cnt = jnp.asarray(cnt, ls.dtype)
+    # an empty selection (e.g. a base mask emptying a fold) must read as
+    # NaN, never as a perfect 0.0 loss
+    return jnp.where(cnt > 0, ls / jnp.maximum(cnt, 1), jnp.nan)
+
+
 class AcceleratedGradientDescent:
     """Config-holder class, reference ``:41-144``: nine fluent setters with
     the reference's defaults, one ``optimize``."""
@@ -407,25 +522,32 @@ class AcceleratedGradientDescent:
             loss_mode=self._loss_mode)
         return weights
 
-    def sweep(self, data: Data, reg_params, initial_weights: Any):
-        """Regularization path with this object's configuration: K
-        strengths in one compiled program (module-level :func:`sweep`).
-        ``set_reg_param`` is ignored — the grid supplies the strengths.
-        The config forwarding lives HERE so every optimizer knob reaches
-        the sweep the way ``optimize`` forwards it."""
+    def _check_grid_fit(self, reg_params, op_name: str):
+        """Shared guards for the batched grid fits (sweep / CV): they run
+        single-device, and a grid through the identity prox would be
+        silently ignored."""
         if self._mesh not in (None, False):
             raise ValueError(
-                "sweep is single-device; drop the optimizer's mesh or "
-                "fit strengths individually")
+                f"{op_name} is single-device; drop the optimizer's mesh "
+                f"or fit configurations individually")
         from .ops.prox import IdentityProx
 
         reg_params = list(reg_params)
         if isinstance(self._updater, IdentityProx) and any(
                 float(r) != 0.0 for r in reg_params):
             raise ValueError(
-                "the updater is IdentityProx (no penalty), so reg_params "
-                "would be ignored; use an explicit updater (e.g. "
-                "L2Prox()) to sweep a regularization path")
+                f"the updater is IdentityProx (no penalty), so "
+                f"reg_params would be ignored; use an explicit updater "
+                f"(e.g. L2Prox()) for {op_name}")
+        return reg_params
+
+    def sweep(self, data: Data, reg_params, initial_weights: Any):
+        """Regularization path with this object's configuration: K
+        strengths in one compiled program (module-level :func:`sweep`).
+        ``set_reg_param`` is ignored — the grid supplies the strengths.
+        The config forwarding lives HERE so every optimizer knob reaches
+        the sweep the way ``optimize`` forwards it."""
+        reg_params = self._check_grid_fit(reg_params, "sweep")
         return sweep(
             data, self._gradient, self._updater, reg_params,
             convergence_tol=self._convergence_tol,
@@ -434,6 +556,22 @@ class AcceleratedGradientDescent:
             l0=self._l0, l_exact=self._l_exact, beta=self._beta,
             alpha=self._alpha, may_restart=self._may_restart,
             loss_mode=self._loss_mode)
+
+    def cross_validate(self, data: Data, reg_params,
+                       initial_weights: Any, n_folds: int = 5,
+                       seed: int = 0) -> CVResult:
+        """K-fold CV over a grid with this object's configuration —
+        every (fold, strength) fit and its held-out evaluation in one
+        compiled program (module-level :func:`cross_validate`)."""
+        reg_params = self._check_grid_fit(reg_params, "cross_validate")
+        return cross_validate(
+            data, self._gradient, self._updater, reg_params,
+            n_folds=n_folds, convergence_tol=self._convergence_tol,
+            num_iterations=self._num_iterations,
+            initial_weights=initial_weights,
+            l0=self._l0, l_exact=self._l_exact, beta=self._beta,
+            alpha=self._alpha, may_restart=self._may_restart,
+            loss_mode=self._loss_mode, seed=seed)
 
 
 def run_minibatch_sgd(

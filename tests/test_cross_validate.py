@@ -1,0 +1,190 @@
+"""`api.cross_validate` — F folds x R strengths in ONE compiled program.
+
+Contract: every (fold, strength) lane must equal an individual masked
+`api.run` at that configuration, validation losses must equal manual
+held-out evaluation, and the selected strength must be sane on planted
+data where the answer is known.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.ops import losses, prox, sparse
+
+
+@pytest.fixture
+def problem(rng):
+    X = rng.standard_normal((400, 10)).astype(np.float32)
+    w_true = rng.standard_normal(10).astype(np.float32)
+    p = 1 / (1 + np.exp(-(X @ w_true)))
+    y = (rng.random(400) < p).astype(np.float32)
+    return X, y, np.zeros(10, np.float32)
+
+
+class TestCrossValidate:
+    def test_lane_matches_individual_masked_run(self, problem):
+        X, y, w0 = problem
+        regs = [0.01, 0.2]
+        cv = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            regs, n_folds=3, num_iterations=5, convergence_tol=0.0,
+            initial_weights=w0, seed=3)
+        assert cv.val_loss.shape == (3, 2)
+        assert cv.train_result.weights.shape == (3, 2, 10)
+        fold_ids = np.asarray(cv.fold_ids)
+        for f in range(3):
+            for r, reg in enumerate(regs):
+                train_mask = (fold_ids != f).astype(np.float32)
+                w_ref, hist_ref = api.run(
+                    (X, y, train_mask), losses.LogisticGradient(),
+                    prox.SquaredL2Updater(), reg_param=reg,
+                    num_iterations=5, convergence_tol=0.0,
+                    initial_weights=w0, mesh=False)
+                np.testing.assert_allclose(
+                    np.asarray(cv.train_result.weights)[f, r],
+                    np.asarray(w_ref), rtol=5e-2, atol=5e-3)
+                # validation loss == manual held-out evaluation
+                val_mask = (fold_ids == f).astype(np.float32)
+                g = losses.LogisticGradient()
+                ls, _, cnt = g.batch_loss_and_grad(
+                    jnp.asarray(np.asarray(
+                        cv.train_result.weights)[f, r]),
+                    jnp.asarray(X), jnp.asarray(y),
+                    jnp.asarray(val_mask))
+                want = float(ls) / float(cnt)
+                assert float(cv.val_loss[f, r]) == pytest.approx(
+                    want, rel=1e-5)
+
+    def test_fold_partition(self, problem):
+        X, y, w0 = problem
+        cv = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.1], n_folds=4, num_iterations=2, convergence_tol=0.0,
+            initial_weights=w0)
+        ids = np.asarray(cv.fold_ids)
+        assert ids.shape == (400,)
+        assert set(np.unique(ids)) == set(range(4))
+
+    def test_selects_sane_strength(self, rng):
+        """Planted high-dimensional noise problem: heavy regularization
+        must beat (over)fitting with none — best_index must not pick the
+        unregularized extreme."""
+        n, d = 80, 120  # d > n: unregularized logistic overfits badly
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)  # pure noise labels
+        regs = [1e-6, 0.1, 1.0]
+        cv = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            regs, n_folds=4, num_iterations=25, convergence_tol=0.0,
+            initial_weights=np.zeros(d, np.float32), seed=1)
+        assert int(cv.best_index) != 0, np.asarray(cv.mean_val_loss)
+        assert np.all(np.isfinite(np.asarray(cv.mean_val_loss)))
+
+    def test_base_mask_excluded_everywhere(self, problem):
+        """Rows masked out in the input must influence neither training
+        nor validation: results equal running on the subset."""
+        X, y, w0 = problem
+        keep = np.ones(400, np.float32)
+        keep[350:] = 0.0
+        cv_masked = api.cross_validate(
+            (X, y, keep), losses.LogisticGradient(),
+            prox.SquaredL2Updater(), [0.1], n_folds=3,
+            num_iterations=3, convergence_tol=0.0,
+            initial_weights=w0, seed=5)
+        # subset run with the same fold assignment restricted
+        ids = np.asarray(cv_masked.fold_ids)
+        f = 0
+        train_mask = keep * (ids != f)
+        w_ref, _ = api.run(
+            (X, y, train_mask), losses.LogisticGradient(),
+            prox.SquaredL2Updater(), reg_param=0.1, num_iterations=3,
+            convergence_tol=0.0, initial_weights=w0, mesh=False)
+        np.testing.assert_allclose(
+            np.asarray(cv_masked.train_result.weights)[f, 0],
+            np.asarray(w_ref), rtol=5e-2, atol=5e-3)
+
+    def test_sparse_input(self, rng):
+        n, d, npr = 240, 20, 4
+        indptr = np.arange(n + 1) * npr
+        X = sparse.CSRMatrix.from_csr_arrays(
+            indptr, rng.integers(0, d, n * npr).astype(np.int32),
+            rng.normal(size=n * npr).astype(np.float32), d,
+            with_csc=True)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        cv = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.05, 0.5], n_folds=2, num_iterations=3,
+            convergence_tol=0.0,
+            initial_weights=np.zeros(d, np.float32))
+        assert cv.val_loss.shape == (2, 2)
+        assert np.all(np.isfinite(np.asarray(cv.val_loss)))
+
+    def test_rejects_bad_inputs(self, problem):
+        X, y, w0 = problem
+        with pytest.raises(ValueError, match="initial_weights"):
+            api.cross_validate((X, y), losses.LogisticGradient(),
+                               prox.SquaredL2Updater(), [0.1])
+        with pytest.raises(ValueError, match="n_folds"):
+            api.cross_validate((X, y), losses.LogisticGradient(),
+                               prox.SquaredL2Updater(), [0.1],
+                               n_folds=1, initial_weights=w0)
+        from spark_agd_tpu.ops.pallas_kernels import PallasMarginGradient
+        with pytest.raises(ValueError, match="prepare"):
+            api.cross_validate(
+                (X, y), PallasMarginGradient(losses.LogisticGradient(),
+                                             interpret=True),
+                prox.SquaredL2Updater(), [0.1], initial_weights=w0)
+
+    def test_optimizer_method_forwards_config(self, problem):
+        """AcceleratedGradientDescent.cross_validate must equal the
+        module function under the same configuration and seed."""
+        X, y, w0 = problem
+        opt = api.AcceleratedGradientDescent(
+            losses.LogisticGradient(), prox.SquaredL2Updater())
+        opt.set_num_iterations(3).set_convergence_tol(0.0)
+        got = opt.cross_validate((X, y), [0.1, 0.5], w0, n_folds=2,
+                                 seed=9)
+        want = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.1, 0.5], n_folds=2, num_iterations=3,
+            convergence_tol=0.0, initial_weights=w0, seed=9)
+        np.testing.assert_allclose(np.asarray(got.val_loss),
+                                   np.asarray(want.val_loss), rtol=1e-6)
+        assert int(got.best_index) == int(want.best_index)
+
+    def test_no_empty_folds_small_n(self, rng):
+        """Balanced assignment: n barely above n_folds must still give
+        every fold at least one row (an empty fold would silently score
+        a perfect 0.0 validation loss)."""
+        n, d = 11, 3
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        cv = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.1], n_folds=10, num_iterations=2, convergence_tol=0.0,
+            initial_weights=np.zeros(d, np.float32))
+        counts = np.bincount(np.asarray(cv.fold_ids), minlength=10)
+        assert counts.min() >= 1, counts
+        assert np.all(np.isfinite(np.asarray(cv.val_loss)))
+
+    def test_masked_out_fold_reads_nan(self, problem):
+        """A base mask that empties a fold's validation rows must read
+        NaN, never 0.0."""
+        X, y, w0 = problem
+        cv0 = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.1], n_folds=4, num_iterations=2, convergence_tol=0.0,
+            initial_weights=w0, seed=2)
+        ids = np.asarray(cv0.fold_ids)
+        keep = (ids != 1).astype(np.float32)  # base mask empties fold 1
+        cv = api.cross_validate(
+            (X, y, keep), losses.LogisticGradient(),
+            prox.SquaredL2Updater(), [0.1], n_folds=4,
+            num_iterations=2, convergence_tol=0.0,
+            initial_weights=w0, seed=2)
+        v = np.asarray(cv.val_loss)
+        assert np.isnan(v[1, 0])
+        assert np.isfinite(v[[0, 2, 3], 0]).all()
