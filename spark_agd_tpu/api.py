@@ -407,7 +407,12 @@ def cross_validate(
     val_loss = val_flat.reshape(n_folds, n_regs)
     train_result = jax.tree_util.tree_map(
         lambda a: a.reshape((n_folds, n_regs) + a.shape[1:]), res_flat)
-    mean_val = jnp.mean(val_loss, axis=0)
+    # nanmean: a fold emptied by the base mask reports NaN (see
+    # _mean_loss) and must not poison every strength's average; a
+    # strength with NO valid fold stays NaN and argmin will not pick it
+    # (NaN comparisons are false) unless ALL are NaN — callers refitting
+    # on best_index must check finiteness (the model layer does).
+    mean_val = jnp.nanmean(val_loss, axis=0)
     return CVResult(val_loss=val_loss, train_result=train_result,
                     mean_val_loss=mean_val,
                     best_index=jnp.argmin(mean_val), fold_ids=fold_ids)

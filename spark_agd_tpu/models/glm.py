@@ -251,6 +251,33 @@ class GeneralizedLinearAlgorithm:
         ]
         return models, res
 
+    def cross_validate(self, X, y, reg_params, n_folds: int = 5,
+                       seed: int = 0, refit: bool = True):
+        """K-fold CV over ``reg_params`` in one compiled program
+        (``api.cross_validate``), then (``refit=True``) one final fit of
+        the winning strength on ALL rows.  Returns ``(model, cv)`` —
+        ``model`` is None when ``refit=False``."""
+        reg_params = list(reg_params)  # consumed more than once below
+        data_X, w0 = self._prepare_fit(X, None)
+        cv = self.optimizer.cross_validate((data_X, y), reg_params, w0,
+                                           n_folds=n_folds, seed=seed)
+        model = None
+        if refit:
+            best_score = float(cv.mean_val_loss[int(cv.best_index)])
+            if not np.isfinite(best_score):
+                raise ValueError(
+                    "cross-validation produced no finite validation "
+                    "score (every fold/strength was empty or aborted); "
+                    "refusing to refit an arbitrary strength")
+            best = float(reg_params[int(cv.best_index)])
+            old = self.optimizer._reg_param
+            try:
+                self.optimizer.set_reg_param(best)
+                model = self.train(X, y)
+            finally:
+                self.optimizer.set_reg_param(old)
+        return model, cv
+
 
 class LogisticRegressionWithAGD(GeneralizedLinearAlgorithm):
     """BASELINE config 1: LogisticGradient + SquaredL2Updater-style prox."""

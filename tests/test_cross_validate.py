@@ -188,3 +188,31 @@ class TestCrossValidate:
         v = np.asarray(cv.val_loss)
         assert np.isnan(v[1, 0])
         assert np.isfinite(v[[0, 2, 3], 0]).all()
+
+
+class TestTrainerCV:
+    def test_refit_on_best(self, problem):
+        from spark_agd_tpu.models import LogisticRegressionWithAGD
+
+        X, y, _ = problem
+        t = LogisticRegressionWithAGD()
+        t.optimizer.set_num_iterations(4).set_convergence_tol(0.0)
+        t.optimizer.set_mesh(False)
+        regs = [0.01, 0.5]
+        model, cv = t.cross_validate(X, y, regs, n_folds=3, seed=4)
+        assert cv.val_loss.shape == (3, 2)
+        best = regs[int(cv.best_index)]
+        # the refit model equals a direct train at the winning strength
+        t2 = LogisticRegressionWithAGD(reg_param=best)
+        t2.optimizer.set_num_iterations(4).set_convergence_tol(0.0)
+        t2.optimizer.set_mesh(False)
+        m_ref = t2.train(X, y)
+        np.testing.assert_allclose(np.asarray(model.weights),
+                                   np.asarray(m_ref.weights), rtol=1e-5)
+        # the trainer's own reg_param is restored
+        assert t.optimizer._reg_param == 0.0
+        m2, cv2 = t.cross_validate(X, y, regs, n_folds=3, seed=4,
+                                   refit=False)
+        assert m2 is None
+        np.testing.assert_allclose(np.asarray(cv2.val_loss),
+                                   np.asarray(cv.val_loss), rtol=1e-6)
