@@ -141,6 +141,50 @@ class TestOracleParity:
         assert_parity(fused, orc)
 
 
+class TestOracleFuzz:
+    """Randomized configuration sweep against the oracle: 24 seeded
+    draws over the knob space (losses x proxes x backtracking /
+    restart / L-cap / alpha regimes; tolerances stay 0 — see the inline
+    comment).  The enumerated
+    parity tests pin the known-tricky paths; this guards the
+    interactions nobody enumerated."""
+
+    @pytest.mark.parametrize("case", range(24))
+    def test_random_config_parity(self, case):
+        r = np.random.default_rng(1000 + case)
+        kind = ["logistic", "least_squares"][case % 2]
+        X, y, grad = make_problem(r, kind=kind)
+        w0 = r.normal(size=X.shape[1]) * r.uniform(0.1, 2.0)
+        p, reg = [
+            (prox.IdentityProx(), 0.0),
+            (prox.MLlibSquaredL2Updater(), float(r.uniform(0.01, 0.5))),
+            (prox.L2Prox(), float(r.uniform(0.01, 0.5))),
+            (prox.L1Prox(), float(r.uniform(0.005, 0.1))),
+            (prox.ElasticNetProx(float(r.uniform(0.1, 0.9))),
+             float(r.uniform(0.01, 0.3))),
+        ][case % 5]
+        cfg = agd.AGDConfig(
+            num_iterations=int(r.integers(3, 15)),
+            # tol=0: a knife-edge stop decision can flip on 1-ulp
+            # NumPy-vs-XLA drift (see the enumerated test's comment);
+            # iteration-count parity under tolerances is pinned there
+            convergence_tol=0.0,
+            l0=float(10.0 ** r.uniform(-3, 1)),
+            l_exact=float([np.inf, 50.0, 5.0][case % 3]),
+            beta=float([0.5, 0.8, 1.0][(case // 3) % 3]),
+            alpha=float(r.uniform(0.7, 1.0)),
+            may_restart=bool((case // 5) % 2),  # decorrelated from
+            # the loss kind (case % 2) so both losses see both settings
+            # 'y' excluded: its loss history is definitionally f(y)+c(y),
+            # not the oracle's f(x)+c(x) (covered by its own semantics
+            # test); 'x' and 'x_strict' must both match the oracle
+            loss_mode=["x", "x_strict"][(case // 2) % 2],
+        )
+        fused, orc = run_both(X, y, grad, p, reg, w0, cfg)
+        assert int(fused.num_backtracks) == orc.num_backtracks, cfg
+        assert_parity(fused, orc)
+
+
 class TestSemantics:
     """Behavioral pins that don't need the oracle."""
 
