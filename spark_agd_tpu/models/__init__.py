@@ -13,6 +13,8 @@ from .evaluation import (  # noqa: F401
 )
 from .glm import (  # noqa: F401
     GLMModel,
+    load_model,
+    save_model,
     GeneralizedLinearAlgorithm,
     LinearRegressionModel,
     LinearRegressionWithAGD,

@@ -108,6 +108,18 @@ class GLMModel:
         return (f"{type(self).__name__}(d={self.weights.shape[0]}, "
                 f"intercept={self.intercept:.4g})")
 
+    # -- persistence (MLlib models are Saveable; reference-era workflow) --
+    def save(self, path: str):
+        """Atomic npz snapshot (class name + arrays + scalars); reload
+        with :func:`load_model`."""
+        save_model(self, path)
+
+    @classmethod
+    def _from_arrays(cls, weights, intercept, threshold):
+        """Restore hook for :func:`load_model`; classes whose ctor shape
+        differs (no threshold / vector intercept) override this."""
+        return cls(weights, float(intercept), threshold=threshold)
+
 
 class LogisticRegressionModel(GLMModel):
     """Binary logistic model.  ``threshold`` semantics follow MLlib's
@@ -156,6 +168,11 @@ class LinearRegressionModel(GLMModel):
     def predict(self, X):
         return self.margin(X)
 
+    @classmethod
+    def _from_arrays(cls, weights, intercept, threshold):
+        del threshold  # regression has none
+        return cls(weights, float(intercept))
+
 
 class SoftmaxRegressionModel:
     """Multinomial model with weight matrix ``(D, K)`` (BASELINE config 4).
@@ -186,6 +203,48 @@ class SoftmaxRegressionModel:
     def __repr__(self):
         d, k = self.weights.shape
         return f"SoftmaxRegressionModel(d={d}, k={k})"
+
+    def save(self, path: str):
+        save_model(self, path)
+
+    @classmethod
+    def _from_arrays(cls, weights, intercept, threshold):
+        del threshold  # softmax predicts by argmax
+        return cls(weights, intercept)
+
+
+def save_model(model, path: str):
+    """Persist a GLM/softmax model as one npz (atomic write via
+    ``utils.checkpoint.atomic_savez``): class name, weights, intercept,
+    and threshold when the class has one."""
+    from ..utils.checkpoint import atomic_savez
+
+    payload = {"class": np.asarray(type(model).__name__),
+               "weights": np.asarray(model.weights),
+               "intercept": np.asarray(model.intercept)}
+    thr = getattr(model, "threshold", None)
+    payload["threshold"] = np.asarray(
+        np.nan if thr is None else float(thr))
+    atomic_savez(path, payload)
+
+
+_MODEL_CLASSES = {}
+
+
+def load_model(path: str):
+    """Reload a model saved by :func:`save_model` / ``model.save``.
+    Each registered class owns its restore (``_from_arrays``), so new
+    classes cannot silently fall into another's constructor shape."""
+    with np.load(path) as z:
+        cls_name = str(z["class"])
+        cls = _MODEL_CLASSES.get(cls_name)
+        if cls is None:
+            raise ValueError(
+                f"unknown model class {cls_name!r} in {path}; known: "
+                f"{sorted(_MODEL_CLASSES)}")
+        thr = float(z["threshold"])
+        return cls._from_arrays(z["weights"], z["intercept"],
+                                None if np.isnan(thr) else thr)
 
 
 class GeneralizedLinearAlgorithm:
@@ -361,3 +420,11 @@ class SoftmaxRegressionWithAGD(GeneralizedLinearAlgorithm):
 
     def _create_model(self, weights, intercept):
         return SoftmaxRegressionModel(weights, intercept)
+
+
+_MODEL_CLASSES.update({
+    "LogisticRegressionModel": LogisticRegressionModel,
+    "SVMModel": SVMModel,
+    "LinearRegressionModel": LinearRegressionModel,
+    "SoftmaxRegressionModel": SoftmaxRegressionModel,
+})

@@ -80,6 +80,13 @@ def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
         payload["fingerprint"] = np.asarray(fingerprint)
     payload["loss_history"] = (np.zeros(0) if loss_history is None
                                else np.asarray(loss_history))
+    atomic_savez(path, payload)
+
+
+def atomic_savez(path: str, payload: dict):
+    """Write an npz atomically (tempfile in the target dir + rename), so
+    a kill mid-write can never leave a torn file.  Creates the directory
+    if needed.  Shared by checkpoints and model persistence."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")

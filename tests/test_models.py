@@ -206,3 +206,54 @@ class TestPredictStream:
         model.clear_threshold()
         probs = np.concatenate(list(model.predict_stream(ds)))
         assert np.all((probs >= 0) & (probs <= 1))
+
+
+class TestModelPersistence:
+    def test_roundtrip_all_classes(self, rng, tmp_path):
+        from spark_agd_tpu.models import (
+            LinearRegressionModel, LogisticRegressionModel, SVMModel,
+            SoftmaxRegressionModel, load_model)
+
+        X = rng.standard_normal((40, 6)).astype(np.float32)
+        cases = [
+            LogisticRegressionModel(rng.standard_normal(6), 0.3),
+            LogisticRegressionModel(rng.standard_normal(6)).
+            clear_threshold(),
+            SVMModel(rng.standard_normal(6), -0.1),
+            LinearRegressionModel(rng.standard_normal(6), 1.5),
+            SoftmaxRegressionModel(rng.standard_normal((6, 3)),
+                                   rng.standard_normal(3)),
+        ]
+        for i, m in enumerate(cases):
+            p = str(tmp_path / f"m{i}.npz")
+            m.save(p)
+            m2 = load_model(p)
+            assert type(m2) is type(m)
+            np.testing.assert_array_equal(np.asarray(m2.weights),
+                                          np.asarray(m.weights))
+            np.testing.assert_allclose(np.asarray(m2.predict(X)),
+                                       np.asarray(m.predict(X)))
+            if hasattr(m, "threshold"):
+                assert m2.threshold == m.threshold
+
+    def test_unknown_class_rejected(self, tmp_path):
+        import numpy as _np
+
+        from spark_agd_tpu.models import load_model
+
+        p = str(tmp_path / "bad.npz")
+        _np.savez(p, **{"class": _np.asarray("NopeModel"),
+                        "weights": _np.zeros(3),
+                        "intercept": _np.asarray(0.0),
+                        "threshold": _np.asarray(_np.nan)})
+        with pytest.raises(ValueError, match="NopeModel"):
+            load_model(p)
+
+    def test_save_creates_directories(self, rng, tmp_path):
+        from spark_agd_tpu.models import (LogisticRegressionModel,
+                                          load_model)
+
+        m = LogisticRegressionModel(rng.standard_normal(4), 0.1)
+        p = str(tmp_path / "new" / "dir" / "m.npz")
+        m.save(p)  # directories created by the atomic writer
+        assert load_model(p).intercept == pytest.approx(0.1)
