@@ -74,9 +74,15 @@ if BENCH_DTYPE not in ("f32", "bf16"):
         f"BENCH_DTYPE must be 'f32' or 'bf16', got {BENCH_DTYPE!r}")
 PARITY_ITERS = int(os.environ.get("BENCH_PARITY_ITERS", 10))
 REG = 0.1
-RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 30))
+RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 15))
 # Hard ceiling on one worker attempt (backend init + compile + run).
-WORKER_TIMEOUT_S = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", 900))
+# Sized so the WHOLE chain (attempt + pause + retry + CPU fallback,
+# ~2*700 + 15 + ~120 ≈ 1550 s) fits inside a 30-minute caller timeout —
+# a driver that kills the orchestrator mid-chain gets no JSON at all,
+# which is round 1's failure mode.  On a healthy pool the claim is
+# near-instant and 700 s covers compile + run many times over; during
+# an outage the claim queue exceeds any worker budget anyway.
+WORKER_TIMEOUT_S = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", 700))
 
 # Per-chip peaks for roofline accounting: device_kind substring ->
 # (dense bf16 TFLOP/s, HBM GB/s).  Public spec-sheet numbers; matmuls on
@@ -517,6 +523,24 @@ def main():
         time.sleep(RETRY_PAUSE_S)
         out = _run_worker("retry")
     if out is None:
+        # The fallback runs in-process (the config-route CPU switch) and
+        # a hung/slow fallback can't be interrupted — so a watchdog
+        # thread guarantees ONE parseable line within the budget even
+        # then: it prints the degraded record and exits the process.
+        import threading
+
+        done = threading.Event()
+
+        def _fallback_watchdog():
+            if not done.wait(float(os.environ.get(
+                    "BENCH_FALLBACK_BUDGET_S", 300))):
+                print(json.dumps(_error_json(
+                    "tpu unavailable and cpu fallback exceeded its "
+                    "budget")), flush=True)
+                sys.stdout.flush()
+                os._exit(1)
+
+        threading.Thread(target=_fallback_watchdog, daemon=True).start()
         try:
             out = cpu_fallback("TPU worker failed/hung twice")
         except Exception as e:  # noqa: BLE001
@@ -526,6 +550,8 @@ def main():
                 f"tpu unavailable and cpu fallback failed: "
                 f"{type(e).__name__}: {e}")), flush=True)
             sys.exit(1)
+        finally:
+            done.set()
     print(json.dumps(out), flush=True)
     sys.exit(0 if not out.get("error") else 1)
 

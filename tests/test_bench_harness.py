@@ -225,3 +225,32 @@ class TestCompileCache:
             assert compile_cache.enable().endswith("envcache")
         finally:
             _disable_cache(jax, compilation_cache, old_size)
+
+
+class TestFallbackWatchdog:
+    def test_slow_fallback_still_emits_json(self, bench, tmp_path):
+        """A fallback that exceeds its budget must still produce ONE
+        parseable (degraded) JSON line — round 1's failure mode was a
+        caller timeout with nothing on stdout."""
+        script = (
+            "import importlib.util, json, os, sys, time\n"
+            f"spec = importlib.util.spec_from_file_location('b', "
+            f"{os.path.join(REPO, 'bench.py')!r})\n"
+            "b = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(b)\n"
+            "b._run_worker = lambda tag: None\n"
+            "b.RETRY_PAUSE_S = 0.0\n"
+            "b.cpu_fallback = lambda reason: time.sleep(60)\n"
+            "os.environ['BENCH_FALLBACK_BUDGET_S'] = '2'\n"
+            "b.main()\n"
+        )
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, timeout=60)
+        assert proc.returncode == 1
+        lines = [ln for ln in proc.stdout.decode().splitlines()
+                 if ln.strip()]
+        out = json.loads(lines[-1])
+        assert "exceeded its budget" in out["error"]
