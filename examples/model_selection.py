@@ -1,0 +1,83 @@
+"""Model selection end to end, at laptop scale — the capabilities a
+Spark/MLlib user gets here that the reference's architecture cannot
+express:
+
+1. a regularization path: K strengths in ONE compiled program
+   (``trainer.train_path`` — a Spark path is K sequential jobs),
+2. K-fold cross-validation over the grid: every (fold, strength) fit
+   AND its held-out evaluation in one program
+   (``trainer.cross_validate``), refit on the winner,
+3. evaluation with the jitted ``mllib.evaluation`` equivalents
+   (rank-based AUC in one device sort),
+4. persistence: ``model.save`` / ``load_model``.
+
+    python examples/model_selection.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    from spark_agd_tpu.models import (
+        LogisticRegressionWithAGD, binary_metrics, load_model)
+
+    rng = np.random.default_rng(0)
+    n, d = 20_000, 64
+    w_true = rng.standard_normal(d).astype(np.float32) / np.sqrt(d)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-3 * (X @ w_true)))).astype(
+        np.float32)
+    X_test = rng.standard_normal((n // 4, d)).astype(np.float32)
+    y_test = (rng.random(n // 4) < 1 / (1 + np.exp(
+        -3 * (X_test @ w_true)))).astype(np.float32)
+
+    trainer = LogisticRegressionWithAGD()
+    trainer.optimizer.set_num_iterations(30).set_convergence_tol(1e-6)
+    grid = [1e-4, 1e-3, 1e-2, 1e-1, 1.0]
+
+    # 1) the whole regularization path, one compiled program
+    t0 = time.perf_counter()
+    models, path = trainer.train_path(X, y, grid)
+    print(f"path: {len(grid)} strengths in {time.perf_counter()-t0:.1f}s "
+          f"(one program; per-lane iters {np.asarray(path.num_iters)})")
+    for reg, m in zip(grid, models):  # the K typed models are usable
+        acc = float((np.asarray(m.predict(X_test)) == y_test).mean())
+        print(f"  reg={reg:<7g} test acc {acc:.4f}")
+
+    # 2) 5-fold CV over the grid, held-out scoring in-program, refit best
+    t0 = time.perf_counter()
+    best_model, cv = trainer.cross_validate(X, y, grid, n_folds=5)
+    best_reg = grid[int(cv.best_index)]
+    print(f"cv: 5 folds x {len(grid)} strengths in "
+          f"{time.perf_counter()-t0:.1f}s; mean val loss "
+          f"{np.round(np.asarray(cv.mean_val_loss), 4)} -> best reg "
+          f"{best_reg}")
+
+    # 3) evaluate on held-out data (jitted, one device sort for AUC)
+    m = binary_metrics(best_model.clear_threshold().predict(X_test),
+                       y_test)
+    print(f"test: auc={float(m['auc_roc']):.4f} "
+          f"acc={float(m['accuracy']):.4f} f1={float(m['f1']):.4f}")
+
+    # 4) persist and reload
+    path_npz = os.path.join(tempfile.mkdtemp(prefix="model_sel_"),
+                            "best.npz")
+    best_model.save(path_npz)
+    reloaded = load_model(path_npz)
+    assert np.allclose(np.asarray(reloaded.weights),
+                       np.asarray(best_model.weights))
+    print(f"saved + reloaded {reloaded} from {path_npz}")
+
+
+if __name__ == "__main__":
+    main()
