@@ -28,6 +28,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def main():
+    import os
+
+    if os.environ.get("EXAMPLE_CPU"):
+        # escape hatch for containers whose default backend is a
+        # (possibly wedged) tunneled TPU: the config route selects CPU
+        # BEFORE backend init (env vars are too late — sitecustomize
+        # already registered the accelerator)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from spark_agd_tpu.models import (
         LogisticRegressionWithAGD, binary_metrics, load_model)
 

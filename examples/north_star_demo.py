@@ -36,6 +36,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def main():
+    import os
+
+    if os.environ.get("EXAMPLE_CPU"):
+        # escape hatch for containers whose default backend is a
+        # (possibly wedged) tunneled TPU: the config route selects CPU
+        # BEFORE backend init (env vars are too late — sitecustomize
+        # already registered the accelerator)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--rows-per-part", type=int, default=20_000)
     p.add_argument("--parts", type=int, default=4)

@@ -134,6 +134,41 @@ def main(argv=None):
         "speedup": round(xla_s / pal_s, 3),
         "ok": True}), flush=True)
 
+    # Block-size autotune: the VMEM-budget model picks block_rows
+    # analytically (choose_block_rows); time the fused kernel at half /
+    # model / double to show the default sits at (or expose the gap to)
+    # the empirical optimum on this chip.
+    from spark_agd_tpu.ops.pallas_kernels import _SUBLANE
+
+    cand = sorted({max(_SUBLANE, br // 2), br,
+                   max(_SUBLANE, br * 2)})
+    g_at = LogisticGradient()
+    timings = {br: round(pal_s * 1e3, 3)}  # already measured above
+    for b in cand:
+        if b == br:
+            continue
+        try:
+            # re-pad per candidate: the padded row count must divide the
+            # candidate block, not the model's
+            pd_b = pad_dense(Xd, yd, block_rows=b)
+            t = timed(jax.jit(lambda wv, bb=b, pp=pd_b:
+                              fused_margin_loss_grad(
+                                  g_at, wv, pp, interpret=interp,
+                                  block_rows=bb)),
+                      wd, args.reps)
+            timings[b] = round(t * 1e3, 3)
+        except Exception as e:  # noqa: BLE001 — e.g. past the VMEM budget
+            timings[b] = f"failed: {type(e).__name__}"
+    numeric = {b: t for b, t in timings.items() if isinstance(t, float)}
+    best_b = min(numeric, key=numeric.get) if numeric else None
+    print(json.dumps({
+        "check": "pallas_block_autotune",
+        "d": d, "rows": n, "model_block": br,
+        "timings_ms": {str(b): t for b, t in timings.items()},
+        "best_block": best_b,
+        "model_is_best": bool(best_b == br),
+        "ok": bool(numeric)}), flush=True)
+
     # Fused softmax kernel at MNIST-8M-like dense shape (config 4):
     # compiled parity + single-pass vs two-pass timing.
     from spark_agd_tpu.ops.losses import SoftmaxGradient
