@@ -120,6 +120,13 @@ class GLMModel:
         differs (no threshold / vector intercept) override this."""
         return cls(weights, float(intercept), threshold=threshold)
 
+    @classmethod
+    def _from_npz(cls, z):
+        return _decode_glm_npz(cls, z)
+
+    def _to_payload(self) -> dict:
+        return _glm_payload(self)
+
 
 class LogisticRegressionModel(GLMModel):
     """Binary logistic model.  ``threshold`` semantics follow MLlib's
@@ -212,29 +219,51 @@ class SoftmaxRegressionModel:
         del threshold  # softmax predicts by argmax
         return cls(weights, intercept)
 
+    @classmethod
+    def _from_npz(cls, z):
+        return _decode_glm_npz(cls, z)
 
-def save_model(model, path: str):
-    """Persist a GLM/softmax model as one npz (atomic write via
-    ``utils.checkpoint.atomic_savez``): class name, weights, intercept,
-    and threshold when the class has one."""
-    from ..utils.checkpoint import atomic_savez
+    def _to_payload(self) -> dict:
+        return _glm_payload(self)
 
+
+def _decode_glm_npz(cls, z):
+    thr = float(z["threshold"])
+    return cls._from_arrays(z["weights"], z["intercept"],
+                            None if np.isnan(thr) else thr)
+
+
+def _glm_payload(model) -> dict:
+    """The GLM-shaped npz payload (class name, weights, intercept,
+    NaN-encoded optional threshold)."""
     payload = {"class": np.asarray(type(model).__name__),
                "weights": np.asarray(model.weights),
                "intercept": np.asarray(model.intercept)}
     thr = getattr(model, "threshold", None)
     payload["threshold"] = np.asarray(
         np.nan if thr is None else float(thr))
-    atomic_savez(path, payload)
+    return payload
+
+
+def save_model(model, path: str):
+    """Persist any registered model as one npz (atomic write via
+    ``utils.checkpoint.atomic_savez``).  Dispatches through the model's
+    ``_to_payload`` so save and :func:`load_model` stay symmetric for
+    every class — including ones (the MLP) whose payload is not the
+    GLM weights/intercept shape."""
+    from ..utils.checkpoint import atomic_savez
+
+    atomic_savez(path, model._to_payload())
 
 
 _MODEL_CLASSES = {}
 
 
 def load_model(path: str):
-    """Reload a model saved by :func:`save_model` / ``model.save``.
-    Each registered class owns its restore (``_from_arrays``), so new
-    classes cannot silently fall into another's constructor shape."""
+    """Reload a model saved by ``model.save``.  Each registered class
+    owns its restore (``_from_npz``), so a class with a different
+    payload shape (the MLP's parameter pytree, regression without a
+    threshold) cannot silently fall into another's decode."""
     with np.load(path) as z:
         cls_name = str(z["class"])
         cls = _MODEL_CLASSES.get(cls_name)
@@ -242,9 +271,7 @@ def load_model(path: str):
             raise ValueError(
                 f"unknown model class {cls_name!r} in {path}; known: "
                 f"{sorted(_MODEL_CLASSES)}")
-        thr = float(z["threshold"])
-        return cls._from_arrays(z["weights"], z["intercept"],
-                                None if np.isnan(thr) else thr)
+        return cls._from_npz(z)
 
 
 class GeneralizedLinearAlgorithm:

@@ -114,6 +114,37 @@ class MLPModel:
         k = self.params["W2"].shape[1]
         return f"MLPModel(d={d}, hidden={h}, k={k})"
 
+    # -- persistence (same npz discipline as the GLM models) --------------
+    def save(self, path: str):
+        from .glm import save_model
+
+        save_model(self, path)
+
+    def _to_payload(self) -> dict:
+        name = next((n for n, f in _ACTIVATIONS.items()
+                     if f is self.activation), None)
+        if name is None:
+            raise ValueError(
+                "cannot persist a custom activation callable; use one "
+                f"of the registered names {sorted(_ACTIVATIONS)}")
+        payload = {"class": np.asarray("MLPModel"),
+                   "activation": np.asarray(name)}
+        payload.update({f"param_{k}": np.asarray(v)
+                        for k, v in self.params.items()})
+        return payload
+
+    @classmethod
+    def _from_npz(cls, z):
+        name = str(z["activation"])
+        act = _ACTIVATIONS.get(name)
+        if act is None:
+            raise ValueError(
+                f"unknown activation {name!r} in saved MLP; known: "
+                f"{sorted(_ACTIVATIONS)}")
+        params = {k[len("param_"):]: jnp.asarray(z[k])
+                  for k in z.files if k.startswith("param_")}
+        return cls(params, act)
+
 
 class MLPClassifierWithAGD:
     """Trainer mirroring the GLM trainers' shape: a public ``.optimizer``
@@ -145,3 +176,8 @@ class MLPClassifierWithAGD:
                 X.shape[1], self.hidden_units, self.num_classes, self.seed)
         params = self.optimizer.optimize((X, y), initial_params)
         return MLPModel(params, self._act)
+
+
+from .glm import _MODEL_CLASSES  # noqa: E402  (registration, no cycle)
+
+_MODEL_CLASSES["MLPModel"] = MLPModel

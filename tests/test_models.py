@@ -257,3 +257,35 @@ class TestModelPersistence:
         p = str(tmp_path / "new" / "dir" / "m.npz")
         m.save(p)  # directories created by the atomic writer
         assert load_model(p).intercept == pytest.approx(0.1)
+
+    def test_mlp_roundtrip(self, rng, tmp_path):
+        from spark_agd_tpu.models import MLPModel, load_model
+        from spark_agd_tpu.models.mlp import init_mlp_params
+
+        X = rng.standard_normal((30, 7)).astype(np.float32)
+        m = MLPModel(init_mlp_params(7, 5, 3, 0))
+        p = str(tmp_path / "mlp.npz")
+        m.save(p)
+        m2 = load_model(p)
+        assert type(m2) is MLPModel
+        np.testing.assert_allclose(np.asarray(m2.predict_proba(X)),
+                                   np.asarray(m.predict_proba(X)),
+                                   rtol=1e-6)
+        # custom (unregistered) activation refuses to persist
+        m3 = MLPModel(m.params, activation=lambda v: v)
+        with pytest.raises(ValueError, match="registered names"):
+            m3.save(str(tmp_path / "bad.npz"))
+
+    def test_save_model_symmetric_for_mlp(self, rng, tmp_path):
+        """save_model/load_model must be symmetric for EVERY registered
+        class, including the MLP's non-GLM payload shape."""
+        from spark_agd_tpu.models import MLPModel, load_model, save_model
+        from spark_agd_tpu.models.mlp import init_mlp_params
+
+        m = MLPModel(init_mlp_params(4, 3, 2, 1))
+        p = str(tmp_path / "m.npz")
+        save_model(m, p)
+        m2 = load_model(p)
+        X = rng.standard_normal((10, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(m2.logits(X)),
+                                   np.asarray(m.logits(X)), rtol=1e-6)
