@@ -23,6 +23,13 @@ L1    runtime                        XLA:TPU + host data staging (``data``)
 L0    local math                     ``core.tvec`` pytree algebra inside the
                                      compiled program
 ====  =============================  =========================================
+
+Beyond the reference's surface: batched regularization paths
+(``sweep`` — K strengths in one compiled program), one-program K-fold
+cross-validation (``cross_validate``), jitted evaluation metrics
+(``models.evaluation``), model persistence, larger-than-HBM streaming
+that composes with the mesh for dense AND sparse data, and fused
+single-HBM-pass Pallas kernels.
 """
 
 __version__ = "0.1.0"
