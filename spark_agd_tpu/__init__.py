@@ -51,6 +51,7 @@ from .api import (  # noqa: F401
     cross_validate,
     make_sweep_runner,
     sweep,
+    sweep_warm_state,
 )
 from .core.agd import AGDConfig, AGDResult  # noqa: F401
 from .parallel.mesh import (  # noqa: F401

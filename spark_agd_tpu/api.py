@@ -243,20 +243,45 @@ def make_sweep_runner(
         l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
         may_restart=may_restart, loss_mode=loss_mode)
 
-    def fit_one(reg, w0):
+    def fit_one(reg, w0, warm=None):
         px, rv = smooth_lib.make_prox(updater, reg)
-        return agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
+        return agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl,
+                           warm=warm)
 
     step = jax.jit(jax.vmap(fit_one, in_axes=(0, None)))
+    step_warm = jax.jit(jax.vmap(fit_one, in_axes=(0, None, 0)))
 
-    def fit(initial_weights, reg_params):
+    def fit(initial_weights, reg_params, warm=None):
+        """``warm`` (optional): a BATCHED ``AGDWarmState`` — one carry
+        per lane, e.g. ``sweep_warm_state(previous_result)`` — to
+        continue every lane exactly where a prior segment stopped
+        (checkpoint-style segmented paths)."""
         regs = jnp.asarray(reg_params, jnp.float32)
         if regs.ndim != 1:
             raise ValueError("reg_params must be 1-D")
         w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-        return step(regs, w0)
+        if warm is None:
+            return step(regs, w0)
+        return step_warm(regs, w0, warm)
 
     return fit
+
+
+def sweep_warm_state(res, prior_iters=0) -> "agd.AGDWarmState":
+    """The batched continuation carry out of a sweep's ``AGDResult`` —
+    the per-lane twin of ``utils.checkpoint.warm_from_result``.  Feed to
+    ``make_sweep_runner``'s ``fit(..., warm=...)`` to run the next
+    segment of every lane.
+
+    ``prior_iters``: iterations already executed BEFORE the segment
+    ``res`` came from (0 for the first continuation; pass the previous
+    warm's ``prior_iters`` when chaining further segments) — the total
+    must accumulate so the ``nIter > 1`` exact-zero-step gate makes the
+    same stop decisions as an uninterrupted run."""
+    return agd.AGDWarmState(
+        x=res.weights, z=res.final_z, theta=res.final_theta,
+        big_l=res.final_l, bts=res.final_bts,
+        prior_iters=jnp.asarray(prior_iters, jnp.int32) + res.num_iters)
 
 
 def sweep(

@@ -205,3 +205,69 @@ class TestTrainPath:
         # an all-zero grid through the identity prox is legitimate
         models, _ = t.train_path(X, y.astype(np.float32), [0.0])
         assert len(models) == 1
+
+
+class TestSweepContinuation:
+    def test_two_segments_equal_one_run(self, problem):
+        """4+4 iterations via sweep_warm_state must equal 8 straight,
+        per lane — the checkpoint-segment contract, batched."""
+        X, y, w0 = problem
+        regs = [0.01, 0.3]
+        fit8 = api.make_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            num_iterations=8, convergence_tol=0.0)
+        ref = fit8(w0, regs)
+
+        fit4 = api.make_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            num_iterations=4, convergence_tol=0.0)
+        seg1 = fit4(w0, regs)
+        seg2 = fit4(w0, regs, warm=api.sweep_warm_state(seg1))
+        np.testing.assert_allclose(np.asarray(seg2.weights),
+                                   np.asarray(ref.weights),
+                                   rtol=1e-6, atol=1e-8)
+        hist = np.concatenate([np.asarray(seg1.loss_history),
+                               np.asarray(seg2.loss_history)], axis=1)
+        np.testing.assert_allclose(hist, np.asarray(ref.loss_history),
+                                   rtol=1e-6)
+
+    def test_three_segments_accumulate_prior_iters(self, problem):
+        """Chaining further segments must ACCUMULATE prior iterations
+        (the checkpoint driver's cumulative contract): 4+4+4 == 12
+        straight, and the third warm carries prior_iters=8."""
+        X, y, w0 = problem
+        regs = [0.01, 0.3]
+        ref = api.make_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            num_iterations=12, convergence_tol=0.0)(w0, regs)
+        fit4 = api.make_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            num_iterations=4, convergence_tol=0.0)
+        seg1 = fit4(w0, regs)
+        warm1 = api.sweep_warm_state(seg1)
+        seg2 = fit4(w0, regs, warm=warm1)
+        warm2 = api.sweep_warm_state(seg2,
+                                     prior_iters=warm1.prior_iters)
+        np.testing.assert_array_equal(np.asarray(warm2.prior_iters),
+                                      [8, 8])
+        seg3 = fit4(w0, regs, warm=warm2)
+        np.testing.assert_allclose(np.asarray(seg3.weights),
+                                   np.asarray(ref.weights),
+                                   rtol=1e-6, atol=1e-8)
+
+    def test_warm_preserves_per_lane_state(self, problem):
+        """Lanes carry DIFFERENT (theta, L, bts) into the next segment —
+        the batched warm must not collapse them."""
+        X, y, w0 = problem
+        fit = api.make_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            num_iterations=5, convergence_tol=0.0, l0=1e-3)
+        seg1 = fit(w0, [0.0, 1.0])
+        warm = api.sweep_warm_state(seg1)
+        assert np.asarray(warm.big_l).shape == (2,)  # per-lane scalars
+        # the lanes' iterates genuinely diverged (L itself tracks the
+        # smooth part and may legitimately agree across strengths)
+        assert not np.allclose(np.asarray(warm.x)[0],
+                               np.asarray(warm.x)[1])
+        seg2 = fit(w0, [0.0, 1.0], warm=warm)
+        assert np.all(np.isfinite(np.asarray(seg2.weights)))
