@@ -20,7 +20,7 @@ reference's executor parallelism with the driver round-trips deleted; pass
 from __future__ import annotations
 
 import math
-from typing import Any, NamedTuple, Optional, Tuple, Union
+from typing import Any, NamedTuple, Tuple, Union
 
 import jax
 import jax.numpy as jnp
