@@ -340,6 +340,10 @@ class CVResult(NamedTuple):
     mean_val_loss: jax.Array  # (R,) averaged over folds
     best_index: jax.Array     # () argmin of mean_val_loss
     fold_ids: jax.Array       # (N,) the fold assignment used
+    base_mask: jax.Array      # (N,) validity mask the CV ran under (all
+    #                           ones when the data carried none) — post-hoc
+    #                           scorers (models.evaluation.
+    #                           cv_validation_scores) default to it
 
 
 def cross_validate(
@@ -440,7 +444,8 @@ def cross_validate(
     mean_val = jnp.nanmean(val_loss, axis=0)
     return CVResult(val_loss=val_loss, train_result=train_result,
                     mean_val_loss=mean_val,
-                    best_index=jnp.argmin(mean_val), fold_ids=fold_ids)
+                    best_index=jnp.argmin(mean_val), fold_ids=fold_ids,
+                    base_mask=base_mask)
 
 
 def _mean_loss(gradient, w, X, y, mask):

@@ -5,6 +5,7 @@ two-layer-MLP custom gradient of BASELINE config 5 (``mlp.py``), and the
 
 from .evaluation import (  # noqa: F401
     binary_metrics,
+    cv_validation_scores,
     confusion_matrix,
     log_loss,
     multiclass_metrics,

@@ -176,6 +176,47 @@ def confusion_matrix(predictions, labels, num_classes: int,
     return flat.reshape(num_classes, num_classes)
 
 
+def cv_validation_scores(cv, X, y, *, score_fn, predict_fn=None,
+                         base_mask=None):
+    """Score every (fold, strength) lane of an ``api.cross_validate``
+    result with an arbitrary metric — e.g. select by held-out AUC
+    instead of loss — in ONE vmapped program.
+
+    ``score_fn(scores, labels, mask) -> SCALAR`` (e.g. :func:`roc_auc`,
+    :func:`log_loss`, or a closure extracting one entry from the
+    dict-returning metrics); ``predict_fn(w) -> scores`` maps one lane's
+    weights to scores (default: the linear margin ``X @ w``).  Rows the
+    CV excluded stay excluded: ``base_mask`` defaults to the mask the
+    ``CVResult`` ran under (``cv.base_mask``).  Returns ``(per_lane
+    (F, R), mean_per_strength (R,))`` — ``nanmean`` over folds.  Select
+    with ``nanargmax``/``nanargmin`` per the metric's direction and
+    check the winner is finite (a strength can be NaN in every fold,
+    e.g. single-class validation sets under AUC; plain argmax would
+    pick it).
+    """
+    from ..ops import sparse
+
+    F, R = cv.val_loss.shape
+    y = jnp.asarray(y)
+    if base_mask is None:
+        base_mask = getattr(cv, "base_mask", None)
+    base = (jnp.ones(y.shape[0], jnp.float32) if base_mask is None
+            else jnp.asarray(base_mask, jnp.float32))
+    if predict_fn is None:
+        predict_fn = lambda w: sparse.matvec(X, w)  # noqa: E731
+    W = cv.train_result.weights
+    flat_w = jax.tree_util.tree_map(
+        lambda a: a.reshape((F * R,) + a.shape[2:]), W)
+    fold_lane = jnp.repeat(jnp.arange(F, dtype=jnp.int32), R)
+
+    def one(w, fold_k):
+        val_mask = base * (cv.fold_ids == fold_k)
+        return score_fn(predict_fn(w), y, val_mask)
+
+    per_lane = jax.jit(jax.vmap(one))(flat_w, fold_lane).reshape(F, R)
+    return per_lane, jnp.nanmean(per_lane, axis=0)
+
+
 def multiclass_metrics(predictions, labels, num_classes: int,
                        mask: Optional[jax.Array] = None) -> dict:
     """``MulticlassMetrics`` equivalents from one confusion matrix:
