@@ -53,6 +53,12 @@ def parse(lines):
     for c in cycles:
         c["last_stage"] = c["stages"][-1] if c["stages"] else None
         del c["stages"]
+        # Uniform schema (ADVICE r2): a cycle killed before its end
+        # marker (watchdog os._exit, outer timeout) must still carry
+        # rc/end keys — those are exactly the cycles consumers index.
+        c.setdefault("rc", None)
+        c.setdefault("end", None)
+        c["aborted"] = c["rc"] is None
     return cycles
 
 

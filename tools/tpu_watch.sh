@@ -1,20 +1,40 @@
 #!/bin/bash
 # Retry tpu_all.py until all round artifacts exist; log each cycle.
+#   tools/tpu_watch.sh [tag]        (default tag: r03)
 # The per-stage watchdog inside tpu_all.py (exit 97) converts hangs into
 # fast retries; this outer timeout is only a belt-and-braces backstop.
+# Before each launch we seed the probe file's deepest marker,
+# "interpreter-start": the container's sitecustomize registers the axon
+# PJRT plugin at interpreter startup, which can hang BEFORE any Python
+# in tpu_all.py runs — only the launcher can record that mode.  (Seeded
+# only while no successful claim has ever been recorded, so a completed
+# probe artifact is never clobbered by a later cycle's launch.)
 # Stops as soon as the three artifacts exist — even if the producing
 # cycle reported failures (a deterministic check failure must keep its
 # evidence, not re-burn chip claims forever); rc is logged for triage.
+# A stop file (tools/tpu_watch.stop) ends the loop at the next cycle
+# boundary, so the round-end driver's own bench claim never queues
+# behind ours.
 cd /root/repo || exit 1
+TAG=${1:-r03}
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
+rm -f tools/tpu_watch.stop
 n=0
 while true; do
   n=$((n+1))
-  echo "=== cycle $n start $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
-  timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag r02 >> /tmp/tpu_watch.log 2>&1
+  echo "=== cycle $n start $(date -u +%H:%M:%S) ===" >> "$LOG"
+  if ! grep -q '"claim_s"' "TPU_PROBE_${TAG}.json" 2>/dev/null; then
+    printf '{"inflight": "interpreter-start", "inflight_since_unix": %s}\n' "$(date +%s)" > "TPU_PROBE_${TAG}.json"
+  fi
+  timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag "$TAG" >> "$LOG" 2>&1
   rc=$?
-  echo "=== cycle $n end rc=$rc $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
-  if [ -f BENCH_MANUAL_r02.json ] && [ -f TPU_CHECKS_r02.json ] && [ -f BENCH_CONFIGS_r02.json ]; then
-    echo "=== ALL ARTIFACTS PRESENT (last rc=$rc) $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+  echo "=== cycle $n end rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
+  if [ -f "BENCH_MANUAL_${TAG}.json" ] && [ -f "TPU_CHECKS_${TAG}.json" ] && [ -f "BENCH_CONFIGS_${TAG}.json" ]; then
+    echo "=== ALL ARTIFACTS PRESENT (last rc=$rc) $(date -u +%H:%M:%S) ===" >> "$LOG"
+    break
+  fi
+  if [ -f tools/tpu_watch.stop ]; then
+    echo "=== STOP FILE SEEN; exiting $(date -u +%H:%M:%S) ===" >> "$LOG"
     break
   fi
   sleep 30

@@ -45,6 +45,19 @@ def log(msg):
 _WD = {"deadline": None, "stage": ""}
 
 
+def make_probe(path):
+    """A ``probe_file.Probe`` wired to this module's stage watchdog:
+    each inflight step arms the stage budget (and prints the stage line
+    the attempts summarizer reads); ``done`` disarms it so a finished
+    step's deadline can never kill the code that runs after it."""
+    from probe_file import Probe
+
+    def _disarm():
+        _WD["deadline"] = None
+
+    return Probe(path, on_inflight=stage, on_done=_disarm)
+
+
 def _watchdog_loop():
     """Convert a hung stage into a fast retry.
 
@@ -80,49 +93,57 @@ def stage(name, budget_s=None):
           flush=True)
 
 
-def _probe_stage(d, claim_s, args):
-    """Measure what the claimed chip can actually do, cheapest first, and
-    leave the evidence in ``TPU_PROBE_{tag}.json`` — even a cycle that
-    dies later proves the chip was reachable and how far it got.
+def _probe_stage(probe, d, args):
+    """Measure what the claimed chip can actually do, cheapest first —
+    even a cycle that dies later proves the chip was reachable and how
+    far it got, because ``probe`` marks each step inflight before it
+    starts.
 
-    Ordering is deliberate: compile → on-device RNG → reduce are the
-    primitives the (reworked, transfer-free) stages below rely on; bulk
-    H2D — the primitive observed to wedge the tunnel — is probed LAST,
-    bracketed by a marker file so a death here tells the next cycle to
-    run in no-H2D mode (``TPU_H2D_MBPS=0``: tpu_checks skips the
-    streaming check, everything else is already on-device).
+    Ordering is deliberate: compile (split from execute, so a Mosaic/
+    XLA-compile hang is distinguishable from an execution hang) →
+    on-device RNG → reduce are the primitives the transfer-free stages
+    below rely on; bulk H2D — the primitive observed to wedge the
+    tunnel — is probed LAST, bracketed by a marker file so a death here
+    tells the next cycle to run in no-H2D mode (``TPU_H2D_MBPS=0``:
+    tpu_checks skips the streaming check, everything else is already
+    on-device).
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    path = f"TPU_PROBE_{args.tag}.json"
-    rec = {"platform": d.platform, "device_kind": d.device_kind,
-           "claim_s": round(claim_s, 1)}
-
-    def flush():
-        with open(path, "w") as f:
-            f.write(json.dumps(rec) + "\n")
-
-    stage("probe", args.probe_budget)
+    probe.inflight("tiny-compile", 180)
     t0 = time.perf_counter()
-    r = jax.jit(lambda a, b: a @ b)(jnp.ones((256, 256)),
-                                    jnp.ones((256, 256)))
+    compiled = (jax.jit(lambda a, b: a @ b)
+                .lower(jax.ShapeDtypeStruct((256, 256), jnp.float32),
+                       jax.ShapeDtypeStruct((256, 256), jnp.float32))
+                .compile())
+    probe.done("tiny-compile",
+               tiny_compile_s=round(time.perf_counter() - t0, 2))
+    probe.inflight("tiny-execute", 120)
+    t0 = time.perf_counter()
+    r = compiled(jnp.ones((256, 256), jnp.float32),
+                 jnp.ones((256, 256), jnp.float32))
     jax.block_until_ready(r)
-    rec["tiny_compile_s"] = round(time.perf_counter() - t0, 2)
+    probe.done("tiny-execute",
+               tiny_execute_s=round(time.perf_counter() - t0, 2))
+    probe.inflight("rng-1gib", args.probe_budget)
     t0 = time.perf_counter()
     X = jax.random.normal(jax.random.PRNGKey(0), PROBE_RNG_SHAPE,
                           jnp.float32)
     jax.block_until_ready(X)
-    rec["rng_1gib_s"] = round(time.perf_counter() - t0, 2)
+    probe.done("rng-1gib", rng_1gib_s=round(time.perf_counter() - t0, 2))
+    probe.inflight("reduce-1gib", 120)
     t0 = time.perf_counter()
     s = jax.jit(jnp.sum)(X)
     jax.block_until_ready(s)
-    rec["reduce_1gib_s"] = round(time.perf_counter() - t0, 2)
+    probe.done("reduce-1gib",
+               reduce_1gib_s=round(time.perf_counter() - t0, 2))
     del X, s
-    flush()
-    log(f"probe: compile {rec['tiny_compile_s']}s, "
-        f"rng 1GiB {rec['rng_1gib_s']}s, reduce {rec['reduce_1gib_s']}s")
+    rec = probe.rec
+    log(f"probe: compile {rec['tiny_compile_s']}s "
+        f"exec {rec['tiny_execute_s']}s, rng 1GiB {rec['rng_1gib_s']}s, "
+        f"reduce {rec['reduce_1gib_s']}s")
 
     if os.path.exists(H2D_MARKER):
         # a previous cycle died INSIDE the H2D probe: bulk staging is
@@ -131,34 +152,33 @@ def _probe_stage(d, claim_s, args):
         # is usually transient (AVAILABILITY.md) and must not disable
         # H2D forever.
         os.remove(H2D_MARKER)
-        rec["h2d_mibps"] = 0.0
-        rec["h2d_note"] = "skipped: prior cycle died probing H2D"
         os.environ["TPU_H2D_MBPS"] = "0"
-        flush()
+        probe.done("", h2d_mibps=0.0,
+                   h2d_note="skipped: prior cycle died probing H2D")
         log("probe: H2D marked wedged by prior cycle; no-H2D mode "
             "(next cycle re-probes)")
         return
 
-    stage("probe-h2d", 240)
     open(H2D_MARKER, "w").close()
     rate = 0.0
     try:
         for mb in (1, 16, 64):
+            probe.inflight(f"h2d-{mb}mib", 120)
             a = np.ones((mb, 1 << 18), np.float32)  # mb MiB
             t0 = time.perf_counter()
             ad = jnp.asarray(a)
             jax.block_until_ready(ad)
             dt = time.perf_counter() - t0
             rate = mb / dt
-            rec[f"h2d_{mb}mib_s"] = round(dt, 2)
+            probe.done(f"h2d-{mb}mib",
+                       **{f"h2d_{mb}mib_s": round(dt, 2)})
             del ad
     finally:
         # reached only if the transfers returned (else the watchdog took
         # the process down and the marker stays)
         os.remove(H2D_MARKER)
-    rec["h2d_mibps"] = round(rate, 1)
-    os.environ["TPU_H2D_MBPS"] = str(rec["h2d_mibps"])
-    flush()
+    os.environ["TPU_H2D_MBPS"] = str(round(rate, 1))
+    probe.done("", h2d_mibps=round(rate, 1))
     log(f"probe: H2D {rate:.0f} MiB/s")
 
 
@@ -177,7 +197,7 @@ def stdout_to(path):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--tag", default="r02")
+    p.add_argument("--tag", default="r03")
     p.add_argument("--skip-bench", action="store_true")
     p.add_argument("--skip-checks", action="store_true")
     p.add_argument("--skip-configs", action="store_true")
@@ -216,12 +236,16 @@ def main(argv=None):
 
     threading.Thread(target=_watchdog_loop, daemon=True).start()
 
+    probe = make_probe(f"TPU_PROBE_{args.tag}.json")
     t0 = time.perf_counter()
+    probe.inflight("import-jax", 300)
     import jax
 
     from spark_agd_tpu.data import device_synth
     from spark_agd_tpu.utils import compile_cache
 
+    probe.done("import-jax",
+               import_jax_s=round(time.perf_counter() - t0, 1))
     device_synth.ensure_cpu_backend()  # host twins need the cpu backend
     try:
         # a retried cycle must not pay every compile again out of its
@@ -230,13 +254,22 @@ def main(argv=None):
         log(f"compilation cache: {compile_cache.enable()}")
     except Exception as e:  # noqa: BLE001
         log(f"compilation cache unavailable: {type(e).__name__}: {e}")
-    stage("claim", args.claim_budget)
-    devs = jax.devices()  # THE claim; may queue behind the pool
+    probe.inflight("claim", args.claim_budget)
+    try:
+        devs = jax.devices()  # THE claim; may queue behind the pool
+    except Exception as e:  # noqa: BLE001 — distinguish "claim errored
+        # (e.g. UNAVAILABLE after the queue)" from "claim hung" in the
+        # committed probe artifact, then let the retry loop take over
+        probe.done("claim", claim_error=f"{type(e).__name__}: {e}"[:300],
+                   claim_wait_s=round(time.perf_counter() - t0, 1))
+        raise
     stage("claimed")  # disarm NOW — a claim that lands at 1699s of a
     # 1700s budget must not be discarded by a poll during the logging
     # and probe setup below
     d = devs[0]
     claim_s = time.perf_counter() - t0
+    probe.done("claim", claim_s=round(claim_s, 1), platform=d.platform,
+               device_kind=d.device_kind)
     log(f"claim acquired in {claim_s:.1f}s: {d.platform}/{d.device_kind}")
     if d.platform != "tpu" and not os.environ.get("TPU_ALL_ALLOW_CPU"):
         print(json.dumps({"error": f"not a TPU: {d.platform}"}))
@@ -244,12 +277,14 @@ def main(argv=None):
 
     failures = 0
     try:
-        _probe_stage(d, claim_s, args)
+        _probe_stage(probe, d, args)
     except Exception as e:  # noqa: BLE001 — the probe is evidence, not a
         # gate: bench/checks/configs each degrade on their own terms, and
         # a cycle whose stages all succeed must exit 0 so the retry loop
         # doesn't burn another claim re-running finished work
         log(f"probe failed (non-gating): {type(e).__name__}: {e}")
+        probe.done(probe.rec.get("inflight", ""),
+                   probe_error=f"{type(e).__name__}: {e}"[:200])
         os.environ.setdefault("TPU_H2D_MBPS", "0")  # be conservative
         stage("probe failed")  # disarm the probe watchdog budget
 
