@@ -62,6 +62,13 @@ def _resolve_mesh(mesh):
     return mesh
 
 
+def _batch_mesh(batch: "mesh_lib.ShardedBatch"):
+    """The mesh a pre-placed batch lives on.  ``RowShardedCSR`` exposes
+    ``.sharding`` exactly like a dense array (``ops/sparse.py``), so one
+    expression serves both layouts."""
+    return batch.X.sharding.mesh
+
+
 def _build_smooth(gradient, data, mesh, dist_mode):
     if mesh is None:
         if isinstance(data, mesh_lib.ShardedBatch):
@@ -113,7 +120,7 @@ def make_runner(
     if isinstance(data, mesh_lib.ShardedBatch):
         # A pre-placed batch carries its own mesh; recover it rather than
         # defaulting to an all-device mesh the batch may not live on.
-        batch_mesh = data.X.sharding.mesh
+        batch_mesh = _batch_mesh(data)
         if mesh is None:
             mesh = batch_mesh
         elif mesh is not False and mesh != batch_mesh:
@@ -227,21 +234,56 @@ def make_sweep_runner(
     alpha: float = 0.9,
     may_restart: bool = True,
     *,
+    mesh=False,
     loss_mode: str = "x",
 ):
     """Build ``fit(initial_weights, reg_params) -> batched AGDResult``,
     compiled ONCE — the ``make_runner`` twin of :func:`sweep` for
-    repeated paths (cross-validation folds, warm-started grids)."""
-    if isinstance(data, mesh_lib.ShardedBatch):
-        raise ValueError("sweep is single-device; pass raw (X, y[, mask])")
-    X, y, mask = _normalize_data(data)
-    # the single-device branch of the shared builder: one prepare(), one
-    # staged copy (see _build_smooth's prepare-once invariant)
-    sm, sl = _build_smooth(gradient, (X, y, mask), None, "shard_map")
+    repeated paths (cross-validation folds, warm-started grids).
+
+    ``mesh``: ``False`` (default) runs single-device — the sweep axis is
+    the parallel axis.  Pass a ``jax.sharding.Mesh`` (or ``None`` for
+    the all-device data mesh) to ALSO shard rows over the mesh's
+    ``data`` axis: lanes are vmapped inside one shard_map, so the grid
+    runs on the full mesh the way the reference runs its sequential
+    grid on the full cluster (``AcceleratedGradientDescent.scala:128``
+    per job) — mandatory at scales where one device cannot hold the
+    rows.  A ``ShardedBatch`` (dense or nnz-balanced ``RowShardedCSR``)
+    is accepted and implies its own mesh.
+    """
     cfg = agd.AGDConfig(
         convergence_tol=convergence_tol, num_iterations=num_iterations,
         l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
         may_restart=may_restart, loss_mode=loss_mode)
+
+    if isinstance(data, mesh_lib.ShardedBatch):
+        batch_mesh = _batch_mesh(data)
+        if mesh in (None, False):
+            mesh = batch_mesh
+        elif mesh != batch_mesh:
+            raise ValueError(
+                "explicit mesh differs from the ShardedBatch's mesh; "
+                "re-shard the batch or drop the mesh argument")
+    else:
+        mesh = _resolve_mesh(mesh)
+
+    if mesh is not None:
+        from .parallel import grid
+
+        batch = (data if isinstance(data, mesh_lib.ShardedBatch)
+                 else mesh_lib.shard_batch(mesh, *_normalize_data(data)))
+        mesh_fit = grid.make_mesh_sweep_fit(gradient, updater, batch,
+                                            mesh, cfg)
+
+        def fit(initial_weights, reg_params, warm=None):
+            return mesh_fit(reg_params, initial_weights, warm=warm)
+
+        return fit
+
+    X, y, mask = _normalize_data(data)
+    # the single-device branch of the shared builder: one prepare(), one
+    # staged copy (see _build_smooth's prepare-once invariant)
+    sm, sl = _build_smooth(gradient, (X, y, mask), None, "shard_map")
 
     def fit_one(reg, w0, warm=None):
         px, rv = smooth_lib.make_prox(updater, reg)
@@ -298,6 +340,7 @@ def sweep(
     alpha: float = 0.9,
     may_restart: bool = True,
     *,
+    mesh=False,
     loss_mode: str = "x",
 ):
     """Fit ONE problem at K regularization strengths in ONE compiled
@@ -317,17 +360,19 @@ def sweep(
     Returns a batched ``AGDResult``: every field gains a leading K axis
     (``weights[k]``, ``loss_history[k]``, ``num_iters[k]``, …).
 
-    Single-device evaluation (the sweep axis IS the parallel axis);
-    shard the data axis too by composing with ``mesh`` in a follow-up.
-    Re-traces per call like :func:`run`; use :func:`make_sweep_runner`
-    for repeated fits.
+    ``mesh=False`` (default) evaluates single-device — the sweep axis is
+    the parallel axis.  Pass a ``Mesh`` / ``None`` / a ``ShardedBatch``
+    to also shard rows over the mesh's ``data`` axis (lanes replicated,
+    rows sharded; see ``parallel.grid``).  Re-traces per call like
+    :func:`run`; use :func:`make_sweep_runner` for repeated fits.
     """
     if initial_weights is None:
         raise ValueError("initial_weights is required")
     fit = make_sweep_runner(
         data, gradient, updater, convergence_tol=convergence_tol,
         num_iterations=num_iterations, l0=l0, l_exact=l_exact, beta=beta,
-        alpha=alpha, may_restart=may_restart, loss_mode=loss_mode)
+        alpha=alpha, may_restart=may_restart, mesh=mesh,
+        loss_mode=loss_mode)
     return fit(initial_weights, reg_params)
 
 
@@ -361,6 +406,7 @@ def cross_validate(
     alpha: float = 0.9,
     may_restart: bool = True,
     *,
+    mesh=False,
     loss_mode: str = "x",
     seed: int = 0,
 ) -> CVResult:
@@ -377,17 +423,111 @@ def cross_validate(
     Spark grid search is F·R sequential jobs with F·R·iterations
     broadcast/reduce round-trips; this is one launch.
 
+    **Cost shape — quietly quadratic in coverage:** every (fold,
+    strength) lane evaluates the FULL N×D matvec with a mask, so one CV
+    launch costs ~``n_folds * len(reg_params)`` times the FLOPs of one
+    fit, with ``(n_folds-1)/n_folds`` of each lane's rows contributing
+    zeros.  That trade is deliberate at moderate scale (one launch, no
+    gathers, perfect MXU batching) but real at config scale: when
+    ``n_folds * len(reg_params)`` is large relative to available FLOPs,
+    prefer :func:`sweep` over manually compacted per-fold subsets (F
+    separate sweeps over N·(F-1)/F gathered rows — F times less masked
+    waste at the cost of F launches).
+
     Folds are a deterministic (``seed``) uniform assignment.  Rows
     masked out by an input ``(X, y, mask)`` triple stay excluded from
     BOTH training and validation everywhere.
+
+    ``mesh=False`` (default) runs single-device.  Pass a ``Mesh`` /
+    ``None`` / a dense ``ShardedBatch`` to shard rows over the mesh's
+    ``data`` axis — lanes vmapped inside one shard_map
+    (``parallel.grid``), the cluster-wide grid the reference runs as
+    sequential jobs.  Sparse (CSR) mesh CV is not supported (fold ids
+    cannot follow the nnz-balanced row permutation); see
+    ``parallel.grid.make_mesh_cv_fit``.
     """
     if initial_weights is None:
         raise ValueError("initial_weights is required")
     if n_folds < 2:
         raise ValueError("n_folds must be >= 2")
-    if isinstance(data, mesh_lib.ShardedBatch):
-        raise ValueError(
-            "cross_validate is single-device; pass raw (X, y[, mask])")
+
+    regs = jnp.asarray(reg_params, jnp.float32)
+    if regs.ndim != 1:
+        raise ValueError("reg_params must be 1-D")
+    n_regs = regs.shape[0]
+    fold_lane = jnp.repeat(jnp.arange(n_folds, dtype=jnp.int32), n_regs)
+    reg_lane = jnp.tile(regs, n_folds)
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+
+    def _fold_assignment(n):
+        # balanced assignment (round-robin over a random permutation):
+        # fold sizes differ by at most 1, so no fold is empty for
+        # n >= n_folds — an empty fold would silently score 0.0
+        # validation loss
+        perm = jax.random.permutation(jax.random.PRNGKey(seed), n)
+        return jnp.zeros(n, jnp.int32).at[perm].set(
+            jnp.arange(n, dtype=jnp.int32) % n_folds)
+
+    def _collect(val_flat, res_flat, fold_ids, base_mask):
+        val_loss = val_flat.reshape(n_folds, n_regs)
+        train_result = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_folds, n_regs) + a.shape[1:]),
+            res_flat)
+        # nanmean: a fold emptied by the base mask reports NaN (see
+        # _mean_loss) and must not poison every strength's average; a
+        # strength with NO valid fold stays NaN and argmin will not pick
+        # it (NaN comparisons are false) unless ALL are NaN — callers
+        # refitting on best_index must check finiteness (the model layer
+        # does).
+        mean_val = jnp.nanmean(val_loss, axis=0)
+        return CVResult(val_loss=val_loss, train_result=train_result,
+                        mean_val_loss=mean_val,
+                        best_index=jnp.argmin(mean_val),
+                        fold_ids=fold_ids, base_mask=base_mask)
+
+    is_batch = isinstance(data, mesh_lib.ShardedBatch)
+    # Sparse CSR input with the AUTO mesh default falls back to the
+    # single-device lane grid (which handles CSR fine) instead of
+    # hitting the mesh path's NotImplementedError — only an EXPLICIT
+    # mesh/ShardedBatch request surfaces that limitation.
+    auto_mesh_ok = not (isinstance(data, (tuple, list))
+                        and isinstance(data[0], CSRMatrix))
+    if is_batch or mesh not in (None, False) or (
+            mesh is None and auto_mesh_ok and len(jax.devices()) > 1):
+        from .parallel import grid
+
+        if is_batch:
+            batch = data
+            m = _batch_mesh(batch)
+            if mesh not in (None, False) and mesh != m:
+                raise ValueError(
+                    "explicit mesh differs from the ShardedBatch's "
+                    "mesh; re-shard the batch or drop the mesh argument")
+            n = batch.y.shape[0]  # padded layout; mask covers padding
+            fold_ids = _fold_assignment(n)
+            base_mask = (batch.mask if batch.mask is not None
+                         else jnp.ones(n, jnp.float32))
+            fids_sharded = grid.shard_row_array(m, np.asarray(fold_ids),
+                                                n, fill=-1)
+        else:
+            m = _resolve_mesh(mesh)
+            X, y, base_mask = _normalize_data(data)
+            n = X.shape[0]
+            fold_ids = _fold_assignment(n)
+            base_mask = (jnp.ones(n, jnp.float32) if base_mask is None
+                         else jnp.asarray(base_mask, jnp.float32))
+            batch = mesh_lib.shard_batch(m, X, y,
+                                         np.asarray(base_mask))
+            fids_sharded = grid.shard_row_array(
+                m, np.asarray(fold_ids), batch.y.shape[0], fill=-1)
+        fit = grid.make_mesh_cv_fit(gradient, updater, batch,
+                                    fids_sharded, m, cfg)
+        val_flat, res_flat = fit(fold_lane, reg_lane, initial_weights)
+        return _collect(val_flat, res_flat, fold_ids, base_mask)
+
     X, y, base_mask = _normalize_data(data)
     n = X.shape[0]
     if not isinstance(X, CSRMatrix):
@@ -403,23 +543,7 @@ def cross_validate(
             "Pallas layouts) is not supported here; use the plain "
             "XLA gradients")
 
-    # balanced assignment (round-robin over a random permutation): fold
-    # sizes differ by at most 1, so no fold is empty for n >= n_folds —
-    # an empty fold would silently score 0.0 validation loss
-    perm = jax.random.permutation(jax.random.PRNGKey(seed), n)
-    fold_ids = jnp.zeros(n, jnp.int32).at[perm].set(
-        jnp.arange(n, dtype=jnp.int32) % n_folds)
-    regs = jnp.asarray(reg_params, jnp.float32)
-    if regs.ndim != 1:
-        raise ValueError("reg_params must be 1-D")
-    n_regs = regs.shape[0]
-    fold_lane = jnp.repeat(jnp.arange(n_folds, dtype=jnp.int32), n_regs)
-    reg_lane = jnp.tile(regs, n_folds)
-
-    cfg = agd.AGDConfig(
-        convergence_tol=convergence_tol, num_iterations=num_iterations,
-        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
-        may_restart=may_restart, loss_mode=loss_mode)
+    fold_ids = _fold_assignment(n)
     w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
 
     def fit_one(fold_k, reg):
@@ -433,19 +557,7 @@ def cross_validate(
         return val, res
 
     val_flat, res_flat = jax.jit(jax.vmap(fit_one))(fold_lane, reg_lane)
-    val_loss = val_flat.reshape(n_folds, n_regs)
-    train_result = jax.tree_util.tree_map(
-        lambda a: a.reshape((n_folds, n_regs) + a.shape[1:]), res_flat)
-    # nanmean: a fold emptied by the base mask reports NaN (see
-    # _mean_loss) and must not poison every strength's average; a
-    # strength with NO valid fold stays NaN and argmin will not pick it
-    # (NaN comparisons are false) unless ALL are NaN — callers refitting
-    # on best_index must check finiteness (the model layer does).
-    mean_val = jnp.nanmean(val_loss, axis=0)
-    return CVResult(val_loss=val_loss, train_result=train_result,
-                    mean_val_loss=mean_val,
-                    best_index=jnp.argmin(mean_val), fold_ids=fold_ids,
-                    base_mask=base_mask)
+    return _collect(val_flat, res_flat, fold_ids, base_mask)
 
 
 def _mean_loss(gradient, w, X, y, mask):
@@ -558,13 +670,8 @@ class AcceleratedGradientDescent:
         return weights
 
     def _check_grid_fit(self, reg_params, op_name: str):
-        """Shared guards for the batched grid fits (sweep / CV): they run
-        single-device, and a grid through the identity prox would be
-        silently ignored."""
-        if self._mesh not in (None, False):
-            raise ValueError(
-                f"{op_name} is single-device; drop the optimizer's mesh "
-                f"or fit configurations individually")
+        """Shared guard for the batched grid fits (sweep / CV): a grid
+        through the identity prox would be silently ignored."""
         from .ops.prox import IdentityProx
 
         reg_params = list(reg_params)
@@ -580,8 +687,11 @@ class AcceleratedGradientDescent:
         """Regularization path with this object's configuration: K
         strengths in one compiled program (module-level :func:`sweep`).
         ``set_reg_param`` is ignored — the grid supplies the strengths.
-        The config forwarding lives HERE so every optimizer knob reaches
-        the sweep the way ``optimize`` forwards it."""
+        The optimizer's mesh composes: like ``optimize``, the default
+        (``None``) shards rows over every visible device; ``set_mesh
+        (False)`` forces single-device.  The config forwarding lives
+        HERE so every optimizer knob reaches the sweep the way
+        ``optimize`` forwards it."""
         reg_params = self._check_grid_fit(reg_params, "sweep")
         return sweep(
             data, self._gradient, self._updater, reg_params,
@@ -590,14 +700,15 @@ class AcceleratedGradientDescent:
             initial_weights=initial_weights,
             l0=self._l0, l_exact=self._l_exact, beta=self._beta,
             alpha=self._alpha, may_restart=self._may_restart,
-            loss_mode=self._loss_mode)
+            mesh=self._mesh, loss_mode=self._loss_mode)
 
     def cross_validate(self, data: Data, reg_params,
                        initial_weights: Any, n_folds: int = 5,
                        seed: int = 0) -> CVResult:
         """K-fold CV over a grid with this object's configuration —
         every (fold, strength) fit and its held-out evaluation in one
-        compiled program (module-level :func:`cross_validate`)."""
+        compiled program (module-level :func:`cross_validate`).  The
+        optimizer's mesh composes exactly as in :meth:`sweep`."""
         reg_params = self._check_grid_fit(reg_params, "cross_validate")
         return cross_validate(
             data, self._gradient, self._updater, reg_params,
@@ -606,7 +717,7 @@ class AcceleratedGradientDescent:
             initial_weights=initial_weights,
             l0=self._l0, l_exact=self._l_exact, beta=self._beta,
             alpha=self._alpha, may_restart=self._may_restart,
-            loss_mode=self._loss_mode, seed=seed)
+            mesh=self._mesh, loss_mode=self._loss_mode, seed=seed)
 
 
 def run_minibatch_sgd(

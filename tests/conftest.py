@@ -47,6 +47,14 @@ def cpu_devices():
     return devs
 
 
+@pytest.fixture(scope="session")
+def mesh8(cpu_devices):
+    """The all-device pure-DP mesh most distributed tests run on."""
+    from spark_agd_tpu.parallel import mesh as mesh_lib
+
+    return mesh_lib.make_mesh({"data": 8}, devices=cpu_devices)
+
+
 def assert_rel(actual, expected, rel_tol, msg=""):
     """Relative-tolerance assert, the ``TestingUtils.~=`` analogue
     (reference Suite:28)."""
