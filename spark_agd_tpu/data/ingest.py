@@ -23,8 +23,19 @@ Contract (every host runs the same code — jax.distributed SPMD):
 Single-process (tests, one chip) degenerates to: read everything, shard
 like ``mesh.shard_batch`` — same return type, no branching in callers.
 
-Multi-host assembly currently densifies rows (the MXU path); CSR data in
-a single process routes through ``mesh.shard_csr_batch`` instead.
+Two assembly layouts:
+
+- :func:`from_partitioned_files` — densified rows (the MXU path for
+  moderate D);
+- :func:`from_partitioned_files_csr` — SPARSE end to end: each host
+  lays out its local rows over its own device shards
+  (``mesh.csr_shard_layout``, nnz-balanced) with globally-agreed
+  shard dimensions (two allgather-max reductions), and the per-host
+  blocks assemble into one ``RowShardedCSR`` without ever densifying —
+  the url_combined regime (D≈3.2M) where a dense row is 12.8 MB and
+  densifying is impossible.  This is the reference's sparse-Vector
+  ingest capability (``AcceleratedGradientDescent.scala:196-204``
+  accepts sparse MLlib vectors) at mesh scale.
 """
 
 from __future__ import annotations
@@ -49,6 +60,17 @@ def _allgather_max(value: int) -> int:
     gathered = multihost_utils.process_allgather(
         np.asarray([value], np.int64))
     return int(np.max(gathered))
+
+
+def _allgather_sum(value: int) -> int:
+    """Sum of a per-host int across the SPMD job."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([value], np.int64))
+    return int(np.sum(gathered))
 
 
 def local_partitions(paths: Sequence[str]) -> list:
@@ -138,3 +160,106 @@ def from_partitioned_files(
     mg = jax.make_array_from_process_local_data(
         row_spec, mask_local, (n_global,))
     return mesh_lib.ShardedBatch(Xg, yg, mg)
+
+
+def from_partitioned_files_csr(
+    paths: Sequence[str],
+    mesh=None,
+    *,
+    n_features: Optional[int] = None,
+    binarize_labels: bool = True,
+    with_csc: bool = True,
+    balance: bool = True,
+    loader: Optional[Callable[..., "libsvm.CSRData"]] = None,
+    axis: str = mesh_lib.DATA_AXIS,
+) -> mesh_lib.ShardedBatch:
+    """Load a LIBSVM partition set into a mesh-sharded SPARSE batch —
+    no densification at any point (r2 VERDICT item 3).
+
+    Same host/partition contract as :func:`from_partitioned_files`; the
+    result's ``X`` is a :class:`~spark_agd_tpu.ops.sparse.RowShardedCSR`
+    (per-device local CSR slices, nnz-balanced within each host), so it
+    feeds the same shard_map+psum kernels as ``mesh.shard_csr_batch``
+    output.  Cross-host agreement costs two allgather-max reductions
+    (rows-per-shard, padded nnz-per-shard); a host with no partitions
+    contributes all-padding shards (mask 0 — exact no-ops in every sum).
+
+    ``with_csc=True`` (default) builds each shard's column-sorted twin
+    so the gradient uses sorted segment-sums.  ``n_features`` pins the
+    global width (url_combined: 3,231,961); inferred by allgather-max
+    when omitted.
+    """
+    if not paths:
+        raise ValueError("no partition files")
+    loader = loader or libsvm.load_libsvm
+    mesh = mesh if mesh is not None else mesh_lib.make_mesh(
+        {axis: len(jax.devices())})
+    n_dev_axis = mesh.shape[axis]
+    if n_dev_axis % jax.process_count():
+        raise ValueError(
+            f"mesh axis {axis!r} has {n_dev_axis} devices, not divisible "
+            f"by {jax.process_count()} processes; per-host shard assembly "
+            f"needs an even device-per-process split")
+    local_shards = n_dev_axis // jax.process_count()
+
+    parts = [loader(p, n_features=n_features)
+             for p in local_partitions(paths)]
+    d = n_features or _allgather_max(
+        max((part.n_features for part in parts), default=0))
+    if d == 0:
+        raise ValueError("could not infer n_features (all partitions "
+                         "empty on this host and none given)")
+    for p, part in zip(local_partitions(paths), parts):
+        if len(part.indices) and int(part.indices.max()) >= d:
+            raise ValueError(
+                f"{p}: feature index {int(part.indices.max())} >= "
+                f"n_features={d}")
+
+    # concatenate this host's partitions into one local CSR triple
+    row_ids, col_ids, values, ys = [], [], [], []
+    row_base = 0
+    for part in parts:
+        counts = np.diff(part.indptr)
+        row_ids.append(row_base + np.repeat(
+            np.arange(len(counts), dtype=np.int64), counts))
+        col_ids.append(np.asarray(part.indices, np.int64))
+        values.append(np.asarray(part.values, np.float32))
+        ys.append(part.binarized_labels() if binarize_labels
+                  else np.asarray(part.labels))
+        row_base += len(counts)
+    n_local = row_base
+    cat = (lambda xs, dt: np.concatenate(xs).astype(dt) if xs
+           else np.zeros(0, dt))
+    lay = mesh_lib.csr_shard_layout(
+        cat(row_ids, np.int64), cat(col_ids, np.int64),
+        cat(values, np.float32), cat(ys, np.float32), None,
+        n_local, d, local_shards, balance=balance, with_csc=with_csc,
+        reduce_max=_allgather_max)
+
+    n_rows_global = _allgather_sum(n_local)
+    if jax.process_count() == 1:
+        return mesh_lib.place_csr_layout(lay, mesh, axis,
+                                          n_rows_global, d)
+
+    spec = NamedSharding(mesh, P(axis))
+    nnz_g = n_dev_axis * lay["nnz_shard"]
+    rows_g = n_dev_axis * lay["rps"]
+
+    def g(a, n):
+        return jax.make_array_from_process_local_data(
+            spec, np.ascontiguousarray(a.reshape(-1)), (n,))
+
+    csc = {}
+    if with_csc:
+        csc = dict(csc_row_ids=g(lay["Rc"], nnz_g),
+                   csc_col_ids=g(lay["Cc"], nnz_g),
+                   csc_values=g(lay["Vc"], nnz_g))
+    from ..ops.sparse import RowShardedCSR
+
+    Xs = RowShardedCSR(
+        row_ids=g(lay["R"], nnz_g), col_ids=g(lay["C"], nnz_g),
+        values=g(lay["V"], nnz_g), shape=(n_rows_global, d),
+        rows_per_shard=lay["rps"], n_shards=n_dev_axis,
+        rows_sorted=True, **csc)
+    return mesh_lib.ShardedBatch(Xs, g(lay["Y"], rows_g),
+                                 g(lay["M"], rows_g))

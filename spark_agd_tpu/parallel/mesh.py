@@ -231,25 +231,51 @@ def shard_csr_batch(
         if not keep.all():
             row_ids, col_ids, values = (row_ids[keep], col_ids[keep],
                                         values[keep])
-    y = np.asarray(y)
-    n_shards = mesh.shape[axis]
-    rps = -(-n_rows // n_shards)  # rows per shard (ceil)
+    lay = csr_shard_layout(
+        row_ids, col_ids, values, np.asarray(y), mask, n_rows,
+        n_features, mesh.shape[axis], balance=balance,
+        with_csc=X.has_csc or X.want_csc, nnz_per_shard=nnz_per_shard)
+    return place_csr_layout(lay, mesh, axis, n_rows, n_features)
 
-    counts = np.bincount(row_ids, minlength=n_rows)
-    if balance:
-        # Greedy nnz balance (same scheme as the column layout in
-        # feature_sharded.py): heaviest row onto the lightest shard with
-        # remaining capacity.  Bounds the padded per-shard nnz near
-        # max(heaviest row, total/n_shards).  C++ core
-        # (native.greedy_balance) with a bit-identical Python fallback
-        # — the heapq loop costs seconds at url_combined scale (native
-        # measured 7x faster at 3.2M items).
-        shard_of_row, local_of_row = native.greedy_balance(
-            counts, n_shards, rps)
-    else:
-        rows = np.arange(n_rows, dtype=np.int64)
-        shard_of_row = rows // rps
-        local_of_row = rows % rps
+
+def csr_shard_layout(row_ids, col_ids, values, y, mask, n_rows: int,
+                     n_features: int, n_shards: int, *,
+                     balance: bool = True, with_csc: bool = False,
+                     nnz_per_shard: Optional[int] = None,
+                     reduce_max=None) -> dict:
+    """Pure-host (NumPy) construction of the per-shard CSR layout — the
+    core of :func:`shard_csr_batch`, factored out so multi-host ingest
+    (``data.ingest.from_partitioned_files_csr``) can build each host's
+    LOCAL shards with GLOBALLY-agreed dimensions.
+
+    ``reduce_max(int) -> int`` equalizes the two cross-host dimensions
+    (rows-per-shard before balancing, padded nnz-per-shard after) — pass
+    an allgather-max under SPMD; identity (default) single-process.
+    Returns ``dict(R, C, V[, Rc, Cc, Vc], Y, M, rps, nnz_shard)`` with
+    2-D ``(n_shards, ...)`` arrays ready to flatten and place.
+    """
+    red = reduce_max or (lambda v: int(v))
+    rps = red(max(1, -(-n_rows // n_shards) if n_rows else 1))
+
+    if n_rows:
+        counts = np.bincount(row_ids, minlength=n_rows)
+        if balance:
+            # Greedy nnz balance (same scheme as the column layout in
+            # feature_sharded.py): heaviest row onto the lightest shard
+            # with remaining capacity.  Bounds the padded per-shard nnz
+            # near max(heaviest row, total/n_shards).  C++ core
+            # (native.greedy_balance) with a bit-identical Python
+            # fallback — the heapq loop costs seconds at url_combined
+            # scale (native measured 7x faster at 3.2M items).
+            shard_of_row, local_of_row = native.greedy_balance(
+                counts, n_shards, rps)
+        else:
+            rows = np.arange(n_rows, dtype=np.int64)
+            shard_of_row = rows // rps
+            local_of_row = rows % rps
+    else:  # a host with no partitions still participates in the layout
+        shard_of_row = np.zeros(0, np.int64)
+        local_of_row = np.zeros(0, np.int64)
 
     e_shard = shard_of_row[row_ids]
     e_local = local_of_row[row_ids].astype(np.int32)
@@ -257,27 +283,30 @@ def shard_csr_batch(
     shard_sorted = e_shard[eorder]
     starts = np.searchsorted(shard_sorted, np.arange(n_shards))
     ends = np.searchsorted(shard_sorted, np.arange(n_shards), side="right")
-    nnz_shard = max(int((ends - starts).max()) if len(values) else 1, 1)
+    nnz_needed = max(int((ends - starts).max()) if len(values) else 1, 1)
     if nnz_per_shard is not None:
-        if nnz_shard > nnz_per_shard:
+        if nnz_needed > nnz_per_shard:
             raise ValueError(
-                f"a shard holds {nnz_shard} entries > nnz_per_shard="
+                f"a shard holds {nnz_needed} entries > nnz_per_shard="
                 f"{nnz_per_shard}; raise the budget (streaming callers: "
                 f"make_streaming_smooth's csr_nnz_per_shard — one "
                 f"compiled shape must fit every macro-batch)")
         nnz_shard = int(nnz_per_shard)
+    else:
+        nnz_shard = red(nnz_needed)
 
-    with_csc = X.has_csc or X.want_csc
     # Padding slots point at the LAST local row / col (inert 0.0 values)
     # so per-shard ids stay nondecreasing and both segment-sums can claim
     # ``indices_are_sorted`` (see ops.sparse module docstring).
     R = np.full((n_shards, nnz_shard), rps - 1, np.int32)
     C = np.zeros((n_shards, nnz_shard), np.int32)
     V = np.zeros((n_shards, nnz_shard), values.dtype)
+    out = dict(R=R, C=C, V=V, rps=rps, nnz_shard=nnz_shard)
     if with_csc:
         Rc = np.zeros((n_shards, nnz_shard), np.int32)
         Cc = np.full((n_shards, nnz_shard), n_features - 1, np.int32)
         Vc = np.zeros((n_shards, nnz_shard), values.dtype)
+        out.update(Rc=Rc, Cc=Cc, Vc=Vc)
     for s in range(n_shards):
         sel = eorder[starts[s]:ends[s]]
         # row-sorted copy: order the shard's entries by local row id
@@ -292,24 +321,32 @@ def shard_csr_batch(
             Cc[s, :k] = col_ids[sel_c]
             Vc[s, :k] = values[sel_c]
 
-    Y = np.zeros((n_shards, rps), y.dtype)
-    Y[shard_of_row, local_of_row] = y
+    Y = np.zeros((n_shards, rps), y.dtype if n_rows else np.float32)
     M = np.zeros((n_shards, rps), np.float32)
-    M[shard_of_row, local_of_row] = (
-        np.ones(n_rows, np.float32) if mask is None
-        else np.asarray(mask, np.float32))
+    if n_rows:
+        Y[shard_of_row, local_of_row] = y
+        M[shard_of_row, local_of_row] = (
+            np.ones(n_rows, np.float32) if mask is None
+            else np.asarray(mask, np.float32))
+    out.update(Y=Y, M=M)
+    return out
 
+
+def place_csr_layout(lay: dict, mesh: Mesh, axis: str, n_rows: int,
+                      n_features: int) -> ShardedBatch:
+    """Device-place a single-process :func:`csr_shard_layout` result."""
     spec = NamedSharding(mesh, P(axis))
     csc = {}
-    if with_csc:
-        csc = dict(csc_row_ids=jax.device_put(Rc.reshape(-1), spec),
-                   csc_col_ids=jax.device_put(Cc.reshape(-1), spec),
-                   csc_values=jax.device_put(Vc.reshape(-1), spec))
+    if "Rc" in lay:
+        csc = dict(
+            csc_row_ids=jax.device_put(lay["Rc"].reshape(-1), spec),
+            csc_col_ids=jax.device_put(lay["Cc"].reshape(-1), spec),
+            csc_values=jax.device_put(lay["Vc"].reshape(-1), spec))
     Xs = RowShardedCSR(
-        row_ids=jax.device_put(R.reshape(-1), spec),
-        col_ids=jax.device_put(C.reshape(-1), spec),
-        values=jax.device_put(V.reshape(-1), spec),
-        shape=(n_rows, n_features), rows_per_shard=rps, n_shards=n_shards,
-        rows_sorted=True, **csc)
-    return ShardedBatch(Xs, jax.device_put(Y.reshape(-1), spec),
-                        jax.device_put(M.reshape(-1), spec))
+        row_ids=jax.device_put(lay["R"].reshape(-1), spec),
+        col_ids=jax.device_put(lay["C"].reshape(-1), spec),
+        values=jax.device_put(lay["V"].reshape(-1), spec),
+        shape=(n_rows, n_features), rows_per_shard=lay["rps"],
+        n_shards=lay["R"].shape[0], rows_sorted=True, **csc)
+    return ShardedBatch(Xs, jax.device_put(lay["Y"].reshape(-1), spec),
+                        jax.device_put(lay["M"].reshape(-1), spec))

@@ -53,6 +53,7 @@ def main():
 
     if len(sys.argv) > 4:
         _ingest_check(sys.argv[4], mesh)
+        _sparse_ingest_check(sys.argv[4], mesh)
     print(f"CHILD_OK pid={pid} psum={float(total)}", flush=True)
 
 
@@ -89,6 +90,58 @@ def _ingest_check(part_dir, mesh):
                                rtol=1e-4, atol=1e-6)
     print(f"INGEST_OK pid={jax.process_index()} rows={batch.X.shape[0]}",
           flush=True)
+
+
+def _sparse_ingest_check(part_dir, mesh):
+    """Sparse multi-host ingest (r2 VERDICT item 3): each process
+    assembles its partitions into LOCAL RowShardedCSR shards with
+    allgather-agreed dimensions; the global sparse batch must stream the
+    SAME mesh-CSR AGD every host can verify against the dense answer —
+    no densification anywhere in the assembly."""
+    import glob
+
+    from spark_agd_tpu import api
+    from spark_agd_tpu.data import ingest, libsvm
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.ops.sparse import RowShardedCSR
+
+    paths = sorted(glob.glob(part_dir + "/part-*.libsvm"))
+    d = 9
+    batch = ingest.from_partitioned_files_csr(paths, mesh, n_features=d)
+    assert isinstance(batch.X, RowShardedCSR), type(batch.X)
+
+    # The fused jit path closes over the data arrays — fine in one
+    # process, disallowed for cross-process global arrays — so drive
+    # the HOST-loop AGD twin over the eager shard_map smooth (the same
+    # pairing the streaming path uses): every collective still runs
+    # cross-process, and replicated outputs are fetchable everywhere.
+    from spark_agd_tpu.core import agd, host_agd, smooth as smooth_lib
+    from spark_agd_tpu.parallel import dist_smooth
+
+    sm, sl = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                          mesh=mesh)
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    cfg = agd.AGDConfig(num_iterations=3, convergence_tol=0.0)
+    w0 = np.zeros(d, np.float32)
+    res = host_agd.run_agd_host(sm, px, rv, w0, cfg, smooth_loss=sl)
+
+    # dense single-device reference from ALL partitions (files are tiny)
+    parts = [libsvm.load_libsvm(p, n_features=d) for p in paths]
+    X = np.concatenate([p.to_dense(d) for p in parts])
+    y = np.concatenate([p.binarized_labels() for p in parts]).astype(
+        np.float32)
+    w_ref, hist_ref = api.run((X, y), LogisticGradient(), L2Prox(),
+                              num_iterations=3, reg_param=0.1,
+                              initial_weights=w0, convergence_tol=0.0,
+                              mesh=False)
+    np.testing.assert_allclose(
+        np.asarray(res.loss_history)[:res.num_iters],
+        np.asarray(hist_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.weights),
+                               np.asarray(w_ref), rtol=1e-4, atol=1e-6)
+    print(f"SPARSE_INGEST_OK pid={jax.process_index()} "
+          f"rows={batch.X.shape[0]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -101,3 +101,77 @@ class TestFromPartitionedFiles:
     def test_local_partitions_round_robin_single(self, cpu_devices):
         paths = [f"p{k}" for k in range(5)]
         assert ingest.local_partitions(paths) == sorted(paths)
+
+
+class TestFromPartitionedFilesCSR:
+    """Sparse multi-host ingest (r2 VERDICT item 3): partition files →
+    RowShardedCSR, never densified."""
+
+    def test_matches_dense_ingest(self, cpu_devices, partitioned):
+        from spark_agd_tpu.ops.sparse import RowShardedCSR
+
+        paths, X_all, y_all = partitioned
+        batch = ingest.from_partitioned_files_csr(paths)
+        assert isinstance(batch.X, RowShardedCSR)
+        assert batch.X.shape == (len(y_all), X_all.shape[1])
+        mesh = batch.y.sharding.mesh
+        sm, _ = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                             mesh=mesh)
+        import jax.numpy as jnp
+
+        w = jnp.asarray(np.linspace(-0.5, 0.5, X_all.shape[1]),
+                        jnp.float32)
+        loss, grad = sm(sat.replicate(w, mesh))
+        ref_loss, ref_grad = LogisticGradient().mean_loss_and_grad(
+            w, jnp.asarray(X_all), jnp.asarray(y_all.astype(np.float32)))
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(grad),
+                                   np.asarray(ref_grad),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_feeds_api_run(self, cpu_devices, partitioned):
+        paths, X_all, y_all = partitioned
+        batch = ingest.from_partitioned_files_csr(paths)
+        w0 = np.zeros(X_all.shape[1], np.float32)
+        w, hist = sat.run(batch, LogisticGradient(), L2Prox(),
+                          num_iterations=4, reg_param=0.1,
+                          initial_weights=w0, convergence_tol=0.0)
+        ref_w, ref_hist = sat.run(
+            (X_all, y_all.astype(np.float32)), LogisticGradient(),
+            L2Prox(), num_iterations=4, reg_param=0.1,
+            initial_weights=w0, mesh=False, convergence_tol=0.0)
+        np.testing.assert_allclose(hist, ref_hist, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(w), np.asarray(ref_w),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_url_combined_width_at_toy_nnz(self, cpu_devices, tmp_path,
+                                           rng):
+        """The regime the sparse path exists for: D = 3,231,961
+        (url_combined, BASELINE config 3) cannot densify — one dense row
+        is 12.9 MB.  Toy nnz, full width, one AGD iteration end to
+        end."""
+        d = 3_231_961
+        n = 24
+        lines = []
+        label_sign = 1.0
+        for i in range(n):
+            cols = np.sort(rng.choice(d, size=5, replace=False))
+            feats = " ".join(f"{c + 1}:{rng.normal():.4f}" for c in cols)
+            lines.append(f"{label_sign:+.0f} {feats}")
+            label_sign = -label_sign
+        p = tmp_path / "part-0.libsvm"
+        p.write_text("\n".join(lines) + "\n")
+        batch = ingest.from_partitioned_files_csr([str(p)],
+                                                  n_features=d)
+        assert batch.X.shape == (n, d)
+        w, hist = sat.run(batch, LogisticGradient(), L2Prox(),
+                          num_iterations=1, reg_param=0.1,
+                          initial_weights=np.zeros(d, np.float32),
+                          convergence_tol=0.0)
+        assert np.all(np.isfinite(hist))
+        assert w.shape == (d,)
+
+    def test_width_guard(self, cpu_devices, partitioned):
+        paths, _, _ = partitioned
+        with pytest.raises(ValueError, match="n_features"):
+            ingest.from_partitioned_files_csr(paths, n_features=3)

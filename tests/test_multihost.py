@@ -120,6 +120,7 @@ class TestTwoProcess:
             assert rc == 0, f"child failed (rc={rc}):\n{err[-2000:]}"
             assert "CHILD_OK" in out, out
             assert "INGEST_OK" in out, out
+            assert "SPARSE_INGEST_OK" in out, out
         assert "pid=0" in outs[0][1] and "pid=1" in outs[1][1]
 
 
