@@ -54,7 +54,7 @@ def _max_batch_nnz(indptr, batch_rows: int) -> int:
 
 def iter_csr_batches(indptr, indices, values, n_features: int, y,
                      batch_rows: int, mask=None,
-                     with_csc=True,
+                     with_csc="lazy",
                      nnz_pad: Optional[int] = None) -> Iterator[Tuple]:
     """Slice host CSR arrays into fixed-shape macro-batches.
 
@@ -64,13 +64,17 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
     explicitly when batches from SEVERAL sources must share one compiled
     shape (``StreamingDataset.from_libsvm_parts``).  Padding follows the
     ops.sparse contract: inert 0.0 entries at the LAST row/col slot (ids
-    stay nondecreasing), padded row slots masked 0.  ``with_csc=True``
-    builds each batch's column-sorted twin on the host — the per-batch
-    argsort overlaps device compute inside :func:`fold_stream`'s double
-    buffering.  ``with_csc="lazy"`` only MARKS the batch as wanting the
-    twin (``CSRMatrix.want_csc``) — the right choice for MESH streaming,
-    where ``shard_csr_batch`` builds per-shard twins itself and a global
-    one would be argsort work thrown away.  ``False`` disables twins
+    stay nondecreasing), padded row slots masked 0.
+
+    ``with_csc="lazy"`` (default) MARKS each batch as wanting the
+    column-sorted twin (``CSRMatrix.want_csc``) and lets placement
+    provide it the cheap way for each path: MESH streaming's
+    ``shard_csr_batch`` builds per-shard twins itself (a global one
+    would be argsort work thrown away), and single-device placement
+    materializes the twin ON DEVICE (overlapped with compute by
+    :func:`fold_stream`'s double buffering).  ``True`` builds each
+    batch's twin eagerly on the host — useful to move the argsort off
+    the device when host cores are idle.  ``False`` disables twins
     (gradient falls back to scatter-add).
     """
     indptr = np.asarray(indptr)
@@ -144,7 +148,7 @@ class StreamingDataset:
 
     @classmethod
     def from_csr(cls, indptr, indices, values, n_features: int, y,
-                 batch_rows: int, mask=None, with_csc=True,
+                 batch_rows: int, mask=None, with_csc="lazy",
                  nnz_pad: Optional[int] = None):
         """Macro-batches over host CSR arrays (``data.libsvm.CSRData``'s
         fields) — the sparse twin of ``from_arrays``; see
@@ -155,7 +159,7 @@ class StreamingDataset:
 
     @classmethod
     def from_libsvm_parts(cls, paths, n_features: int, batch_rows: int,
-                          with_csc=True,
+                          with_csc="lazy",
                           nnz_pad: Optional[int] = None,
                           binarize_labels: bool = True):
         """Stream LIBSVM partition files (e.g. a Spark job's part-*
@@ -297,10 +301,17 @@ def make_streaming_smooth(
                 b = mesh_lib.shard_csr_batch(mesh, X, y, mask,
                                              nnz_per_shard=budget[0])
                 return b.X, b.y, b.mask
-            # iter_csr_batches already padded to fixed shape; just move
-            # the leaves (csc twin included) onto the device
-            return (jax.tree_util.tree_map(jnp.asarray, X),
-                    jnp.asarray(y), jnp.asarray(mask))
+            # iter_csr_batches already padded to fixed shape; move the
+            # leaves onto the device and, when the batch WANTS a CSC
+            # twin it doesn't carry (with_csc="lazy"), materialize it
+            # there — an on-device argsort per batch, overlapped with
+            # compute by fold_stream's double buffering; without this
+            # the gradient would silently take the slow scatter-add
+            # path (r2 ADVICE)
+            Xd = jax.tree_util.tree_map(jnp.asarray, X)
+            if Xd.want_csc and not Xd.has_csc:
+                Xd = Xd.with_csc()
+            return Xd, jnp.asarray(y), jnp.asarray(mask)
         X = np.asarray(X)
         y = np.asarray(y)
         n = X.shape[0]

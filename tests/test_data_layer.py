@@ -208,7 +208,7 @@ class TestStreaming:
         assert r_host.num_restarts == int(r_fused.num_restarts)
         assert r_host.num_backtracks == int(r_fused.num_backtracks)
 
-    @pytest.mark.parametrize("with_csc", [True, False])
+    @pytest.mark.parametrize("with_csc", [True, False, "lazy"])
     def test_streamed_csr_smooth_equals_in_memory(self, rng, with_csc):
         """Sparse macro-batches (fixed-shape padding, ragged tail) must
         reproduce the in-memory CSR smooth exactly up to reassociation."""
@@ -229,12 +229,18 @@ class TestStreaming:
             indptr, indices, values, d, y, batch_rows=128,
             with_csc=with_csc)
         batches = list(ds)
-        assert all(b[0].has_csc == with_csc for b in batches)
+        if with_csc == "lazy":
+            # the default: only the marker travels; placement
+            # materializes the twin on device (asserted below)
+            assert all(not b[0].has_csc and b[0].want_csc
+                       for b in batches)
+        else:
+            assert all(b[0].has_csc == with_csc for b in batches)
         # fixed shapes: one compile serves every batch incl. the tail
         assert len({(b[0].nnz, b[0].shape) for b in batches}) == 1
         for Xb, _, _ in batches:  # sorted-claim preconditions
             assert np.all(np.diff(np.asarray(Xb.row_ids)) >= 0)
-            if with_csc:
+            if with_csc is True:
                 assert np.all(np.diff(np.asarray(Xb.csc_col_ids)) >= 0)
         sm, sl = streaming.make_streaming_smooth(g, ds)
         f, gr = sm(w)
@@ -242,6 +248,31 @@ class TestStreaming:
         np.testing.assert_allclose(np.asarray(gr), np.asarray(g_ref),
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(float(sl(w)), float(f_ref), rtol=1e-6)
+
+    def test_lazy_csc_materialized_at_single_device_placement(self, rng):
+        """r2 ADVICE: with_csc='lazy' (now the default) on SINGLE-device
+        streaming must materialize the column-sorted twin at placement —
+        not silently fall back to the scatter-add gradient path."""
+        n, d = 200, 31
+        npr = 4
+        indptr = np.arange(n + 1) * npr
+        indices = rng.integers(0, d, n * npr).astype(np.int32)
+        values = rng.normal(size=n * npr).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        ds = streaming.StreamingDataset.from_csr(
+            indptr, indices, values, d, y, batch_rows=64)  # default lazy
+        seen = []
+        g = losses.LogisticGradient()
+
+        class Spy(losses.LogisticGradient):
+            def batch_loss_and_grad(self, wv, Xv, yv, mask=None):
+                seen.append(bool(Xv.has_csc))
+                return super().batch_loss_and_grad(wv, Xv, yv, mask)
+
+        sm, _ = streaming.make_streaming_smooth(Spy(), ds)
+        sm(jnp.zeros(d, jnp.float32))
+        assert seen and all(seen), (
+            "lazy CSC twin was not materialized before the kernel")
 
     def test_streamed_csr_host_agd(self, rng):
         """Full host-driver AGD over streamed CSR equals the fused
