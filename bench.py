@@ -554,19 +554,16 @@ def _run_worker(tag):
     env = dict(os.environ, BENCH_STAGE="worker")
     # Seed the deepest marker before the spawn: the axon plugin registers
     # at interpreter startup, which can hang before any bench.py code
-    # runs — only the parent can record that mode.  Never clobber a probe
-    # file that already recorded a successful claim.
+    # runs — only the parent can record that mode.  The Probe-based seed
+    # MERGES: a prior attempt's hang point / successful claim survives
+    # under prior_inflight / prior_success.
     try:
-        with open(_PROBE_PATH) as f:
-            seeded = "claim_s" in f.read()
-    except OSError:
-        seeded = False
-    if not seeded:
-        with open(_PROBE_PATH, "w") as f:
-            f.write(json.dumps({"inflight": "interpreter-start",
-                                "inflight_since_unix":
-                                    round(time.time(), 1),
-                                "attempt": tag}) + "\n")
+        from probe_file import seed_interpreter_start
+
+        seed_interpreter_start(_PROBE_PATH, attempt=tag)
+    except Exception as e:  # noqa: BLE001 — the seed is evidence, not
+        # a gate; a read-only cwd must not kill the bench
+        log(f"probe seed failed (non-gating): {type(e).__name__}: {e}")
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,

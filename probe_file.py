@@ -47,12 +47,19 @@ class Probe:
         if old:
             if "inflight" in old:
                 self.rec["prior_inflight"] = old["inflight"]
+            elif "prior_inflight" in old:
+                # no fresh death point this time — keep the last known
+                self.rec["prior_inflight"] = old["prior_inflight"]
             if "claim_s" in old:
                 # a prior cycle DID claim the chip: that is round
                 # evidence, not state to overwrite
                 self.rec["prior_success"] = {
                     k: v for k, v in old.items()
                     if k not in ("prior_success", "prior_inflight")}
+            elif "prior_success" in old:
+                # carry an even earlier success forward — two failed
+                # attempts in a row must not erase the one that worked
+                self.rec["prior_success"] = old["prior_success"]
 
     def _flush(self):
         tmp = self.path + ".tmp"
@@ -78,3 +85,13 @@ class Probe:
             self.rec.pop("inflight_budget_s", None)
         self.rec.update(kv)
         self._flush()
+
+
+def seed_interpreter_start(path, **kv):
+    """Launcher-side seed: mark ``interpreter-start`` inflight BEFORE
+    spawning a child whose interpreter startup itself can hang (the
+    axon plugin registers in sitecustomize).  Merges through ``Probe``,
+    so a prior attempt's hang point / successful claim survives under
+    ``prior_inflight`` / ``prior_success`` instead of being overwritten
+    (r3 review finding)."""
+    Probe(path).inflight("interpreter-start", **kv)

@@ -100,7 +100,8 @@ class TestBenchFallbackChain:
                                                       monkeypatch,
                                                       tmp_path):
         """A probe file recording a successful claim must survive later
-        worker launches (it is the round's evidence)."""
+        worker launches (it is the round's evidence) — preserved under
+        prior_success by the merge-seed."""
         monkeypatch.chdir(tmp_path)
         with open("BENCH_PROBE.json", "w") as f:
             f.write(json.dumps({"claim_s": 3.0, "platform": "tpu"}) + "\n")
@@ -112,7 +113,53 @@ class TestBenchFallbackChain:
         monkeypatch.setattr(bench.subprocess, "run",
                             lambda *a, **k: FakeProc())
         assert bench._run_worker("t") is None
-        assert json.loads(open("BENCH_PROBE.json").read())["claim_s"] == 3.0
+        rec = json.loads(open("BENCH_PROBE.json").read())
+        assert rec["prior_success"]["claim_s"] == 3.0
+        assert rec["inflight"] == "interpreter-start"
+
+    def test_worker_seed_preserves_prior_hang_point(self, bench,
+                                                    monkeypatch,
+                                                    tmp_path):
+        """r3 review: a prior attempt's mid-step death marker must
+        survive the retry's seed as prior_inflight, not be overwritten
+        to interpreter-start."""
+        monkeypatch.chdir(tmp_path)
+        with open("BENCH_PROBE.json", "w") as f:
+            f.write(json.dumps({"inflight": "tiny-compile",
+                                "inflight_since_unix": 1.0}) + "\n")
+
+        class FakeProc:
+            returncode = 1
+            stdout = b""
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        assert bench._run_worker("t") is None
+        rec = json.loads(open("BENCH_PROBE.json").read())
+        assert rec["prior_inflight"] == "tiny-compile"
+        assert rec["inflight"] == "interpreter-start"
+
+    def test_seed_chain_keeps_oldest_success_and_latest_hang(self,
+                                                             tmp_path,
+                                                             monkeypatch):
+        """Two failed attempts after one success: the success survives
+        two merges and the most recent hang point wins."""
+        import probe_file
+
+        monkeypatch.chdir(tmp_path)
+        p = probe_file.Probe("P.json")
+        p.inflight("claim")
+        p.done("claim", claim_s=2.0)
+        probe_file.seed_interpreter_start("P.json", attempt="first")
+        rec = json.loads(open("P.json").read())
+        assert rec["prior_success"]["claim_s"] == 2.0
+        # the first retry dies at claim; second seed must keep both
+        probe_file.Probe("P.json").inflight("claim", 10)
+        probe_file.seed_interpreter_start("P.json", attempt="retry")
+        rec = json.loads(open("P.json").read())
+        assert rec["prior_success"]["claim_s"] == 2.0
+        assert rec["prior_inflight"] == "claim"
+        assert rec["inflight"] == "interpreter-start"
 
     def test_replay_of_same_session_tpu_record(self, bench, monkeypatch,
                                                tmp_path, capsys):

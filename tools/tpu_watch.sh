@@ -23,8 +23,15 @@ n=0
 while true; do
   n=$((n+1))
   echo "=== cycle $n start $(date -u +%H:%M:%S) ===" >> "$LOG"
-  if ! grep -q '"claim_s"' "TPU_PROBE_${TAG}.json" 2>/dev/null; then
-    printf '{"inflight": "interpreter-start", "inflight_since_unix": %s}\n' "$(date +%s)" > "TPU_PROBE_${TAG}.json"
+  # Merge-seed the deepest marker via probe_file (preserves a prior
+  # cycle's hang point / successful claim).  env -u strips the tunnel
+  # trigger so THIS python cannot hang in sitecustomize; belt-and-braces
+  # timeout, then a plain create only if the file doesn't exist at all.
+  if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu timeout 30 \
+      python -c "from probe_file import seed_interpreter_start as s; s('TPU_PROBE_${TAG}.json')" 2>>"$LOG"; then
+    if [ ! -f "TPU_PROBE_${TAG}.json" ]; then
+      printf '{"inflight": "interpreter-start", "inflight_since_unix": %s}\n' "$(date +%s)" > "TPU_PROBE_${TAG}.json"
+    fi
   fi
   timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag "$TAG" >> "$LOG" 2>&1
   rc=$?
