@@ -54,6 +54,14 @@ def main():
     p.add_argument("--iterations", type=int, default=10)
     p.add_argument("--batch-rows", type=int, default=8_192)
     p.add_argument("--workdir", default=None)
+    p.add_argument("--emit-json", default=None,
+                   help="append a one-line JSON rehearsal record here "
+                        "(the committed evidence artifact)")
+    p.add_argument("--time-parse-pass", action="store_true",
+                   help="time one parse-only pass over the stream "
+                        "before optimizing (isolates host parse cost "
+                        "from the overlapped parse+place+compute of a "
+                        "smooth evaluation)")
     args = p.parse_args()
 
     import jax
@@ -74,30 +82,62 @@ def main():
               ).astype(np.float32)
 
     # -- 1. the ingest layer writes partition files ---------------------
+    # Vectorized LIBSVM formatting (np.char at C speed; the python-level
+    # per-row loop caps out around 10^5 rows/min, useless at rehearsal
+    # scale).  Existing part files are kept — a killed-and-rerun
+    # rehearsal must not re-pay the write, and the generator is
+    # deterministic per part.  A manifest pins the generation params: a
+    # reused workdir with DIFFERENT args must refuse, not silently
+    # train on stale data while the evidence record claims the new args.
+    import json
+
+    params = {"rows_per_part": args.rows_per_part, "parts": args.parts,
+              "features": d, "nnz_per_row": args.nnz_per_row}
+    manifest = os.path.join(work, "params.json")
+    if os.path.exists(manifest):
+        with open(manifest) as f:
+            prev = json.load(f)
+        if prev != params:
+            raise SystemExit(
+                f"workdir {work} was generated with {prev}, requested "
+                f"{params}; use a fresh --workdir (or delete this one)")
+    else:
+        with open(manifest, "w") as f:
+            json.dump(params, f)
     paths = []
     t0 = time.perf_counter()
+    written = 0
+    idx_width = len(str(d))  # widest 1-based index in full
     for part in range(args.parts):
+        path = os.path.join(work, f"part-{part:05d}")
+        paths.append(path)
+        if os.path.exists(path):
+            continue
+        prng = np.random.default_rng(1000 + part)
         n = args.rows_per_part
-        cols = rng.integers(0, d, n * args.nnz_per_row).astype(np.int32)
-        vals = rng.standard_normal(n * args.nnz_per_row).astype(
+        cols = prng.integers(0, d, n * args.nnz_per_row).astype(np.int64)
+        vals = prng.standard_normal(n * args.nnz_per_row).astype(
             np.float32)
         rows = np.repeat(np.arange(n), args.nnz_per_row)
         margins = np.zeros(n, np.float32)
         np.add.at(margins, rows, vals * w_true[cols])
-        y = np.where(rng.random(n) < 1 / (1 + np.exp(-margins)),
+        y = np.where(prng.random(n) < 1 / (1 + np.exp(-margins)),
                      1.0, -1.0)
-        path = os.path.join(work, f"part-{part:05d}")
-        # write LIBSVM lines directly (save_libsvm takes dense; at demo
-        # scale the row loop is fine and bounds memory)
-        with open(path, "w") as f:
-            for i in range(n):
-                s, e = i * args.nnz_per_row, (i + 1) * args.nnz_per_row
-                toks = " ".join(f"{c + 1}:{v:.6g}"
-                                for c, v in zip(cols[s:e], vals[s:e]))
-                f.write(f"{y[i]:g} {toks}\n")
-        paths.append(path)
-    print(f"[1] wrote {args.parts} parts x {args.rows_per_part} rows "
-          f"({time.perf_counter() - t0:.1f}s)")
+        toks = np.char.add(" ", np.char.add(
+            np.char.add((cols + 1).astype(f"U{idx_width}"), ":"),
+            np.char.mod("%.6g", vals))).reshape(n, args.nnz_per_row)
+        labels = np.char.add("\n", np.char.mod("%g", y))[:, None]
+        cells = np.concatenate([labels, toks], axis=1)
+        text = "".join(cells.ravel().tolist())  # one pass, no re-copying
+        with open(path + ".tmp", "w") as f:
+            f.write(text[1:] + "\n")  # drop the leading newline
+        os.replace(path + ".tmp", path)
+        written += 1
+    write_s = time.perf_counter() - t0
+    bytes_on_disk = sum(os.path.getsize(p) for p in paths)
+    print(f"[1] {written} parts written ({args.parts} total) x "
+          f"{args.rows_per_part} rows, {bytes_on_disk / 2**30:.2f} GiB "
+          f"on disk ({write_s:.1f}s)")
 
     # -- 2. stream the parts as fixed-shape macro-batches ---------------
     ds = StreamingDataset.from_libsvm_parts(
@@ -105,6 +145,18 @@ def main():
     sm, sl = make_streaming_smooth(LogisticGradient(), ds)
     print(f"[2] streaming smooth over {args.parts} parts, "
           f"batch_rows={args.batch_rows}")
+    parse_pass_s = first_eval_s = None
+    if args.time_parse_pass:
+        t0 = time.perf_counter()
+        n_batches = sum(1 for _ in ds)  # parse + pad only, no device
+        parse_pass_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.block_until_ready(sm(jnp.zeros(d, jnp.float32))[0])
+        first_eval_s = time.perf_counter() - t0
+        print(f"[2b] parse-only pass {parse_pass_s:.1f}s over "
+              f"{n_batches} batches; first full smooth evaluation "
+              f"(parse+place+compute+compile, overlapped) "
+              f"{first_eval_s:.1f}s")
 
     # -- 3+4. checkpointed full-batch AGD over the stream ---------------
     px, rv = smooth_lib.make_prox(L2Prox(), 1e-4)
@@ -118,14 +170,43 @@ def main():
         driver="host")  # streamed smooths run the host driver
     dt = time.perf_counter() - t0
     hist = np.asarray(out.loss_history)
-    print(f"[3] {len(hist)} iterations in {dt:.1f}s "
-          f"({len(hist) / dt:.2f} iters/s): "
+    ran = len(hist) - out.resumed_from
+    ips = ran / dt if ran else 0.0  # a no-op resume ran NOTHING
+    print(f"[3] {ran} iterations this launch ({len(hist)} total, "
+          f"resumed from {out.resumed_from}) in {dt:.1f}s "
+          f"({ips:.3f} iters/s): "
           f"loss {hist[0]:.6f} -> {hist[-1]:.6f}")
     print(f"[4] checkpoint at {ck_path} — rerunning the same command "
           f"resumes/no-ops (kill/resume parity: tests/test_checkpoint.py)")
     rec = float(np.mean(
         np.sign(w_true) == np.sign(np.asarray(out.weights))))
     print(f"    sign agreement with planted weights: {rec:.1%}")
+    if args.emit_json:
+        record = {
+            "rehearsal": "north_star_streaming",
+            "platform": jax.devices()[0].platform,
+            "rows": args.parts * args.rows_per_part,
+            "features": d,
+            "nnz_per_row": args.nnz_per_row,
+            "bytes_on_disk": bytes_on_disk,
+            "batch_rows": args.batch_rows,
+            "write_s": round(write_s, 1),
+            "parse_pass_s": (None if parse_pass_s is None
+                             else round(parse_pass_s, 1)),
+            "first_eval_s": (None if first_eval_s is None
+                             else round(first_eval_s, 1)),
+            "iterations_total": len(hist),
+            "resumed_from": out.resumed_from,
+            "iters_this_launch": ran,
+            "wall_s_this_launch": round(dt, 1),
+            "iters_per_sec": round(ips, 4) if ran else None,
+            "loss_first": float(hist[0]),
+            "loss_final": float(hist[-1]),
+            "sign_agreement": round(rec, 4),
+        }
+        with open(args.emit_json, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        print(f"[5] rehearsal record appended to {args.emit_json}")
 
 
 if __name__ == "__main__":
