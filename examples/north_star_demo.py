@@ -102,6 +102,13 @@ def main():
                 f"workdir {work} was generated with {prev}, requested "
                 f"{params}; use a fresh --workdir (or delete this one)")
     else:
+        import glob as _glob
+
+        if _glob.glob(os.path.join(work, "part-*")):
+            raise SystemExit(
+                f"workdir {work} contains part files but no "
+                f"params.json — cannot verify they match the requested "
+                f"parameters; use a fresh --workdir (or delete it)")
         with open(manifest, "w") as f:
             json.dump(params, f)
     paths = []
@@ -123,14 +130,28 @@ def main():
         np.add.at(margins, rows, vals * w_true[cols])
         y = np.where(prng.random(n) < 1 / (1 + np.exp(-margins)),
                      1.0, -1.0)
-        toks = np.char.add(" ", np.char.add(
-            np.char.add((cols + 1).astype(f"U{idx_width}"), ":"),
-            np.char.mod("%.6g", vals))).reshape(n, args.nnz_per_row)
-        labels = np.char.add("\n", np.char.mod("%g", y))[:, None]
-        cells = np.concatenate([labels, toks], axis=1)
-        text = "".join(cells.ravel().tolist())  # one pass, no re-copying
+        # Chunked formatting: the UCS4 cell array + the Python-str list
+        # for join cost ~25x the text size in transient memory, so at
+        # rehearsal scale one whole part at once would spike many GB —
+        # bound it to chunk_rows rows per write.
+        chunk_rows = 100_000
         with open(path + ".tmp", "w") as f:
-            f.write(text[1:] + "\n")  # drop the leading newline
+            for s in range(0, n, chunk_rows):
+                e = min(s + chunk_rows, n)
+                lo, hi = s * args.nnz_per_row, e * args.nnz_per_row
+                toks = np.char.add(" ", np.char.add(
+                    np.char.add((cols[lo:hi] + 1).astype(
+                        f"U{idx_width}"), ":"),
+                    np.char.mod("%.6g", vals[lo:hi]))
+                    ).reshape(e - s, args.nnz_per_row)
+                labels = np.char.add(
+                    "\n", np.char.mod("%g", y[s:e]))[:, None]
+                cells = np.concatenate([labels, toks], axis=1)
+                parts_list = cells.ravel().tolist()
+                if s == 0:
+                    parts_list[0] = parts_list[0][1:]  # leading newline
+                f.write("".join(parts_list))
+            f.write("\n")
         os.replace(path + ".tmp", path)
         written += 1
     write_s = time.perf_counter() - t0

@@ -73,4 +73,8 @@ from .ops.prox import (  # noqa: F401
     L1Updater,
 )
 from .ops.sparse import CSRMatrix  # noqa: F401
-from .data.streaming import StreamingDataset  # noqa: F401
+from .data.streaming import (  # noqa: F401
+    StreamingDataset,
+    make_streaming_eval_multi,
+    make_streaming_smooth,
+)

@@ -222,6 +222,66 @@ class StreamingDataset:
         return iter(self._factory())
 
 
+def _make_placer(mesh, pad_to, csr_nnz_per_shard):
+    """The shared macro-batch placement closure: pad to one compiled
+    shape, put on the device or shard over the mesh (dense via
+    ``shard_batch``, CSR via nnz-budgeted ``shard_csr_batch``), and
+    materialize a wanted-but-absent CSC twin on device (r2 ADVICE — a
+    lazy twin must not silently fall back to scatter-add)."""
+    budget = [csr_nnz_per_shard]  # resolved from the first batch
+    warned_eager_twin = []  # warn once per smooth, not per batch
+
+    def _place(X, y, mask):
+        if isinstance(X, CSRMatrix):
+            if mesh is not None:
+                # row-shard this macro-batch like the in-memory sparse
+                # mesh path; the fixed budget keeps one kernel shape
+                if X.has_csc and not warned_eager_twin:
+                    warned_eager_twin.append(True)
+                    import warnings
+
+                    warnings.warn(
+                        "mesh CSR streaming with an EAGER per-batch CSC "
+                        "twin: the sharder rebuilds per-shard twins and "
+                        "discards the global one — build the dataset "
+                        "with with_csc='lazy' to skip the wasted "
+                        "per-batch argsort", stacklevel=2)
+                if budget[0] is None:
+                    n_shards = mesh.shape[mesh_lib.DATA_AXIS]
+                    budget[0] = max(128, -(-int(X.nnz * 1.25 / n_shards)
+                                           // 128) * 128)
+                b = mesh_lib.shard_csr_batch(mesh, X, y, mask,
+                                             nnz_per_shard=budget[0])
+                return b.X, b.y, b.mask
+            # iter_csr_batches already padded to fixed shape; move the
+            # leaves onto the device and, when the batch WANTS a CSC
+            # twin it doesn't carry (with_csc="lazy"), materialize it
+            # there — an on-device argsort per batch, overlapped with
+            # compute by fold_stream's double buffering; without this
+            # the gradient would silently take the slow scatter-add
+            # path (r2 ADVICE)
+            Xd = jax.tree_util.tree_map(jnp.asarray, X)
+            if Xd.want_csc and not Xd.has_csc:
+                Xd = Xd.with_csc()
+            return Xd, jnp.asarray(y), jnp.asarray(mask)
+        X = np.asarray(X)
+        y = np.asarray(y)
+        n = X.shape[0]
+        if pad_to is not None and n < pad_to:
+            base = np.ones(n, np.float32) if mask is None else \
+                np.asarray(mask, np.float32)
+            X = np.concatenate(
+                [X, np.zeros((pad_to - n,) + X.shape[1:], X.dtype)])
+            y = np.concatenate([y, np.zeros(pad_to - n, y.dtype)])
+            mask = np.concatenate([base, np.zeros(pad_to - n, np.float32)])
+        if mesh is not None:
+            return mesh_lib.shard_batch(mesh, X, y, mask)
+        m = None if mask is None else jnp.asarray(mask)
+        return jnp.asarray(X), jnp.asarray(y), m
+
+    return _place
+
+
 def make_streaming_smooth(
     gradient: Gradient,
     dataset: StreamingDataset,
@@ -276,56 +336,7 @@ def make_streaming_smooth(
             with_grad=with_grad)
         return ev(w, *dist_smooth.csr_shard_args(X, y, mask))
 
-    budget = [csr_nnz_per_shard]  # resolved from the first batch
-    warned_eager_twin = []  # warn once per smooth, not per batch
-
-    def _place(X, y, mask):
-        if isinstance(X, CSRMatrix):
-            if mesh is not None:
-                # row-shard this macro-batch like the in-memory sparse
-                # mesh path; the fixed budget keeps one kernel shape
-                if X.has_csc and not warned_eager_twin:
-                    warned_eager_twin.append(True)
-                    import warnings
-
-                    warnings.warn(
-                        "mesh CSR streaming with an EAGER per-batch CSC "
-                        "twin: the sharder rebuilds per-shard twins and "
-                        "discards the global one — build the dataset "
-                        "with with_csc='lazy' to skip the wasted "
-                        "per-batch argsort", stacklevel=2)
-                if budget[0] is None:
-                    n_shards = mesh.shape[mesh_lib.DATA_AXIS]
-                    budget[0] = max(128, -(-int(X.nnz * 1.25 / n_shards)
-                                           // 128) * 128)
-                b = mesh_lib.shard_csr_batch(mesh, X, y, mask,
-                                             nnz_per_shard=budget[0])
-                return b.X, b.y, b.mask
-            # iter_csr_batches already padded to fixed shape; move the
-            # leaves onto the device and, when the batch WANTS a CSC
-            # twin it doesn't carry (with_csc="lazy"), materialize it
-            # there — an on-device argsort per batch, overlapped with
-            # compute by fold_stream's double buffering; without this
-            # the gradient would silently take the slow scatter-add
-            # path (r2 ADVICE)
-            Xd = jax.tree_util.tree_map(jnp.asarray, X)
-            if Xd.want_csc and not Xd.has_csc:
-                Xd = Xd.with_csc()
-            return Xd, jnp.asarray(y), jnp.asarray(mask)
-        X = np.asarray(X)
-        y = np.asarray(y)
-        n = X.shape[0]
-        if pad_to is not None and n < pad_to:
-            base = np.ones(n, np.float32) if mask is None else \
-                np.asarray(mask, np.float32)
-            X = np.concatenate(
-                [X, np.zeros((pad_to - n,) + X.shape[1:], X.dtype)])
-            y = np.concatenate([y, np.zeros(pad_to - n, y.dtype)])
-            mask = np.concatenate([base, np.zeros(pad_to - n, np.float32)])
-        if mesh is not None:
-            return mesh_lib.shard_batch(mesh, X, y, mask)
-        m = None if mask is None else jnp.asarray(mask)
-        return jnp.asarray(X), jnp.asarray(y), m
+    _place = _make_placer(mesh, pad_to, csr_nnz_per_shard)
 
     def smooth(w):
         (ls, gs), n = fold_stream(
@@ -341,6 +352,75 @@ def make_streaming_smooth(
         return ls / jnp.asarray(n, ls.dtype)
 
     return smooth, smooth_loss
+
+
+def make_streaming_eval_multi(
+    gradient: Gradient,
+    dataset: StreamingDataset,
+    *,
+    mesh=None,
+    pad_to: Optional[int] = None,
+    csr_nnz_per_shard: Optional[int] = None,
+    with_grad: bool = True,
+):
+    """Evaluate K weight vectors over ONE pass of the stream.
+
+    ``eval_multi(W_stacked) -> (mean_losses, mean_grads)`` where
+    ``W_stacked`` has a leading lane axis (``(K, D)`` array or a pytree
+    of stacked leaves, e.g. a sweep result's ``res.weights``);
+    ``mean_losses`` is ``(K,)`` and ``mean_grads`` keeps the lane axis.
+    ``with_grad=False`` returns ``(K,)`` losses only — the gradient
+    work (the size-D rmatvec per lane) vanishes from the compiled
+    kernel, the right mode for validation scoring.
+
+    This is the streaming member of the grid-fit family: the mesh sweep
+    (``parallel.grid``) trains K lanes on in-HBM shards; this scores K
+    candidates (a regularization path, CV refits) on data LARGER than
+    HBM, reading the stream ONCE for all lanes instead of K times —
+    per macro-batch the K margin products fuse into one
+    ``(rows, D) @ (D, K)`` contraction, the same MXU batching the
+    in-memory sweep gets.  Composes with ``mesh`` exactly like
+    ``make_streaming_smooth`` (dense GSPMD / CSR shard_map+psum).
+    """
+    _place = _make_placer(mesh, pad_to, csr_nnz_per_shard)
+
+    @jax.jit
+    def batch_sums(W, X, y, mask):
+        if isinstance(X, RowShardedCSR):
+            ev = dist_smooth.csr_shard_sums(
+                gradient, X, y, mask, mesh, mesh_lib.DATA_AXIS,
+                with_grad=True, n_lanes=True)
+            return ev(W, *dist_smooth.csr_shard_args(X, y, mask))
+        ls, gs, n = jax.vmap(
+            lambda wv: gradient.batch_loss_and_grad(wv, X, y, mask))(W)
+        return ls, gs, n[0]  # count is mask-only: identical per lane
+
+    @jax.jit
+    def batch_loss_sums(W, X, y, mask):
+        if isinstance(X, RowShardedCSR):
+            ev = dist_smooth.csr_shard_sums(
+                gradient, X, y, mask, mesh, mesh_lib.DATA_AXIS,
+                with_grad=False, n_lanes=True)
+            return ev(W, *dist_smooth.csr_shard_args(X, y, mask))
+        ls, _, n = jax.vmap(
+            lambda wv: gradient.batch_loss_and_grad(wv, X, y, mask))(W)
+        return ls, n[0]
+
+    def eval_multi(W):
+        W = jax.tree_util.tree_map(jnp.asarray, W)
+        if with_grad:
+            (ls, gs), n = fold_stream(
+                batch_sums,
+                lambda a, b: [a[0] + b[0], tvec.add(a[1], b[1])],
+                _place, dataset, W)
+            nf = jnp.asarray(n, ls.dtype)
+            return ls / nf, tvec.scale(1.0 / nf, gs)
+        (ls,), n = fold_stream(
+            batch_loss_sums, lambda a, b: [a[0] + b[0]], _place,
+            dataset, W)
+        return ls / jnp.asarray(n, ls.dtype)
+
+    return eval_multi
 
 
 def fold_stream(kernel, combine, place, dataset, w):

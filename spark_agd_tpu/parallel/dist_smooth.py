@@ -242,7 +242,7 @@ def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
 
 
 def csr_shard_sums(gradient, X, y, mask, mesh, data_axis,
-                   with_grad: bool = True):
+                   with_grad: bool = True, n_lanes: bool = False):
     """One distributed (Σloss, Σgrad, n) pass over a ``RowShardedCSR``.
 
     The seqOp/combOp core shared by the in-memory mesh path
@@ -252,9 +252,12 @@ def csr_shard_sums(gradient, X, y, mask, mesh, data_axis,
     batched kernel as the single-device sparse path, and one psum
     combines the sums.  ``with_grad=False`` psums only (loss, n) — the
     unused per-shard gradient (the size-D rmatvec) is dead code inside
-    the enclosing jit and vanishes.  May be called inside a jit trace
-    (the streaming kernels do); the shard_map wrapper is created at
-    trace time, once per shape.
+    the enclosing jit and vanishes.  ``n_lanes=True`` takes a STACKED
+    weight leading axis (K lanes, replicated) and vmaps the kernel over
+    it inside the body — the local CSR reconstruction and the psum are
+    shared across lanes; the count (mask-only, lane-invariant) psums
+    once.  May be called inside a jit trace (the streaming kernels do);
+    the shard_map wrapper is created at trace time, once per shape.
     """
     if mask is None:
         raise ValueError(
@@ -267,7 +270,13 @@ def csr_shard_sums(gradient, X, y, mask, mesh, data_axis,
 
     def _body(w, rid, cid, val, ys, ms, *csc):
         Xl = X.local_csr(rid, cid, val, *csc)
-        ls, gs, n = gradient.batch_loss_and_grad(w, Xl, ys, ms)
+        if n_lanes:
+            ls, gs, n = jax.vmap(
+                lambda wv: gradient.batch_loss_and_grad(wv, Xl, ys, ms)
+            )(w)
+            n = n[0]  # count depends only on the mask: identical lanes
+        else:
+            ls, gs, n = gradient.batch_loss_and_grad(w, Xl, ys, ms)
         ls = lax.psum(ls, data_axis)
         n = lax.psum(n, data_axis)
         if not with_grad:

@@ -64,6 +64,15 @@ def main(argv=None):
         print(json.dumps({"check": "backend", "ok": False,
                           "error": f"not a TPU: {dev.platform}"}))
         return 1
+    # header record: every artifact self-describes its backend, so a
+    # CPU-rehearsal file can never be mistaken for TPU evidence (and the
+    # timing rows' meaning — interpret-mode Pallas on CPU — is explicit)
+    print(json.dumps({
+        "check": "env", "ok": True, "platform": dev.platform,
+        "device_kind": dev.device_kind, "small": bool(args.small),
+        "pallas_mode": ("compiled" if dev.platform == "tpu"
+                        else "interpret"),
+        "measured_at_unix": round(time.time(), 1)}), flush=True)
 
     n, d = args.rows, args.wide_d
     br = choose_block_rows(((d + 127) // 128) * 128, 4)
