@@ -69,6 +69,36 @@ def _batch_mesh(batch: "mesh_lib.ShardedBatch"):
     return batch.X.sharding.mesh
 
 
+def _resolve_fit_mesh(data: Data, mesh):
+    """The ONE mesh-dispatch decision for every mesh-capable entry point
+    (sweep, cross-validate, the GD oracle) — hand-rolled per-site
+    variants drifted into real bugs (r3 review).
+
+    Returns ``(m, batch, csr_raw)``:
+
+    - ``batch`` is the ``ShardedBatch`` when ``data`` is pre-placed
+      (``m`` is then its mesh; an explicit ``mesh`` argument must match
+      or this raises), else ``None``;
+    - ``m`` is the resolved mesh — ``None`` means single-device
+      (``mesh=False``, or ``mesh=None`` on a single-device host);
+    - ``csr_raw``: ``data`` is a raw ``(CSRMatrix, ...)`` tuple.
+      Callers that cannot mesh CSR apply their policy: RAISE when the
+      mesh was requested explicitly (a silently-undistributed run is
+      worse than an error), fall back to single-device under the auto
+      default (``mesh=None``).
+    """
+    if isinstance(data, mesh_lib.ShardedBatch):
+        m = _batch_mesh(data)
+        if mesh not in (None, False) and mesh != m:
+            raise ValueError(
+                "explicit mesh differs from the ShardedBatch's mesh; "
+                "re-shard the batch or drop the mesh argument")
+        return m, data, False
+    csr_raw = (isinstance(data, (tuple, list))
+               and isinstance(data[0], CSRMatrix))
+    return _resolve_mesh(mesh), None, csr_raw
+
+
 def _build_smooth(gradient, data, mesh, dist_mode):
     if mesh is None:
         if isinstance(data, mesh_lib.ShardedBatch):
@@ -256,22 +286,12 @@ def make_sweep_runner(
         l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
         may_restart=may_restart, loss_mode=loss_mode)
 
-    if isinstance(data, mesh_lib.ShardedBatch):
-        batch_mesh = _batch_mesh(data)
-        if mesh in (None, False):
-            mesh = batch_mesh
-        elif mesh != batch_mesh:
-            raise ValueError(
-                "explicit mesh differs from the ShardedBatch's mesh; "
-                "re-shard the batch or drop the mesh argument")
-    else:
-        mesh = _resolve_mesh(mesh)
-
+    mesh, batch, _ = _resolve_fit_mesh(data, mesh)  # CSR meshes fine
     if mesh is not None:
         from .parallel import grid
 
-        batch = (data if isinstance(data, mesh_lib.ShardedBatch)
-                 else mesh_lib.shard_batch(mesh, *_normalize_data(data)))
+        if batch is None:
+            batch = mesh_lib.shard_batch(mesh, *_normalize_data(data))
         mesh_fit = grid.make_mesh_sweep_fit(gradient, updater, batch,
                                             mesh, cfg)
 
@@ -488,24 +508,17 @@ def cross_validate(
                         best_index=jnp.argmin(mean_val),
                         fold_ids=fold_ids, base_mask=base_mask)
 
-    is_batch = isinstance(data, mesh_lib.ShardedBatch)
-    # Sparse CSR input with the AUTO mesh default falls back to the
-    # single-device lane grid (which handles CSR fine) instead of
+    m, batch, csr_raw = _resolve_fit_mesh(data, mesh)
+    # Sparse CSR input with the AUTO mesh default (mesh=None) falls back
+    # to the single-device lane grid (which handles CSR fine) instead of
     # hitting the mesh path's NotImplementedError — only an EXPLICIT
     # mesh/ShardedBatch request surfaces that limitation.
-    auto_mesh_ok = not (isinstance(data, (tuple, list))
-                        and isinstance(data[0], CSRMatrix))
-    if is_batch or mesh not in (None, False) or (
-            mesh is None and auto_mesh_ok and len(jax.devices()) > 1):
+    if csr_raw and mesh is None:
+        m = None
+    if m is not None:
         from .parallel import grid
 
-        if is_batch:
-            batch = data
-            m = _batch_mesh(batch)
-            if mesh not in (None, False) and mesh != m:
-                raise ValueError(
-                    "explicit mesh differs from the ShardedBatch's "
-                    "mesh; re-shard the batch or drop the mesh argument")
+        if batch is not None:
             n = batch.y.shape[0]  # padded layout; mask covers padding
             fold_ids = _fold_assignment(n)
             base_mask = (batch.mask if batch.mask is not None
@@ -513,7 +526,6 @@ def cross_validate(
             fids_sharded = grid.shard_row_array(m, np.asarray(fold_ids),
                                                 n, fill=-1)
         else:
-            m = _resolve_mesh(mesh)
             X, y, base_mask = _normalize_data(data)
             n = X.shape[0]
             fold_ids = _fold_assignment(n)
@@ -730,22 +742,84 @@ def run_minibatch_sgd(
     minibatch_fraction: float = 1.0,
     initial_weights: Any = None,
     seed: int = 42,
+    *,
+    mesh=False,
 ):
     """MLlib ``GradientDescent.runMiniBatchSGD`` equivalent — the oracle
-    the reference tests against (SURVEY §2.2); single-device evaluation.
-    Returns ``(weights, loss_history)``."""
+    the reference tests against (SURVEY §2.2).
+    Returns ``(weights, loss_history)``.
+
+    ``mesh=False`` (default) evaluates single-device.  Pass a ``Mesh`` /
+    ``None`` / a dense ``ShardedBatch`` to shard rows over the mesh's
+    ``data`` axis — the reference's GD *is* distributed (MLlib's
+    ``runMiniBatchSGD`` runs the same treeAggregate as AGD), and the
+    Bernoulli sample sequence is bit-identical to a single-device run
+    on the identically-padded arrays (``core.gd.run_minibatch_sgd``'s
+    global-sample contract).  Dense only: the nnz-balanced CSR shard
+    layout permutes rows, which would break the contiguous
+    global-sample slicing.
+    """
     if initial_weights is None:
         raise ValueError("initial_weights is required")
+    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+    kw = dict(step_size=step_size, num_iterations=num_iterations,
+              reg_param=reg_param,
+              minibatch_fraction=minibatch_fraction, seed=seed)
+
+    m, batch, csr_raw = _resolve_fit_mesh(data, mesh)
+    if csr_raw:
+        if mesh not in (None, False):
+            # an explicitly requested mesh must not silently degrade to
+            # an undistributed run (r3 review)
+            raise ValueError(
+                "mesh run_minibatch_sgd supports dense data only (the "
+                "nnz-balanced CSR layout permutes rows, breaking the "
+                "global Bernoulli sample slicing); drop the mesh "
+                "argument for a single-device oracle run")
+        m = None  # auto default: single-device handles CSR fine
+    if m is not None:
+        import functools
+
+        from jax import lax, shard_map
+        from jax.sharding import PartitionSpec as P
+
+        if batch is not None:
+            if isinstance(batch.X, mesh_lib.RowShardedCSR):
+                raise ValueError(
+                    "mesh run_minibatch_sgd supports dense batches "
+                    "only (the nnz-balanced CSR layout permutes rows, "
+                    "breaking the global Bernoulli sample slicing)")
+        else:
+            batch = mesh_lib.shard_batch(m, *_normalize_data(data))
+        X, y, mask = batch
+        axis = mesh_lib.DATA_AXIS
+        n_global = X.shape[0]
+        rows_per_shard = n_global // m.shape[axis]
+        row = P(axis)
+        xspec = P(axis, *([None] * (X.ndim - 1)))
+        has_mask = mask is not None
+        in_specs = (P(), xspec, row) + ((row,) if has_mask else ())
+
+        def _body(w, Xs, ys, *ms):
+            off = lax.axis_index(axis) * rows_per_shard
+            return gd.run_minibatch_sgd(
+                gradient, updater, Xs, ys, w,
+                mask=ms[0] if has_mask else None, data_axis=axis,
+                global_rows=n_global, row_offset=off, **kw)
+
+        step = jax.jit(functools.partial(
+            shard_map, mesh=m, in_specs=in_specs, out_specs=P(),
+            check_vma=False)(_body))
+        args = (X, y, mask) if has_mask else (X, y)
+        res = step(mesh_lib.replicate(w0, m), *args)
+        return res.weights, np.asarray(res.loss_history)
+
     X, y, mask = _normalize_data(data)
     if not isinstance(X, CSRMatrix):
         X = jnp.asarray(X)
     y = jnp.asarray(y)
     mask = None if mask is None else jnp.asarray(mask)
-    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
     res = jax.jit(
         lambda w: gd.run_minibatch_sgd(
-            gradient, updater, X, y, w,
-            step_size=step_size, num_iterations=num_iterations,
-            reg_param=reg_param, minibatch_fraction=minibatch_fraction,
-            mask=mask, seed=seed))(w0)
+            gradient, updater, X, y, w, mask=mask, **kw))(w0)
     return res.weights, np.asarray(res.loss_history)

@@ -55,9 +55,24 @@ def run_minibatch_sgd(
     minibatch_fraction: float = 1.0,
     mask=None,
     seed: int = 42,
+    data_axis: Optional[str] = None,
+    global_rows: Optional[int] = None,
+    row_offset=None,
 ) -> GDResult:
     """Trace-compatible MLlib-1.3 ``runMiniBatchSGD``.  ``mask`` is the
-    data-layer padding mask; sampling masks compose with it."""
+    data-layer padding mask; sampling masks compose with it.
+
+    Mesh composition (the reference's GD *is* distributed — MLlib's
+    ``runMiniBatchSGD`` runs the same treeAggregate as AGD): call inside
+    a ``shard_map`` body with LOCAL ``(X, y, mask)`` shards and
+    ``data_axis`` set — the (Σloss, Σgrad, n) sums psum over the axis
+    before every division.  Sampling stays globally consistent: each
+    shard draws the SAME full-length Bernoulli vector (``global_rows``)
+    and slices its contiguous block at ``row_offset``, so an N-way mesh
+    run takes bit-identical sample sequences to a single-device run on
+    the identically-padded arrays.  ``api.run_minibatch_sgd(mesh=...)``
+    wraps this.
+    """
     full_batch = minibatch_fraction >= 1.0
     base_key = jax.random.PRNGKey(seed)
     w0 = initial_weights
@@ -70,6 +85,7 @@ def run_minibatch_sgd(
         updater.prox(w0, tvec.zeros_like(w0), 0.0, reg_param)[1], dt)
 
     n_rows = X.shape[0]
+    g_rows = n_rows if global_rows is None else int(global_rows)
 
     def body(i, carry):
         w, reg_val, hist = carry
@@ -80,12 +96,23 @@ def run_minibatch_sgd(
         else:
             key = jax.random.fold_in(base_key, it)
             sample = jax.random.bernoulli(
-                key, minibatch_fraction, (n_rows,)).astype(dt)
+                key, minibatch_fraction, (g_rows,)).astype(dt)
+            if global_rows is not None:
+                sample = lax.dynamic_slice(sample, (row_offset,),
+                                           (n_rows,))
             it_mask = sample if mask is None else sample * jnp.asarray(
                 mask, dt)
 
         loss_sum, grad_sum, n = gradient.batch_loss_and_grad(
             w, X, y, it_mask)
+        if data_axis is not None:
+            # the whole treeAggregate comb tree, one ICI all-reduce —
+            # identical on every device, so the driver math below stays
+            # coherent across the mesh
+            loss_sum = lax.psum(loss_sum, data_axis)
+            grad_sum = tvec.tmap(lambda g: lax.psum(g, data_axis),
+                                 grad_sum)
+            n = lax.psum(n, data_axis)
         nf = jnp.asarray(n, dt)
         nonempty = nf > 0
 
