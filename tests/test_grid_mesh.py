@@ -327,3 +327,60 @@ class TestMeshGD:
             mesh=None, num_iterations=3,
             initial_weights=np.zeros(d, np.float32))
         assert np.all(np.isfinite(hist))
+
+
+class TestMeshFuzz:
+    """Randomized knob-space parity: single-device vs 8-way mesh on the
+    SAME problem must agree to reduction-order noise across losses,
+    proxes, backtracking/restart/L-cap regimes — the sharded twin of
+    tests/test_agd_core.py::TestOracleFuzz, guarding interactions the
+    enumerated mesh tests don't cover."""
+
+    @pytest.mark.parametrize("case", range(12))
+    def test_random_config_parity(self, case, mesh8):
+        r = np.random.default_rng(7000 + case)
+        n, d = int(r.integers(150, 500)), int(r.integers(4, 20))
+        # float64: in f32, reduction reassociation can flip a knife-edge
+        # backtracking accept (localL <= L) and legitimately fork the
+        # discrete path — at f64 the noise is ~1e-16 and STRICT
+        # path equality is the meaningful invariant to fuzz
+        X = r.standard_normal((n, d))
+        yb = (r.random(n) < 0.5).astype(np.float64)
+        # staggered divisors decorrelate the knob axes (the
+        # TestOracleFuzz pattern): every loss sees multiple beta /
+        # l_exact / restart regimes across the 12 cases, instead of
+        # e.g. hinge being locked to backtracking-disabled beta=1.0
+        grad = [losses.LogisticGradient(),
+                losses.LeastSquaresGradient(),
+                losses.HingeGradient()][case % 3]
+        p, reg = [
+            (prox.SquaredL2Updater(), float(r.uniform(0.01, 0.5))),
+            (prox.L1Updater(), float(r.uniform(0.005, 0.1))),
+            (prox.SimpleUpdater(), 0.0),
+            (prox.ElasticNetProx(float(r.uniform(0.1, 0.9))),
+             float(r.uniform(0.01, 0.3))),
+        ][(case // 3) % 4]
+        w0 = r.normal(size=d) * 0.1
+        kw = dict(
+            num_iterations=int(r.integers(3, 10)),
+            convergence_tol=0.0,
+            reg_param=reg,
+            l0=float(10.0 ** r.uniform(-2, 1)),
+            l_exact=float([np.inf, 50.0][(case // 2) % 2]),
+            beta=float([0.5, 0.8, 1.0][(case // 4) % 3]),
+            alpha=float(r.uniform(0.7, 1.0)),
+            may_restart=bool((case // 6) % 2),
+            initial_weights=w0,
+        )
+        w_m, h_m, res_m = api.run((X, yb), grad, p, mesh=mesh8,
+                                  return_result=True, **kw)
+        w_1, h_1, res_1 = api.run((X, yb), grad, p, mesh=False,
+                                  return_result=True, **kw)
+        assert int(res_m.num_iters) == int(res_1.num_iters), kw
+        assert int(res_m.num_backtracks) == int(res_1.num_backtracks), kw
+        assert int(res_m.num_restarts) == int(res_1.num_restarts), kw
+        np.testing.assert_allclose(h_m, h_1, rtol=1e-9, atol=1e-12,
+                                   err_msg=str(kw))
+        np.testing.assert_allclose(np.asarray(w_m), np.asarray(w_1),
+                                   rtol=1e-7, atol=1e-10,
+                                   err_msg=str(kw))
