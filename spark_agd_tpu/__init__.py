@@ -50,6 +50,7 @@ from .api import (  # noqa: F401
     CVResult,
     cross_validate,
     make_sweep_runner,
+    streaming_sweep,
     sweep,
     sweep_warm_state,
 )

@@ -732,6 +732,67 @@ class AcceleratedGradientDescent:
             mesh=self._mesh, loss_mode=self._loss_mode, seed=seed)
 
 
+def streaming_sweep(
+    dataset,
+    gradient: Gradient,
+    updater: Prox,
+    reg_params,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    initial_weights: Any = None,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    mesh=None,
+    pad_to=None,
+    csr_nnz_per_shard=None,
+    loss_mode: str = "x",
+):
+    """Train a K-strength regularization path over a STREAMED dataset —
+    one stream read per trial for ALL lanes.
+
+    The in-memory :func:`sweep` requires the data in HBM; this is its
+    larger-than-HBM member: the K lanes run the host AGD driver in
+    lock-step (``core.host_agd.run_agd_host_multi``, per-lane semantics
+    pinned exactly against solo runs) over a multi-lane streamed smooth
+    (``data.streaming.make_streaming_eval_multi`` — per macro-batch the
+    K margin products fuse into one ``(rows, D) @ (D, K)``
+    contraction).  A solo sweep over a stream costs K full stream reads
+    per evaluation; this costs ONE.
+
+    ``dataset`` is a ``data.streaming.StreamingDataset``; ``mesh``
+    follows the streaming modules' convention (``None`` = single
+    device, pass a ``Mesh`` to shard each macro-batch).  Returns a
+    ``core.host_agd.HostAGDMultiResult`` (leading K axis per field;
+    ``loss_history[:, k][:num_iters[k]]`` is lane k's history).
+    """
+    if initial_weights is None:
+        raise ValueError("initial_weights is required")
+    from .core import host_agd
+    from .data import streaming as streaming_lib
+
+    regs = list(reg_params)
+    sm = streaming_lib.make_streaming_eval_multi(
+        gradient, dataset, mesh=mesh, pad_to=pad_to,
+        csr_nnz_per_shard=csr_nnz_per_shard)
+    sl = streaming_lib.make_streaming_eval_multi(
+        gradient, dataset, mesh=mesh, pad_to=pad_to,
+        csr_nnz_per_shard=csr_nnz_per_shard, with_grad=False)
+    pxm, rvm = host_agd.make_prox_multi(updater, regs)
+    W0 = jax.tree_util.tree_map(
+        lambda a: jnp.stack([jnp.asarray(a)] * len(regs)),
+        initial_weights)
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+    return host_agd.run_agd_host_multi(sm, pxm, rvm, W0, cfg,
+                                       smooth_loss_multi=sl)
+
+
 def run_minibatch_sgd(
     data: Data,
     gradient: Gradient,

@@ -188,3 +188,271 @@ def _carry(x, z, theta, big_l, bts, n_iter, loss, aborted=False,
     return dict(x=x, z=z, theta=theta, big_l=big_l, bts=bts,
                 prior_iters=n_iter, loss=loss, aborted=aborted,
                 stopped=stopped or aborted, last=last or aborted)
+
+
+# ---------------------------------------------------------------------------
+# Multi-lane host driver: K independent AGD trajectories in lock-step over
+# ONE shared multi-lane smooth — the streamed regularization path.  A solo
+# host sweep costs K full stream reads per evaluation; this reads the
+# stream once per trial for ALL lanes (data.streaming.
+# make_streaming_eval_multi fuses the K margin products per macro-batch).
+# Semantics: each lane's recurrence is EXACTLY run_agd_host's — lanes that
+# accept/stop early are frozen by masks while the lock-step continues, and
+# since evaluations are pure, the extra (masked-out) evaluations cannot
+# change any lane's trajectory.  Pinned per-lane against the solo driver
+# by tests/test_host_multi.py.
+# ---------------------------------------------------------------------------
+
+
+class HostAGDMultiResult(NamedTuple):
+    """Batched result: every per-lane field carries a leading K axis
+    (the host twin of a batched ``core.agd.AGDResult`` from a sweep),
+    EXCEPT ``loss_history`` whose lane axis is SECOND:
+    ``loss_history[:, k][:num_iters[k]]`` is lane k's executed
+    history."""
+
+    weights: Any              # stacked (K, ...) pytree
+    loss_history: np.ndarray  # (num_iterations, K) -> indexed [i, k]
+    num_iters: np.ndarray     # (K,)
+    aborted_non_finite: np.ndarray  # (K,) bool
+    final_l: np.ndarray       # (K,)
+    num_backtracks: np.ndarray  # (K,)
+    num_restarts: np.ndarray  # (K,)
+    final_z: Any = None
+    final_theta: Any = None   # (K,)
+    final_bts: Any = None     # (K,) bool
+    converged: Any = None     # (K,) bool
+
+
+def _bc(a, leaf):
+    """Broadcast a per-lane (K,) host array against a stacked leaf."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(a).reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+
+def _axpby_lanes(a, X, b, Y):
+    """Per-lane axpby on stacked pytrees: a,b are (K,) arrays."""
+    return tvec.tmap(lambda u, v: _bc(a, u) * u + _bc(b, v) * v, X, Y)
+
+
+def _where_lanes(m, A, B):
+    """Per-lane select on stacked pytrees: m is a (K,) bool array."""
+    import jax.numpy as jnp
+
+    return tvec.tmap(
+        lambda u, v: jnp.where(_bc(m, u) != 0, u, v), A, B)
+
+
+def _dot_lanes(A, B):
+    """Per-lane <A, B>: (K,) NumPy array."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves_a = jax.tree_util.tree_leaves(A)
+    leaves_b = jax.tree_util.tree_leaves(B)
+    tot = sum(jnp.sum((u * v).reshape(u.shape[0], -1), axis=1)
+              for u, v in zip(leaves_a, leaves_b))
+    return np.asarray(tot)
+
+
+def make_prox_multi(updater, reg_params):
+    """Per-lane prox/reg-value pair for a strength grid: jitted vmap of
+    the updater over (lane state, lane gradient, lane step, lane reg)."""
+    import jax
+    import jax.numpy as jnp
+
+    # native dtype (f64 under x64): rounding strengths to f32 would
+    # silently fork every lane's trajectory from a solo run at the
+    # same (python-float) strength
+    regs = jnp.asarray(reg_params)
+
+    @jax.jit
+    def prox_multi(Z, G, steps):
+        return jax.vmap(
+            lambda z, g, s, r: updater.prox(z, g, s, r)[0])(
+                Z, G, jnp.asarray(steps), regs)
+
+    @jax.jit
+    def reg_value_multi(W):
+        return jax.vmap(
+            lambda w, r: updater.prox(
+                w, tvec.zeros_like(w), 0.0, r)[1])(W, regs)
+
+    return prox_multi, reg_value_multi
+
+
+def run_agd_host_multi(
+    smooth_multi: Callable,
+    prox_multi: Callable,
+    reg_value_multi: Callable,
+    w0_stacked: Any,
+    config: AGDConfig,
+    *,
+    smooth_loss_multi: Callable | None = None,
+) -> HostAGDMultiResult:
+    """K-lane lock-step twin of :func:`run_agd_host`.
+
+    ``smooth_multi(W_stacked) -> ((K,) losses, stacked grads)`` — e.g.
+    ``data.streaming.make_streaming_eval_multi``;
+    ``prox_multi(Z, G, steps) -> Z_new`` and
+    ``reg_value_multi(W) -> (K,)`` — e.g. :func:`make_prox_multi`.
+    ``w0_stacked`` carries the lane axis (same ``w0`` in every lane:
+    ``np.broadcast_to``/``jnp.stack`` it).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cfg = config
+    if cfg.loss_mode not in ("x", "x_strict", "y"):
+        raise ValueError(f"unknown loss_mode {cfg.loss_mode!r}")
+    k_lanes = jax.tree_util.tree_leaves(w0_stacked)[0].shape[0]
+    x = z = jax.tree_util.tree_map(jnp.asarray, w0_stacked)
+    theta = np.full(k_lanes, np.inf)
+    big_l = np.full(k_lanes, float(cfg.l0))
+    bts = np.ones(k_lanes, bool)
+    n_bt = np.zeros(k_lanes, np.int64)
+    n_restart = np.zeros(k_lanes, np.int64)
+    aborted = np.zeros(k_lanes, bool)
+    stopped_by_criteria = np.zeros(k_lanes, bool)
+    active = np.ones(k_lanes, bool)
+    num_iters = np.zeros(k_lanes, np.int64)
+    hist_rows: List[np.ndarray] = []
+    backtracking = cfg.beta < 1.0
+
+    for n_iter in range(1, cfg.num_iterations + 1):
+        if not active.any():
+            break
+        x_old, z_old = x, z
+        l_old = big_l.copy()
+        big_l = np.where(active, big_l * cfg.alpha, big_l)
+        theta_old = theta.copy()
+
+        f_y = np.zeros(k_lanes)
+        g_y = None
+        y = x
+        f_x_reuse = np.full(k_lanes, np.nan)
+        have_f_x = np.zeros(k_lanes, bool)
+        pending = active.copy()
+        for _ in range(max(1, cfg.max_backtracks)):
+            theta_try = 2.0 / (1.0 + np.sqrt(
+                1.0 + 4.0 * (big_l / l_old) / (theta_old * theta_old)))
+            theta = np.where(pending, theta_try, theta)
+            y_try = _axpby_lanes(1.0 - theta, x_old, theta, z_old)
+            y = _where_lanes(pending, y_try, y)
+            f_y_all, g_y_all = smooth_multi(y)
+            f_y = np.where(pending, np.asarray(f_y_all), f_y)
+            g_y = (g_y_all if g_y is None
+                   else _where_lanes(pending, g_y_all, g_y))
+            step = 1.0 / (theta * big_l)
+            z_try = prox_multi(z_old, g_y, step)
+            z = _where_lanes(pending, z_try, z)
+            x_try = _axpby_lanes(1.0 - theta, x_old, theta, z)
+            x = _where_lanes(pending, x_try, x)
+
+            if not backtracking:
+                have_f_x[:] = False
+                break
+
+            xy = tvec.sub(x, y)
+            xy_sq = _dot_lanes(xy, xy)
+            degenerate = pending & (
+                (xy_sq == 0.0) | ~np.isfinite(f_y))
+            f_x_reuse = np.where(degenerate, f_y, f_x_reuse)
+            have_f_x = have_f_x | degenerate
+            pending = pending & ~degenerate
+            if not pending.any():
+                break
+
+            f_x_all, g_x_all = smooth_multi(x)
+            f_x = np.asarray(f_x_all)
+            f_x_reuse = np.where(pending, f_x, f_x_reuse)
+            have_f_x = have_f_x | pending
+            xy_sq_safe = np.where(xy_sq > 0, xy_sq, 1.0)
+            q_x = (f_y + _dot_lanes(xy, g_y)
+                   + 0.5 * big_l * xy_sq_safe)
+            local_simple = big_l + 2.0 * np.maximum(f_x - q_x, 0.0) \
+                / xy_sq_safe
+            local_curv = 2.0 * _dot_lanes(
+                xy, tvec.sub(g_x_all, g_y)) / xy_sq_safe
+            # local_l uses the CURRENT bts (simple vs curvature
+            # estimate); bts then switches only for lanes that were in
+            # simple mode (the solo driver's `if bts: ... bts = ...`)
+            local_l = np.where(bts, local_simple, local_curv)
+            bts_next = (np.abs(f_y - f_x)
+                        >= cfg.backtrack_tol
+                        * np.maximum(np.abs(f_x), np.abs(f_y)))
+            bts = np.where(pending & bts, bts_next, bts)
+
+            accept = pending & ((local_l <= big_l)
+                                | (big_l >= cfg.l_exact))
+            reject = pending & ~accept
+            n_bt += reject.astype(np.int64)
+            # the solo loop's ∞-localL dance, with Python's min/max
+            # NaN semantics mirrored exactly (np.minimum propagates
+            # NaN where Python's min(l_exact, nan) returns l_exact —
+            # the r3 review caught the divergence): +inf keeps big_l
+            # then grows by 1/beta; NaN resolves to l_exact; finite
+            # takes min(l_exact, local) then max with bl1/beta.
+            linf = np.isinf(local_l)
+            lnan = np.isnan(local_l)
+            bl1 = np.where(
+                linf, big_l,
+                np.where(lnan, cfg.l_exact,
+                         np.minimum(cfg.l_exact, local_l)))
+            leff = np.where(linf, big_l, local_l)
+            bl2 = np.where(
+                lnan, cfg.l_exact,
+                np.minimum(cfg.l_exact,
+                           np.maximum(leff, bl1 / cfg.beta)))
+            big_l = np.where(reject, bl2, big_l)
+            pending = reject
+            if not pending.any():
+                break
+
+        # loss history (same modes as the solo driver), active lanes only
+        if cfg.loss_mode == "y":
+            loss_row = f_y + np.asarray(reg_value_multi(y))
+        elif cfg.loss_mode == "x_strict":
+            loss_row = (np.asarray(smooth_multi(x)[0])
+                        + np.asarray(reg_value_multi(x)))
+        else:  # 'x'
+            need = active & ~have_f_x
+            if need.any():
+                ls = smooth_loss_multi or (
+                    lambda W: smooth_multi(W)[0])
+                f_fresh = np.asarray(ls(x))
+                f_x_reuse = np.where(have_f_x, f_x_reuse, f_fresh)
+            loss_row = f_x_reuse + np.asarray(reg_value_multi(x))
+        prev = hist_rows[-1] if hist_rows else np.full(k_lanes, np.nan)
+        hist_rows.append(np.where(active, loss_row, prev))
+        num_iters += active.astype(np.int64)
+
+        abort_now = active & ~np.isfinite(f_y)
+        aborted |= abort_now
+        active = active & ~abort_now
+
+        dx = tvec.sub(x, x_old)
+        norm_dx = np.sqrt(np.maximum(_dot_lanes(dx, dx), 0.0))
+        norm_x = np.sqrt(np.maximum(_dot_lanes(x, x), 0.0))
+        stop = active & (
+            ((norm_dx == 0.0) & (n_iter > 1))
+            | (norm_dx < cfg.convergence_tol * np.maximum(norm_x, 1.0)))
+        stopped_by_criteria |= stop
+        active = active & ~stop
+        if cfg.may_restart:
+            restart = active & (_dot_lanes(g_y, dx) > 0)
+            if restart.any():
+                z = _where_lanes(restart, x, z)
+                theta = np.where(restart, np.inf, theta)
+                bts = np.where(restart, True, bts)
+                n_restart += restart.astype(np.int64)
+
+    return HostAGDMultiResult(
+        weights=x,
+        loss_history=(np.stack(hist_rows)
+                      if hist_rows else np.zeros((0, k_lanes))),
+        num_iters=num_iters, aborted_non_finite=aborted,
+        final_l=big_l, num_backtracks=n_bt, num_restarts=n_restart,
+        final_z=z, final_theta=theta, final_bts=bts,
+        converged=stopped_by_criteria)

@@ -1,0 +1,228 @@
+"""Multi-lane host AGD (`core.host_agd.run_agd_host_multi`) — the
+streamed regularization path.
+
+The contract: lane k of a lock-step multi-lane run must reproduce a
+SOLO `run_agd_host` at strength k EXACTLY (f64) — frozen-lane masking
+and the shared lock-step evaluations must be invisible to every lane's
+own recurrence (theta/L dance, bts switching, ∞-localL, restart,
+convergence stops, all of it).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu.core import agd, host_agd, smooth as smooth_lib
+from spark_agd_tpu.data import streaming
+from spark_agd_tpu.ops import losses, prox
+
+REGS = [0.0, 0.03, 0.4, 5.0]
+
+
+def _problem(rng, n=400, d=7):
+    X = rng.standard_normal((n, d))
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    return X, y
+
+
+def _solo(X, y, g, updater, reg, w0, cfg):
+    sm = smooth_lib.make_smooth(g, jnp.asarray(X), jnp.asarray(y))
+    sl = smooth_lib.make_smooth_loss(g, jnp.asarray(X), jnp.asarray(y))
+    px, rv = smooth_lib.make_prox(updater, reg)
+    return host_agd.run_agd_host(sm, px, rv, jnp.asarray(w0), cfg,
+                                 smooth_loss=sl)
+
+
+def _multi(X, y, g, updater, regs, w0, cfg):
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    @jax.jit
+    def smooth_multi(W):
+        ls, gs, n = jax.vmap(
+            lambda w: g.batch_loss_and_grad(w, Xd, yd))(W)
+        nf = jnp.asarray(n[0], ls.dtype)
+        return ls / nf, gs / nf
+
+    @jax.jit
+    def smooth_loss_multi(W):
+        ls, _, n = jax.vmap(
+            lambda w: g.batch_loss_and_grad(w, Xd, yd))(W)
+        return ls / jnp.asarray(n[0], ls.dtype)
+
+    pxm, rvm = host_agd.make_prox_multi(updater, regs)
+    W0 = jnp.stack([jnp.asarray(w0)] * len(regs))
+    return host_agd.run_agd_host_multi(
+        smooth_multi, pxm, rvm, W0, cfg,
+        smooth_loss_multi=smooth_loss_multi)
+
+
+def _assert_lane_parity(multi, solos):
+    for k, solo in enumerate(solos):
+        assert int(multi.num_iters[k]) == solo.num_iters, f"lane {k}"
+        assert int(multi.num_backtracks[k]) == solo.num_backtracks, (
+            f"lane {k}")
+        assert int(multi.num_restarts[k]) == solo.num_restarts, (
+            f"lane {k}")
+        assert bool(multi.converged[k]) == solo.converged, f"lane {k}"
+        nk = solo.num_iters
+        # f64 tolerances: the vmapped (N,D)@(D,K) lane contraction
+        # reassociates vs the solo matvec, so last-ulp drift (~1e-11
+        # rel) is physical; the DISCRETE path equality above is exact
+        np.testing.assert_allclose(
+            multi.loss_history[:nk, k], solo.loss_history,
+            rtol=1e-9, atol=1e-12, err_msg=f"lane {k}")
+        np.testing.assert_allclose(
+            np.asarray(multi.weights)[k], np.asarray(solo.weights),
+            rtol=1e-7, atol=1e-10, err_msg=f"lane {k}")
+        np.testing.assert_allclose(
+            float(multi.final_l[k]), solo.final_l, rtol=1e-9,
+            err_msg=f"lane {k}")
+
+
+class TestLaneParity:
+    @pytest.mark.parametrize("updater", [
+        prox.SquaredL2Updater(), prox.L1Updater(),
+        prox.MLlibSquaredL2Updater()])
+    def test_lanes_equal_solo_runs(self, rng, updater):
+        X, y = _problem(rng)
+        g = losses.LogisticGradient()
+        w0 = rng.normal(size=X.shape[1]) * 0.2
+        cfg = agd.AGDConfig(num_iterations=8, convergence_tol=0.0)
+        multi = _multi(X, y, g, updater, REGS, w0, cfg)
+        solos = [_solo(X, y, g, updater, r, w0, cfg) for r in REGS]
+        _assert_lane_parity(multi, solos)
+
+    def test_early_converging_lanes_freeze(self, rng):
+        """A loose tolerance stops strong-reg lanes early; their frozen
+        state must still match their solo runs while weak-reg lanes
+        keep iterating."""
+        X, y = _problem(rng)
+        g = losses.LogisticGradient()
+        w0 = np.zeros(X.shape[1])
+        cfg = agd.AGDConfig(num_iterations=25, convergence_tol=3e-3)
+        multi = _multi(X, y, g, prox.SquaredL2Updater(), REGS, w0, cfg)
+        solos = [_solo(X, y, g, prox.SquaredL2Updater(), r, w0, cfg)
+                 for r in REGS]
+        iters = [s.num_iters for s in solos]
+        assert len(set(iters)) > 1, (
+            f"test needs lanes stopping at different iterations, "
+            f"got {iters}")
+        _assert_lane_parity(multi, solos)
+
+    def test_backtracking_and_restart_regimes(self, rng):
+        """l0 far too small forces backtracking; restarts on."""
+        X, y = _problem(rng)
+        g = losses.LeastSquaresGradient()
+        w0 = rng.normal(size=X.shape[1])
+        cfg = agd.AGDConfig(num_iterations=10, convergence_tol=0.0,
+                            l0=1e-3, may_restart=True)
+        multi = _multi(X, y, g, prox.SquaredL2Updater(), REGS, w0, cfg)
+        solos = [_solo(X, y, g, prox.SquaredL2Updater(), r, w0, cfg)
+                 for r in REGS]
+        assert sum(s.num_backtracks for s in solos) > 0
+        _assert_lane_parity(multi, solos)
+
+    def test_backtracking_disabled(self, rng):
+        X, y = _problem(rng)
+        g = losses.LogisticGradient()
+        w0 = np.zeros(X.shape[1])
+        cfg = agd.AGDConfig(num_iterations=6, convergence_tol=0.0,
+                            beta=1.0)
+        multi = _multi(X, y, g, prox.L1Updater(), [0.01, 0.2], w0, cfg)
+        solos = [_solo(X, y, g, prox.L1Updater(), r, w0, cfg)
+                 for r in [0.01, 0.2]]
+        _assert_lane_parity(multi, solos)
+
+    @pytest.mark.parametrize("loss_mode", ["x_strict", "y"])
+    def test_loss_modes(self, rng, loss_mode):
+        X, y = _problem(rng)
+        g = losses.LogisticGradient()
+        w0 = np.zeros(X.shape[1])
+        cfg = agd.AGDConfig(num_iterations=5, convergence_tol=0.0,
+                            loss_mode=loss_mode)
+        multi = _multi(X, y, g, prox.SquaredL2Updater(), REGS, w0, cfg)
+        solos = [_solo(X, y, g, prox.SquaredL2Updater(), r, w0, cfg)
+                 for r in REGS]
+        _assert_lane_parity(multi, solos)
+
+    def test_l_cap_and_small_alpha(self, rng):
+        X, y = _problem(rng)
+        g = losses.LogisticGradient()
+        w0 = np.zeros(X.shape[1])
+        cfg = agd.AGDConfig(num_iterations=7, convergence_tol=0.0,
+                            l_exact=2.0, alpha=0.7)
+        multi = _multi(X, y, g, prox.SquaredL2Updater(), REGS, w0, cfg)
+        solos = [_solo(X, y, g, prox.SquaredL2Updater(), r, w0, cfg)
+                 for r in REGS]
+        _assert_lane_parity(multi, solos)
+
+
+class TestStreamedSweep:
+    def test_streamed_lanes_equal_in_memory_solo(self, rng):
+        """The intended use: the whole path trained over a STREAM, one
+        stream read per trial for all lanes — must equal in-memory solo
+        host runs per lane."""
+        n, d = 600, 9
+        X = rng.standard_normal((n, d)).astype(np.float64)
+        y = (rng.random(n) < 0.5).astype(np.float64)
+        g = losses.LogisticGradient()
+        regs = [0.01, 0.3]
+        w0 = np.zeros(d)
+        cfg = agd.AGDConfig(num_iterations=6, convergence_tol=0.0)
+
+        ds = streaming.StreamingDataset.from_arrays(X, y,
+                                                    batch_rows=256)
+        sm_multi = streaming.make_streaming_eval_multi(g, ds,
+                                                       pad_to=256)
+        sl_multi = streaming.make_streaming_eval_multi(
+            g, ds, pad_to=256, with_grad=False)
+        pxm, rvm = host_agd.make_prox_multi(prox.SquaredL2Updater(),
+                                            regs)
+        W0 = jnp.stack([jnp.asarray(w0)] * len(regs))
+        multi = host_agd.run_agd_host_multi(
+            sm_multi, pxm, rvm, W0, cfg, smooth_loss_multi=sl_multi)
+        solos = [_solo(X, y, g, prox.SquaredL2Updater(), r, w0, cfg)
+                 for r in regs]
+        _assert_lane_parity(multi, solos)
+
+
+class TestStreamingSweepAPI:
+    def test_api_streaming_sweep(self, rng, cpu_devices):
+        """api.streaming_sweep end to end: streamed CSR data, mesh
+        sharding, parity vs solo host runs."""
+        from spark_agd_tpu import api
+        from spark_agd_tpu.ops import sparse
+        from spark_agd_tpu.parallel import mesh as mesh_lib
+
+        n, d, npr = 500, 11, 4
+        indptr = np.arange(n + 1) * npr
+        indices = rng.integers(0, d, n * npr).astype(np.int32)
+        values = rng.normal(size=n * npr)
+        y = (rng.random(n) < 0.5).astype(np.float64)
+        regs = [0.01, 0.2]
+        w0 = np.zeros(d)
+        cfg_kw = dict(num_iterations=5, convergence_tol=0.0)
+
+        mesh = mesh_lib.make_mesh({"data": 4}, devices=cpu_devices[:4])
+        ds = streaming.StreamingDataset.from_csr(
+            indptr, indices, values, d, y, batch_rows=256)
+        multi = api.streaming_sweep(
+            ds, losses.LogisticGradient(), prox.SquaredL2Updater(),
+            regs, initial_weights=w0, mesh=mesh, **cfg_kw)
+
+        X = np.zeros((n, d))
+        rows = np.repeat(np.arange(n), npr)
+        np.add.at(X, (rows, indices), values)
+        cfg = agd.AGDConfig(**cfg_kw)
+        solos = [_solo(X, y, losses.LogisticGradient(),
+                       prox.SquaredL2Updater(), r, w0, cfg)
+                 for r in regs]
+        for k, s in enumerate(solos):
+            assert int(multi.num_iters[k]) == s.num_iters
+            np.testing.assert_allclose(
+                multi.loss_history[:s.num_iters, k], s.loss_history,
+                rtol=1e-9, atol=1e-12)
+            np.testing.assert_allclose(
+                np.asarray(multi.weights)[k], np.asarray(s.weights),
+                rtol=1e-7, atol=1e-10)
