@@ -212,7 +212,11 @@ class HostAGDMultiResult(NamedTuple):
     history."""
 
     weights: Any              # stacked (K, ...) pytree
-    loss_history: np.ndarray  # (num_iterations, K) -> indexed [i, k]
+    loss_history: np.ndarray  # (executed_iters, K) -> indexed [i, k];
+    #                           first axis = GLOBALLY executed
+    #                           iterations (max over lanes, <= the
+    #                           configured num_iterations when every
+    #                           lane stops early)
     num_iters: np.ndarray     # (K,)
     aborted_non_finite: np.ndarray  # (K,) bool
     final_l: np.ndarray       # (K,)

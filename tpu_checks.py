@@ -349,11 +349,12 @@ def main(argv=None):
         # Guard ONLY this block (ADVICE r2): an early `return` here would
         # silently skip any check appended after the streaming one in
         # no-H2D mode.
-        print(json.dumps({
-            "check": "streaming_overlap", "ok": True, "skipped": True,
-            "reason": f"H2D rate {h2d_rate:.1f} MiB/s too low "
-                      "(tunnel degraded); overlap is CI-covered on the "
-                      "CPU backend"}), flush=True)
+        for chk in ("streaming_overlap", "streamed_sweep_vs_sequential"):
+            print(json.dumps({
+                "check": chk, "ok": True, "skipped": True,
+                "reason": f"H2D rate {h2d_rate:.1f} MiB/s too low "
+                          "(tunnel degraded); covered on the CPU "
+                          "backend"}), flush=True)
     else:
         from spark_agd_tpu.data import streaming
 
@@ -401,6 +402,73 @@ def main(argv=None):
             "serialized_ms": round(serial_s * 1e3, 1),
             "speedup": round(serial_s / piped_s, 3),
             "ok": True}), flush=True)
+
+        # Streamed K-lane sweep vs K sequential streamed fits: the
+        # multi-lane host driver shares ONE stream read per trial
+        # across all lanes, where sequential fits re-stream per lane.
+        # Both sides are built ONCE and WARMED (first run pays the
+        # compiles) so the timed second run measures the lane fusion,
+        # not jit-cache misses; one shared AGDConfig drives both.
+        from spark_agd_tpu.core import agd as agd_lib, host_agd
+        from spark_agd_tpu.core import smooth as smooth_lib_m
+        from spark_agd_tpu.ops.prox import SquaredL2Updater
+
+        ss_k, ss_iters = 4, 2
+        ss_regs = [0.0, 0.01, 0.1, 1.0][:ss_k]
+        ds2 = streaming.StreamingDataset.from_arrays(Xs, ys,
+                                                     batch_rows=bs)
+        w0s = jnp.zeros(sd, jnp.float32)
+        cfg_s = agd_lib.AGDConfig(num_iterations=ss_iters,
+                                  convergence_tol=0.0)
+        sm_multi = streaming.make_streaming_eval_multi(
+            LogisticGradient(), ds2, pad_to=bs)
+        sl_multi = streaming.make_streaming_eval_multi(
+            LogisticGradient(), ds2, pad_to=bs, with_grad=False)
+        pxm, rvm = host_agd.make_prox_multi(SquaredL2Updater(), ss_regs)
+        W0 = jnp.stack([w0s] * ss_k)
+
+        def run_multi():
+            return host_agd.run_agd_host_multi(
+                sm_multi, pxm, rvm, W0, cfg_s,
+                smooth_loss_multi=sl_multi)
+
+        sm2, sl2 = streaming.make_streaming_smooth(
+            LogisticGradient(), ds2, pad_to=bs)  # reg-independent: ONE
+        # build serves every sequential fit
+
+        def run_sequential():
+            out = []
+            for reg in ss_regs:
+                px2, rv2 = smooth_lib_m.make_prox(SquaredL2Updater(),
+                                                  reg)
+                out.append(host_agd.run_agd_host(
+                    sm2, px2, rv2, w0s, cfg_s, smooth_loss=sl2))
+            return out
+
+        run_multi()  # warm (compiles)
+        t0 = time.perf_counter()
+        multi = run_multi()
+        multi_s = time.perf_counter() - t0
+        run_sequential()  # warm
+        t0 = time.perf_counter()
+        solos = run_sequential()
+        seq_s = time.perf_counter() - t0
+        rel_w0 = float(
+            np.linalg.norm(np.asarray(multi.weights)[0]
+                           - np.asarray(solos[0].weights))
+            / (np.linalg.norm(np.asarray(solos[0].weights)) + 1e-30))
+        ss_ok = rel_w0 < 1e-4 and all(
+            int(multi.num_iters[k]) == solos[k].num_iters
+            for k in range(ss_k))
+        failures += not ss_ok
+        print(json.dumps({
+            "check": "streamed_sweep_vs_sequential",
+            "rows": sn, "d": sd, "k": ss_k, "iters": ss_iters,
+            "multi_s": round(multi_s, 2),
+            "sequential_s": round(seq_s, 2),
+            "speedup_vs_k_fits": round(seq_s / multi_s, 2),
+            "rel_weight_err_lane0": rel_w0,
+            "ok": bool(ss_ok)}), flush=True)
 
     return failures
 
