@@ -9,7 +9,11 @@ express:
    (``trainer.cross_validate``), refit on the winner,
 3. evaluation with the jitted ``mllib.evaluation`` equivalents
    (rank-based AUC in one device sort),
-4. persistence: ``model.save`` / ``load_model``.
+4. persistence: ``model.save`` / ``load_model``,
+5. the STREAMED variants: a regularization path trained over a
+   larger-than-HBM stream in lock-step (``api.streaming_sweep``, one
+   stream read per trial for every lane) and one-pass multi-lane
+   validation scoring (``make_streaming_eval_multi``).
 
     python examples/model_selection.py
 """
@@ -87,6 +91,35 @@ def main():
     assert np.allclose(np.asarray(reloaded.weights),
                        np.asarray(best_model.weights))
     print(f"saved + reloaded {reloaded} from {path_npz}")
+
+    # 5) the same path over a STREAM (as if X could not fit in HBM):
+    #    train all strengths in lock-step — one stream read per trial —
+    #    then score every lane on a streamed validation set in one pass
+    from spark_agd_tpu import StreamingDataset, api, \
+        make_streaming_eval_multi
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    # L2Prox = the EXACT proximity operator (what the trainer uses):
+    # unconditionally stable at any strength.  The MLlib-linearized
+    # SquaredL2Updater is kept bit-faithful for parity and diverges at
+    # step*reg >> 1 exactly like the reference would.
+    t0 = time.perf_counter()
+    ds = StreamingDataset.from_arrays(X, y, batch_rows=4096)
+    sres = api.streaming_sweep(
+        ds, LogisticGradient(), L2Prox(), grid,
+        num_iterations=25, convergence_tol=1e-6,
+        initial_weights=np.zeros(d, np.float32), pad_to=4096)
+    ds_val = StreamingDataset.from_arrays(X_test, y_test,
+                                          batch_rows=4096)
+    val = make_streaming_eval_multi(
+        LogisticGradient(), ds_val, pad_to=4096,
+        with_grad=False)(sres.weights)
+    print(f"streamed path: {len(grid)} strengths in lock-step, "
+          f"{time.perf_counter()-t0:.1f}s; per-lane iters "
+          f"{sres.num_iters.tolist()}, streamed val loss "
+          f"{np.round(np.asarray(val), 4)} -> best reg "
+          f"{grid[int(np.argmin(np.asarray(val)))]}")
 
 
 if __name__ == "__main__":
