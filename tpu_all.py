@@ -310,9 +310,18 @@ def main(argv=None):
         stage("checks", args.checks_budget)
         import tpu_checks
 
+        # off-chip rehearsals (TPU_ALL_ALLOW_CPU) must run the tiny
+        # shapes: interpret-mode Pallas at rcv1 width is intractable on
+        # a CPU backend; the chip runs the full scale.  tpu_checks has
+        # its own CPU gate, so the rehearsal also needs its allow flag.
+        if d.platform == "tpu":
+            checks_argv = []
+        else:
+            checks_argv = ["--small"]
+            os.environ["TPU_CHECKS_ALLOW_CPU"] = "1"
         try:
             with stdout_to(f"TPU_CHECKS_{args.tag}.json"):
-                n_fail = tpu_checks.main([])
+                n_fail = tpu_checks.main(checks_argv)
             failures += n_fail
         except Exception as e:  # noqa: BLE001
             log(f"tpu_checks failed: {type(e).__name__}: {e}")

@@ -3,7 +3,7 @@ Pallas, real VMEM limits, real MXU timings).  Run manually / by the
 driver when the TPU is reachable:
 
     timeout 900 python tpu_checks.py          # all checks
-    timeout 900 python tpu_checks.py --wide-d 47104 --rows 65536
+    timeout 900 python tpu_checks.py          # HBM-safe default rows
 
 Covers VERDICT r1 item 4's done-condition: compiled (non-interpreter)
 parity of the fused Pallas margin kernel at rcv1 width (D>=47k), for all
@@ -31,7 +31,13 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--wide-d", type=int, default=47104,
                    help="feature width for the wide checks (rcv1 ~47k)")
-    p.add_argument("--rows", type=int, default=1 << 16)
+    p.add_argument("--rows", type=int, default=None,
+                   help="rows for the wide DENSE checks; default sizes "
+                        "X to ~1.5 GiB so X + its tile-padded twin + "
+                        "transients stay far from a 16 GB chip's HBM "
+                        "ceiling (the old 1<<16 default built a "
+                        "12.35 GiB X that would have OOMed the first "
+                        "healthy claim's checks stage)")
     p.add_argument("--reps", type=int, default=20)
     p.add_argument("--small", action="store_true",
                    help="tiny shapes — a CPU smoke of the harness "
@@ -40,6 +46,9 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.small:
         args.wide_d, args.rows, args.reps = 512, 1 << 10, 2
+    elif args.rows is None:
+        args.rows = max(1024, int(1.5 * 2**30 / (4 * args.wide_d))
+                        // 256 * 256)
 
     import jax
 
@@ -182,6 +191,10 @@ def main(argv=None):
     # compiled parity + single-pass vs two-pass timing.
     from spark_agd_tpu.ops.losses import SoftmaxGradient
     from spark_agd_tpu.ops.pallas_kernels import PallasSoftmaxGradient
+
+    # the wide arrays are dead past this point; dropping them returns
+    # ~3 GiB of HBM before the softmax/sweep sections allocate
+    del Xd, yd, wd, padded
 
     smx_n, smx_d, smx_k = (1 << 10 if args.small else 1 << 17), 784, 10
 
