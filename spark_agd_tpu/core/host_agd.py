@@ -260,6 +260,73 @@ def _dot_lanes(A, B):
     return np.asarray(tot)
 
 
+class HostMultiWarm(NamedTuple):
+    """Continuation carry for :func:`run_agd_host_multi` — the multi-
+    lane twin of ``AGDWarmState`` plus the per-lane stop bookkeeping a
+    lock-step resume needs (a lane that converged before the kill must
+    STAY stopped; counters continue, not restart)."""
+
+    x: Any                 # stacked (K, ...) pytree
+    z: Any
+    theta: np.ndarray      # (K,)
+    big_l: np.ndarray      # (K,)
+    bts: np.ndarray        # (K,) bool
+    prior_iters: np.ndarray  # (K,) iterations already executed
+    converged: np.ndarray  # (K,) bool — stopped by its own criteria
+    aborted: np.ndarray    # (K,) bool
+    num_backtracks: np.ndarray  # (K,)
+    num_restarts: np.ndarray    # (K,)
+    last_loss: np.ndarray  # (K,) last recorded history row — warm
+    #                        segments forward-fill stopped lanes with
+    #                        THIS (an uninterrupted run repeats the
+    #                        converged loss, not NaN)
+
+    @classmethod
+    def initial(cls, w0_stacked, config) -> "HostMultiWarm":
+        """The iteration-zero carry — defined ONCE (the checkpoint
+        layer must not hand-roll its own copy)."""
+        import jax
+        import jax.numpy as jnp
+
+        k = jax.tree_util.tree_leaves(w0_stacked)[0].shape[0]
+        w = jax.tree_util.tree_map(jnp.asarray, w0_stacked)
+        return cls(
+            x=w, z=w, theta=np.full(k, np.inf),
+            big_l=np.full(k, float(config.l0)), bts=np.ones(k, bool),
+            prior_iters=np.zeros(k, np.int64),
+            converged=np.zeros(k, bool), aborted=np.zeros(k, bool),
+            num_backtracks=np.zeros(k, np.int64),
+            num_restarts=np.zeros(k, np.int64),
+            last_loss=np.full(k, np.nan))
+
+
+def multi_warm_state(res: "HostAGDMultiResult",
+                     prior_iters=0) -> HostMultiWarm:
+    """The continuation carry out of a multi-lane result — feed to
+    ``run_agd_host_multi(..., warm=...)`` to run the next segment.
+
+    ``prior_iters``: per-lane iterations executed BEFORE the segment
+    ``res`` came from (0 for the first continuation; pass the previous
+    warm's ``prior_iters`` when chaining — the ``sweep_warm_state``
+    convention), so the total accumulates and the ``n_iter > 1``
+    exact-zero-step gate keeps making uninterrupted-run decisions."""
+    hist = np.asarray(res.loss_history)
+    k = len(np.asarray(res.num_iters))
+    return HostMultiWarm(
+        x=res.weights, z=res.final_z,
+        theta=np.asarray(res.final_theta, float),
+        big_l=np.asarray(res.final_l, float),
+        bts=np.asarray(res.final_bts, bool),
+        prior_iters=(np.asarray(prior_iters, np.int64)
+                     + np.asarray(res.num_iters, np.int64)),
+        converged=np.asarray(res.converged, bool),
+        aborted=np.asarray(res.aborted_non_finite, bool),
+        num_backtracks=np.asarray(res.num_backtracks, np.int64),
+        num_restarts=np.asarray(res.num_restarts, np.int64),
+        last_loss=(hist[-1] if hist.shape[0]
+                   else np.full(k, np.nan)))
+
+
 def make_prox_multi(updater, reg_params):
     """Per-lane prox/reg-value pair for a strength grid: jitted vmap of
     the updater over (lane state, lane gradient, lane step, lane reg)."""
@@ -294,6 +361,7 @@ def run_agd_host_multi(
     config: AGDConfig,
     *,
     smooth_loss_multi: Callable | None = None,
+    warm: HostMultiWarm | None = None,
 ) -> HostAGDMultiResult:
     """K-lane lock-step twin of :func:`run_agd_host`.
 
@@ -303,6 +371,11 @@ def run_agd_host_multi(
     ``reg_value_multi(W) -> (K,)`` — e.g. :func:`make_prox_multi`.
     ``w0_stacked`` carries the lane axis (same ``w0`` in every lane:
     ``np.broadcast_to``/``jnp.stack`` it).
+
+    ``warm`` (:func:`multi_warm_state`) continues a prior segment:
+    converged/aborted lanes stay stopped, counters continue, and the
+    returned ``loss_history``/``num_iters`` cover THIS segment only
+    (the solo-driver checkpointing convention).
     """
     import jax
     import jax.numpy as jnp
@@ -311,15 +384,20 @@ def run_agd_host_multi(
     if cfg.loss_mode not in ("x", "x_strict", "y"):
         raise ValueError(f"unknown loss_mode {cfg.loss_mode!r}")
     k_lanes = jax.tree_util.tree_leaves(w0_stacked)[0].shape[0]
-    x = z = jax.tree_util.tree_map(jnp.asarray, w0_stacked)
-    theta = np.full(k_lanes, np.inf)
-    big_l = np.full(k_lanes, float(cfg.l0))
-    bts = np.ones(k_lanes, bool)
-    n_bt = np.zeros(k_lanes, np.int64)
-    n_restart = np.zeros(k_lanes, np.int64)
-    aborted = np.zeros(k_lanes, bool)
-    stopped_by_criteria = np.zeros(k_lanes, bool)
-    active = np.ones(k_lanes, bool)
+    if warm is None:
+        warm = HostMultiWarm.initial(w0_stacked, cfg)
+    x = jax.tree_util.tree_map(jnp.asarray, warm.x)
+    z = jax.tree_util.tree_map(jnp.asarray, warm.z)
+    theta = np.asarray(warm.theta, float).copy()
+    big_l = np.asarray(warm.big_l, float).copy()
+    bts = np.asarray(warm.bts, bool).copy()
+    n_bt = np.asarray(warm.num_backtracks, np.int64).copy()
+    n_restart = np.asarray(warm.num_restarts, np.int64).copy()
+    aborted = np.asarray(warm.aborted, bool).copy()
+    stopped_by_criteria = np.asarray(warm.converged, bool).copy()
+    it_base = np.asarray(warm.prior_iters, np.int64).copy()
+    prev_fill = np.asarray(warm.last_loss, float).copy()
+    active = ~(aborted | stopped_by_criteria)
     num_iters = np.zeros(k_lanes, np.int64)
     hist_rows: List[np.ndarray] = []
     backtracking = cfg.beta < 1.0
@@ -428,7 +506,10 @@ def run_agd_host_multi(
                 f_fresh = np.asarray(ls(x))
                 f_x_reuse = np.where(have_f_x, f_x_reuse, f_fresh)
             loss_row = f_x_reuse + np.asarray(reg_value_multi(x))
-        prev = hist_rows[-1] if hist_rows else np.full(k_lanes, np.nan)
+        # stopped lanes forward-fill their last recorded loss — across
+        # warm-segment boundaries too (prev_fill carries it), so a
+        # checkpointed history equals the uninterrupted one
+        prev = hist_rows[-1] if hist_rows else prev_fill
         hist_rows.append(np.where(active, loss_row, prev))
         num_iters += active.astype(np.int64)
 
@@ -439,8 +520,11 @@ def run_agd_host_multi(
         dx = tvec.sub(x, x_old)
         norm_dx = np.sqrt(np.maximum(_dot_lanes(dx, dx), 0.0))
         norm_x = np.sqrt(np.maximum(_dot_lanes(x, x), 0.0))
+        # per-lane TOTAL iteration count (warm segments accumulate) for
+        # the exact-zero-step nIter>1 gate
+        it_count = it_base + num_iters
         stop = active & (
-            ((norm_dx == 0.0) & (n_iter > 1))
+            ((norm_dx == 0.0) & (it_count > 1))
             | (norm_dx < cfg.convergence_tol * np.maximum(norm_x, 1.0)))
         stopped_by_criteria |= stop
         active = active & ~stop

@@ -128,6 +128,12 @@ def load_checkpoint(path: str, template: Any,
                 f"checkpoint at {path!r} belongs to a different problem "
                 "(weight structure or config changed); delete it or use "
                 "a different path")
+        if "multi" in data:
+            raise ValueError(
+                f"checkpoint at {path!r} is a MULTI-lane checkpoint "
+                "(run_agd_multi_checkpointed); load it with "
+                "load_multi_checkpoint / resume it with the multi "
+                "driver")
         def tree(name):
             leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
             return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -245,3 +251,152 @@ def run_agd_checkpointed(
         weights=warm.x, loss_history=np.asarray(hist),
         num_iters=int(warm.prior_iters), aborted_non_finite=aborted,
         resumed_from=resumed_from)
+
+
+# ---------------------------------------------------------------------------
+# Multi-lane (streamed sweep) checkpointing: same format discipline — one
+# atomic npz of plain arrays, a fingerprint, terminal semantics — for the
+# K-lane lock-step host driver (core.host_agd.run_agd_host_multi).  The
+# north-star composition closes here: a regularization path over a
+# larger-than-HBM stream survives a mid-run kill.
+# ---------------------------------------------------------------------------
+
+
+def save_multi_checkpoint(path: str, warm, loss_history,
+                          *, fingerprint: Optional[str] = None) -> None:
+    """Atomically persist a ``core.host_agd.HostMultiWarm`` (+ the
+    cumulative ``(iters, K)`` loss-history rows)."""
+    payload = {}
+    for name, tree in (("x", warm.x), ("z", warm.z)):
+        for i, leaf in enumerate(_flat(tree)):
+            payload[f"{name}_{i}"] = np.asarray(leaf)
+    for field in ("theta", "big_l", "bts", "prior_iters", "converged",
+                  "aborted", "num_backtracks", "num_restarts",
+                  "last_loss"):
+        payload[field] = np.asarray(getattr(warm, field))
+    if fingerprint is not None:
+        payload["fingerprint"] = np.asarray(fingerprint)
+    payload["loss_history"] = np.asarray(loss_history)
+    payload["multi"] = np.asarray(True)
+    atomic_savez(path, payload)
+
+
+def load_multi_checkpoint(path: str, template: Any,
+                          expect_fingerprint: Optional[str] = None):
+    """Rebuild a multi-lane checkpoint; ``template`` is the STACKED
+    weight pytree (leaf order).  Returns ``(HostMultiWarm, hist)`` or
+    None when the file does not exist."""
+    from ..core import host_agd
+
+    if not os.path.exists(path):
+        return None
+    treedef = jax.tree_util.tree_structure(template)
+    n = treedef.num_leaves
+    with np.load(path) as data:
+        fp = str(data["fingerprint"]) if "fingerprint" in data else None
+        if (expect_fingerprint is not None and fp is not None
+                and fp != expect_fingerprint):
+            raise ValueError(
+                f"checkpoint at {path!r} belongs to a different problem "
+                "(weight structure or config changed); delete it or use "
+                "a different path")
+        if "multi" not in data:
+            raise ValueError(
+                f"checkpoint at {path!r} is a single-run checkpoint, "
+                "not a multi-lane one")
+
+        def tree(name):
+            leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        warm = host_agd.HostMultiWarm(
+            x=tree("x"), z=tree("z"),
+            theta=np.asarray(data["theta"]),
+            big_l=np.asarray(data["big_l"]),
+            bts=np.asarray(data["bts"]),
+            prior_iters=np.asarray(data["prior_iters"]),
+            converged=np.asarray(data["converged"]),
+            aborted=np.asarray(data["aborted"]),
+            num_backtracks=np.asarray(data["num_backtracks"]),
+            num_restarts=np.asarray(data["num_restarts"]),
+            last_loss=np.asarray(data["last_loss"]))
+        hist = np.asarray(data["loss_history"])
+    return warm, hist
+
+
+class CheckpointedMultiResult(NamedTuple):
+    weights: Any               # stacked (K, ...) pytree
+    loss_history: np.ndarray   # cumulative (total_iters, K)
+    num_iters: np.ndarray      # (K,) totals across all launches
+    aborted_non_finite: np.ndarray  # (K,)
+    converged: np.ndarray      # (K,)
+    resumed_from: np.ndarray   # (K,) iterations already checkpointed
+
+
+def run_agd_multi_checkpointed(
+    smooth_multi,
+    prox_multi,
+    reg_value_multi,
+    w0_stacked: Any,
+    config: AGDConfig,
+    *,
+    path: str,
+    segment_iters: int = 10,
+    smooth_loss_multi=None,
+) -> CheckpointedMultiResult:
+    """The K-lane twin of :func:`run_agd_checkpointed` over the host
+    multi driver: run ``segment_iters`` lock-step iterations per
+    segment, checkpoint the full per-lane carry after each, resume
+    exactly (converged lanes stay stopped) after any kill."""
+    from ..core import host_agd
+
+    if segment_iters <= 0:
+        raise ValueError("segment_iters must be positive")
+    fp = problem_fingerprint(w0_stacked, config)
+    loaded = load_multi_checkpoint(path, w0_stacked,
+                                   expect_fingerprint=fp)
+    if loaded is not None:
+        warm, hist = loaded
+        hist = list(hist)
+    else:
+        warm, hist = None, []
+
+    def _active_done(w):
+        if w is None:
+            return 0, True
+        act = ~(w.converged | w.aborted)
+        return (int(w.prior_iters[act].max()) if act.any()
+                else int(config.num_iterations)), act.any()
+
+    done, any_active = _active_done(warm)
+    resumed_from = (np.zeros(_n_lanes(w0_stacked), np.int64)
+                    if warm is None else warm.prior_iters.copy())
+    while any_active and done < config.num_iterations:
+        k = min(segment_iters, config.num_iterations - done)
+        cfg_k = dataclasses.replace(config, num_iterations=k)
+        res = host_agd.run_agd_host_multi(
+            smooth_multi, prox_multi, reg_value_multi, w0_stacked,
+            cfg_k, smooth_loss_multi=smooth_loss_multi, warm=warm)
+        seg_rows = np.asarray(res.loss_history)
+        hist.extend(seg_rows.tolist())
+        warm = host_agd.multi_warm_state(
+            res, prior_iters=(0 if warm is None else warm.prior_iters))
+        save_multi_checkpoint(path, warm, np.asarray(hist),
+                              fingerprint=fp)
+        if seg_rows.shape[0] == 0:
+            break
+        done, any_active = _active_done(warm)
+
+    if warm is None:  # zero-iteration request on a fresh path
+        warm = host_agd.HostMultiWarm.initial(w0_stacked, config)
+    return CheckpointedMultiResult(
+        weights=warm.x,
+        loss_history=(np.asarray(hist) if hist
+                      else np.zeros((0, _n_lanes(w0_stacked)))),
+        num_iters=warm.prior_iters,
+        aborted_non_finite=warm.aborted, converged=warm.converged,
+        resumed_from=np.asarray(resumed_from))
+
+
+def _n_lanes(w0_stacked) -> int:
+    return jax.tree_util.tree_leaves(w0_stacked)[0].shape[0]

@@ -226,3 +226,160 @@ class TestStreamingSweepAPI:
             np.testing.assert_allclose(
                 np.asarray(multi.weights)[k], np.asarray(s.weights),
                 rtol=1e-7, atol=1e-10)
+
+
+class TestMultiWarmAndCheckpoint:
+    """Segmented / checkpointed multi-lane runs must be invisible to the
+    math: warm chains equal one uninterrupted run per lane, converged
+    lanes stay frozen across resumes, and a mid-run kill resumes
+    exactly."""
+
+    def _pieces(self, rng, regs, n=400, d=7):
+        X, y = _problem(rng, n=n, d=d)
+        g = losses.LogisticGradient()
+        Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+        @jax.jit
+        def sm(W):
+            ls, gs, nn = jax.vmap(
+                lambda w: g.batch_loss_and_grad(w, Xd, yd))(W)
+            nf = jnp.asarray(nn[0], ls.dtype)
+            return ls / nf, gs / nf
+
+        pxm, rvm = host_agd.make_prox_multi(prox.SquaredL2Updater(),
+                                            regs)
+        W0 = jnp.stack([jnp.zeros(d)] * len(regs))
+        return sm, pxm, rvm, W0
+
+    def test_two_segments_equal_one_run(self, rng):
+        sm, pxm, rvm, W0 = self._pieces(rng, REGS)
+        cfg3 = agd.AGDConfig(num_iterations=3, convergence_tol=0.0)
+        cfg6 = agd.AGDConfig(num_iterations=6, convergence_tol=0.0)
+        seg1 = host_agd.run_agd_host_multi(sm, pxm, rvm, W0, cfg3)
+        seg2 = host_agd.run_agd_host_multi(
+            sm, pxm, rvm, W0, cfg3, warm=host_agd.multi_warm_state(seg1))
+        full = host_agd.run_agd_host_multi(sm, pxm, rvm, W0, cfg6)
+        np.testing.assert_allclose(np.asarray(seg2.weights),
+                                   np.asarray(full.weights),
+                                   rtol=1e-12, atol=1e-15)
+        np.testing.assert_allclose(
+            np.vstack([seg1.loss_history, seg2.loss_history]),
+            full.loss_history, rtol=1e-12)
+        # counters CONTINUE across the warm boundary (seg2 reports
+        # cumulative totals) and land on the uninterrupted counts
+        assert np.all(seg1.num_backtracks <= seg2.num_backtracks)
+        np.testing.assert_array_equal(seg2.num_backtracks,
+                                      full.num_backtracks)
+        np.testing.assert_array_equal(seg2.num_restarts,
+                                      full.num_restarts)
+
+    def test_converged_lanes_stay_frozen_across_segments(self, rng):
+        sm, pxm, rvm, W0 = self._pieces(rng, REGS)
+        cfg = agd.AGDConfig(num_iterations=12, convergence_tol=3e-3)
+        seg1 = host_agd.run_agd_host_multi(sm, pxm, rvm, W0, cfg)
+        assert np.asarray(seg1.converged).any(), "need an early stop"
+        w_frozen = np.asarray(seg1.weights)[
+            np.asarray(seg1.converged)].copy()
+        seg2 = host_agd.run_agd_host_multi(
+            sm, pxm, rvm, W0,
+            agd.AGDConfig(num_iterations=5, convergence_tol=3e-3),
+            warm=host_agd.multi_warm_state(seg1))
+        np.testing.assert_array_equal(
+            np.asarray(seg2.weights)[np.asarray(seg1.converged)],
+            w_frozen)
+        assert np.all(np.asarray(seg2.num_iters)[
+            np.asarray(seg1.converged)] == 0)
+
+    def test_checkpointed_equals_uninterrupted(self, rng, tmp_path):
+        from spark_agd_tpu.utils import checkpoint as ckpt
+
+        sm, pxm, rvm, W0 = self._pieces(rng, REGS)
+        cfg = agd.AGDConfig(num_iterations=9, convergence_tol=0.0)
+        out = ckpt.run_agd_multi_checkpointed(
+            sm, pxm, rvm, W0, cfg, path=str(tmp_path / "m.npz"),
+            segment_iters=2)
+        full = host_agd.run_agd_host_multi(sm, pxm, rvm, W0, cfg)
+        np.testing.assert_allclose(np.asarray(out.weights),
+                                   np.asarray(full.weights),
+                                   rtol=1e-12, atol=1e-15)
+        np.testing.assert_allclose(out.loss_history, full.loss_history,
+                                   rtol=1e-12)
+        np.testing.assert_array_equal(out.num_iters, full.num_iters)
+        # rerun = no-op resume (terminal by iteration cap)
+        out2 = ckpt.run_agd_multi_checkpointed(
+            sm, pxm, rvm, W0, cfg, path=str(tmp_path / "m.npz"),
+            segment_iters=2)
+        np.testing.assert_array_equal(out2.resumed_from, out.num_iters)
+        np.testing.assert_allclose(np.asarray(out2.weights),
+                                   np.asarray(out.weights))
+
+    def test_kill_mid_run_resumes_exactly(self, rng, tmp_path):
+        """Simulated kill: run HALF the segments (a smaller cap),
+        then rerun with the full cap at the SAME path — must land on
+        the uninterrupted answer."""
+        from spark_agd_tpu.utils import checkpoint as ckpt
+
+        sm, pxm, rvm, W0 = self._pieces(rng, REGS)
+        path = str(tmp_path / "k.npz")
+        cfg_half = agd.AGDConfig(num_iterations=4, convergence_tol=0.0)
+        cfg_full = agd.AGDConfig(num_iterations=9, convergence_tol=0.0)
+        ckpt.run_agd_multi_checkpointed(
+            sm, pxm, rvm, W0, cfg_half, path=path, segment_iters=2)
+        out = ckpt.run_agd_multi_checkpointed(
+            sm, pxm, rvm, W0, cfg_full, path=path, segment_iters=2)
+        assert int(out.resumed_from.max()) == 4
+        full = host_agd.run_agd_host_multi(
+            sm, pxm, rvm, W0, cfg_full)
+        np.testing.assert_allclose(np.asarray(out.weights),
+                                   np.asarray(full.weights),
+                                   rtol=1e-12, atol=1e-15)
+        np.testing.assert_allclose(out.loss_history, full.loss_history,
+                                   rtol=1e-12)
+
+    def test_checkpoint_with_mid_run_convergence(self, rng, tmp_path):
+        """r3 review: lanes that converge in an EARLY segment must
+        forward-fill their converged loss (not NaN) in the cumulative
+        checkpointed history, exactly like an uninterrupted run."""
+        from spark_agd_tpu.utils import checkpoint as ckpt
+
+        sm, pxm, rvm, W0 = self._pieces(rng, REGS)
+        cfg = agd.AGDConfig(num_iterations=20, convergence_tol=3e-3)
+        out = ckpt.run_agd_multi_checkpointed(
+            sm, pxm, rvm, W0, cfg, path=str(tmp_path / "c.npz"),
+            segment_iters=3)
+        full = host_agd.run_agd_host_multi(sm, pxm, rvm, W0, cfg)
+        assert np.asarray(full.converged).any(), "need an early stop"
+        assert np.isfinite(out.loss_history).all(), (
+            "stopped lanes must forward-fill, not NaN")
+        np.testing.assert_allclose(out.loss_history, full.loss_history,
+                                   rtol=1e-12)
+        np.testing.assert_array_equal(out.num_iters, full.num_iters)
+        np.testing.assert_allclose(np.asarray(out.weights),
+                                   np.asarray(full.weights),
+                                   rtol=1e-12, atol=1e-15)
+
+    def test_single_loader_rejects_multi_file(self, rng, tmp_path):
+        from spark_agd_tpu.utils import checkpoint as ckpt
+
+        sm, pxm, rvm, W0 = self._pieces(rng, [0.1])
+        path = str(tmp_path / "mx.npz")
+        cfg = agd.AGDConfig(num_iterations=2, convergence_tol=0.0)
+        ckpt.run_agd_multi_checkpointed(sm, pxm, rvm, W0, cfg,
+                                        path=path, segment_iters=2)
+        with pytest.raises(ValueError, match="MULTI-lane"):
+            ckpt.load_checkpoint(path, W0)
+
+    def test_fingerprint_guard(self, rng, tmp_path):
+        from spark_agd_tpu.utils import checkpoint as ckpt
+
+        sm, pxm, rvm, W0 = self._pieces(rng, REGS)
+        path = str(tmp_path / "fp.npz")
+        cfg = agd.AGDConfig(num_iterations=2, convergence_tol=0.0)
+        ckpt.run_agd_multi_checkpointed(sm, pxm, rvm, W0, cfg,
+                                        path=path, segment_iters=2)
+        with pytest.raises(ValueError, match="different problem"):
+            ckpt.run_agd_multi_checkpointed(
+                sm, pxm, rvm, W0,
+                agd.AGDConfig(num_iterations=2, convergence_tol=0.0,
+                              l0=123.0),
+                path=path, segment_iters=2)
