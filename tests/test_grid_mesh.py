@@ -384,3 +384,32 @@ class TestMeshFuzz:
         np.testing.assert_allclose(np.asarray(w_m), np.asarray(w_1),
                                    rtol=1e-7, atol=1e-10,
                                    err_msg=str(kw))
+
+
+class TestMeshCVPostHocScoring:
+    def test_cv_validation_scores_over_mesh_result(self, problem, mesh8):
+        """Post-hoc metric scorers consume a MESH CVResult identically
+        to a single-device one (the returned fold_ids/base_mask/
+        train_result are global structures either way)."""
+        from spark_agd_tpu.models.evaluation import (
+            cv_validation_scores, roc_auc)
+
+        X, y, w0 = problem
+        kw = dict(n_folds=3, num_iterations=4, convergence_tol=0.0,
+                  initial_weights=w0, seed=5)
+        cv_m = api.cross_validate((X, y), losses.LogisticGradient(),
+                                  prox.SquaredL2Updater(), [0.05, 0.5],
+                                  mesh=mesh8, **kw)
+        cv_1 = api.cross_validate((X, y), losses.LogisticGradient(),
+                                  prox.SquaredL2Updater(), [0.05, 0.5],
+                                  mesh=False, **kw)
+        per_m, mean_m = cv_validation_scores(cv_m, X, y,
+                                             score_fn=roc_auc)
+        per_1, mean_1 = cv_validation_scores(cv_1, X, y,
+                                             score_fn=roc_auc)
+        assert per_m.shape == (3, 2)
+        np.testing.assert_allclose(np.asarray(per_m), np.asarray(per_1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(mean_m),
+                                   np.asarray(mean_1),
+                                   rtol=1e-5, atol=1e-6)
