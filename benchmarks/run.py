@@ -218,6 +218,7 @@ def run_config(config: BenchConfig, scale: float, iters: int,
         "scale": scale,
         "dtype": dtype,
         "pallas": bool(use_pallas and config.pallas_ok),
+        "measured_at_unix": round(time.time(), 1),
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
         "iters": n_iters,
