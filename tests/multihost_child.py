@@ -54,6 +54,7 @@ def main():
     if len(sys.argv) > 4:
         _ingest_check(sys.argv[4], mesh)
         _sparse_ingest_check(sys.argv[4], mesh)
+        _grid_check(mesh)
     print(f"CHILD_OK pid={pid} psum={float(total)}", flush=True)
 
 
@@ -142,6 +143,64 @@ def _sparse_ingest_check(part_dir, mesh):
                                np.asarray(w_ref), rtol=1e-4, atol=1e-6)
     print(f"SPARSE_INGEST_OK pid={jax.process_index()} "
           f"rows={batch.X.shape[0]}", flush=True)
+
+
+def _grid_check(mesh):
+    """Mesh-composed grid fits across PROCESS boundaries: the vmapped
+    lanes + psum inside the shard_map must produce the single-device
+    answer when the data axis spans two interpreters.  Data is
+    deterministic and identical on every host, so ``shard_batch``'s
+    ``device_put`` places one consistent global batch (each process
+    commits its addressable shards)."""
+    from spark_agd_tpu import api
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import SquaredL2Updater
+
+    rng = np.random.default_rng(11)
+    n, d = 96, 6
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w0 = np.zeros(d, np.float32)
+    regs = [0.05, 0.5]
+    kw = dict(num_iterations=3, convergence_tol=0.0,
+              initial_weights=w0)
+
+    res = api.sweep((X, y), LogisticGradient(), SquaredL2Updater(),
+                    regs, mesh=mesh, **kw)
+    # single-device reference: every child computes it locally
+    ref = api.sweep((X, y), LogisticGradient(), SquaredL2Updater(),
+                    regs, mesh=False, **kw)
+    np.testing.assert_array_equal(np.asarray(res.num_iters),
+                                  np.asarray(ref.num_iters))
+    np.testing.assert_allclose(np.asarray(res.loss_history),
+                               np.asarray(ref.loss_history),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(res.weights),
+                               np.asarray(ref.weights),
+                               rtol=1e-4, atol=1e-6)
+
+    cv = api.cross_validate((X, y), LogisticGradient(),
+                            SquaredL2Updater(), regs, n_folds=2,
+                            mesh=mesh, seed=4, **kw)
+    cv1 = api.cross_validate((X, y), LogisticGradient(),
+                             SquaredL2Updater(), regs, n_folds=2,
+                             mesh=False, seed=4, **kw)
+    np.testing.assert_allclose(np.asarray(cv.val_loss),
+                               np.asarray(cv1.val_loss),
+                               rtol=1e-5, atol=1e-7)
+    assert int(cv.best_index) == int(cv1.best_index)
+
+    wg, hg = api.run_minibatch_sgd(
+        (X, y), LogisticGradient(), SquaredL2Updater(), mesh=mesh,
+        step_size=0.5, num_iterations=4, minibatch_fraction=0.5,
+        seed=2, initial_weights=w0)
+    wg1, hg1 = api.run_minibatch_sgd(
+        (X, y), LogisticGradient(), SquaredL2Updater(), mesh=False,
+        step_size=0.5, num_iterations=4, minibatch_fraction=0.5,
+        seed=2, initial_weights=w0)
+    np.testing.assert_allclose(np.asarray(hg), np.asarray(hg1),
+                               rtol=1e-5, atol=1e-7)
+    print(f"GRID_OK pid={jax.process_index()}", flush=True)
 
 
 if __name__ == "__main__":

@@ -121,6 +121,7 @@ class TestTwoProcess:
             assert "CHILD_OK" in out, out
             assert "INGEST_OK" in out, out
             assert "SPARSE_INGEST_OK" in out, out
+            assert "GRID_OK" in out, out
         assert "pid=0" in outs[0][1] and "pid=1" in outs[1][1]
 
 
