@@ -65,3 +65,56 @@ def test_garbage_configs_fail_before_stages(cpu_ok):
     with pytest.raises(SystemExit) as exc:
         tpu_all.main(["--configs", "1,oops"])
     assert exc.value.code == 2
+
+
+class TestArtifactReuse:
+    """--reuse-artifacts: partial claim windows accumulate across
+    cycles instead of re-running finished on-chip work."""
+
+    def test_artifact_ok_accepts_healthy_tpu_record(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        with open("a.json", "w") as f:
+            f.write(json.dumps({"value": 1.0, "platform": "tpu",
+                                "error": None}) + "\n")
+        assert tpu_all.artifact_ok("a.json")
+
+    def test_artifact_ok_rejects_cpu_error_and_failed(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        cases = [
+            {"platform": "cpu", "value": 1.0},          # wrong backend
+            {"platform": "tpu", "error": "degraded"},   # errored
+            {"check": "x", "ok": False},                # failed check
+        ]
+        for i, rec in enumerate(cases):
+            with open(f"c{i}.json", "w") as f:
+                f.write(json.dumps(rec) + "\n")
+            assert not tpu_all.artifact_ok(f"c{i}.json"), rec
+        assert not tpu_all.artifact_ok("missing.json")
+        with open("short.json", "w") as f:
+            f.write(json.dumps({"check": "env", "ok": True,
+                                "platform": "tpu"}) + "\n")
+        assert not tpu_all.artifact_ok("short.json", min_rows=2)
+
+    def test_configs_done_requires_all_dtypes(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        rows = [
+            {"config": 1, "dtype": "f32", "platform": "tpu"},
+            {"config": 1, "dtype": "bf16", "platform": "tpu"},
+            {"config": 2, "dtype": "f32", "platform": "tpu"},
+            {"config": 3, "dtype": "f32", "platform": "tpu",
+             "error": "boom"},
+            {"config": 3, "dtype": "bf16", "platform": "tpu"},
+            {"config": 4, "dtype": "f32", "platform": "cpu"},
+            {"config": 4, "dtype": "bf16", "platform": "tpu"},
+        ]
+        with open("cfg.json", "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        done = tpu_all.configs_done("cfg.json", ["f32", "bf16"])
+        # 1: both dtypes healthy; 2: missing bf16; 3: errored f32;
+        # 4: f32 measured on the wrong backend
+        assert done == {1}
+        assert tpu_all.configs_done("missing.json", ["f32"]) == set()

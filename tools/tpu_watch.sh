@@ -33,7 +33,7 @@ while true; do
       printf '{"inflight": "interpreter-start", "inflight_since_unix": %s}\n' "$(date +%s)" > "TPU_PROBE_${TAG}.json"
     fi
   fi
-  timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag "$TAG" >> "$LOG" 2>&1
+  timeout ${TPU_CYCLE_TIMEOUT:-10800} python tpu_all.py --tag "$TAG" --reuse-artifacts >> "$LOG" 2>&1
   rc=$?
   echo "=== cycle $n end rc=$rc $(date -u +%H:%M:%S) ===" >> "$LOG"
   if [ -f "BENCH_MANUAL_${TAG}.json" ] && [ -f "TPU_CHECKS_${TAG}.json" ] && [ -f "BENCH_CONFIGS_${TAG}.json" ]; then
