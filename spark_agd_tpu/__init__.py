@@ -30,6 +30,16 @@ cross-validation (``cross_validate``), jitted evaluation metrics
 (``models.evaluation``), model persistence, larger-than-HBM streaming
 that composes with the mesh for dense AND sparse data, and fused
 single-HBM-pass Pallas kernels.
+
+Grid fits compose with EVERYTHING (round 3): lanes vmapped inside the
+shard_map so sweeps/CV run on the full mesh (``parallel.grid``); the
+GD oracle runs sharded with globally consistent sampling; K-lane
+lock-step host AGD trains a whole path over a STREAM on one stream
+read per trial (``streaming_sweep``), scores K candidates in one pass
+(``make_streaming_eval_multi``), and survives kills via per-lane
+checkpoints (``utils.checkpoint.run_agd_multi_checkpointed``).  See
+``docs/DISTRIBUTED.md`` for the full composition matrix, each cell
+named with its test.
 """
 
 __version__ = "0.1.0"
