@@ -59,6 +59,7 @@ from .api import (  # noqa: F401
     run_minibatch_sgd,
     CVResult,
     cross_validate,
+    make_cv_runner,
     make_sweep_runner,
     streaming_sweep,
     sweep,

@@ -411,6 +411,34 @@ class CVResult(NamedTuple):
     #                           cv_validation_scores) default to it
 
 
+def make_cv_runner(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    n_folds: int = 5,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    l0: float = 1.0,
+    l_exact: float = math.inf,
+    beta: float = 0.5,
+    alpha: float = 0.9,
+    may_restart: bool = True,
+    *,
+    mesh=False,
+    loss_mode: str = "x",
+    seed: int = 0,
+):
+    """Build ``fit(initial_weights, reg_params) -> CVResult``, compiled
+    once per grid SHAPE — the ``make_sweep_runner`` twin of
+    :func:`cross_validate` for repeated CV (refined grids, warm-start
+    studies).  Repeated calls with the same number of strengths reuse
+    one executable; the fold assignment (``seed``) and data staging
+    happen once, at build time."""
+    return _build_cv(data, gradient, updater, n_folds, convergence_tol,
+                     num_iterations, l0, l_exact, beta, alpha,
+                     may_restart, mesh, loss_mode, seed)
+
+
 def cross_validate(
     data: Data,
     gradient: Gradient,
@@ -466,17 +494,23 @@ def cross_validate(
     cannot follow the nnz-balanced row permutation); see
     ``parallel.grid.make_mesh_cv_fit``.
     """
-    if initial_weights is None:
-        raise ValueError("initial_weights is required")
+    fit = make_cv_runner(
+        data, gradient, updater, n_folds=n_folds,
+        convergence_tol=convergence_tol,
+        num_iterations=num_iterations, l0=l0, l_exact=l_exact,
+        beta=beta, alpha=alpha, may_restart=may_restart, mesh=mesh,
+        loss_mode=loss_mode, seed=seed)
+    return fit(initial_weights, reg_params)
+
+
+def _build_cv(data, gradient, updater, n_folds, convergence_tol,
+              num_iterations, l0, l_exact, beta, alpha, may_restart,
+              mesh, loss_mode, seed):
+    """Shared CV builder: stage data, assign folds, and compile the
+    lane grid ONCE; the returned ``fit(initial_weights, reg_params)``
+    reuses one executable per grid shape."""
     if n_folds < 2:
         raise ValueError("n_folds must be >= 2")
-
-    regs = jnp.asarray(reg_params, jnp.float32)
-    if regs.ndim != 1:
-        raise ValueError("reg_params must be 1-D")
-    n_regs = regs.shape[0]
-    fold_lane = jnp.repeat(jnp.arange(n_folds, dtype=jnp.int32), n_regs)
-    reg_lane = jnp.tile(regs, n_folds)
     cfg = agd.AGDConfig(
         convergence_tol=convergence_tol, num_iterations=num_iterations,
         l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
@@ -490,23 +524,6 @@ def cross_validate(
         perm = jax.random.permutation(jax.random.PRNGKey(seed), n)
         return jnp.zeros(n, jnp.int32).at[perm].set(
             jnp.arange(n, dtype=jnp.int32) % n_folds)
-
-    def _collect(val_flat, res_flat, fold_ids, base_mask):
-        val_loss = val_flat.reshape(n_folds, n_regs)
-        train_result = jax.tree_util.tree_map(
-            lambda a: a.reshape((n_folds, n_regs) + a.shape[1:]),
-            res_flat)
-        # nanmean: a fold emptied by the base mask reports NaN (see
-        # _mean_loss) and must not poison every strength's average; a
-        # strength with NO valid fold stays NaN and argmin will not pick
-        # it (NaN comparisons are false) unless ALL are NaN — callers
-        # refitting on best_index must check finiteness (the model layer
-        # does).
-        mean_val = jnp.nanmean(val_loss, axis=0)
-        return CVResult(val_loss=val_loss, train_result=train_result,
-                        mean_val_loss=mean_val,
-                        best_index=jnp.argmin(mean_val),
-                        fold_ids=fold_ids, base_mask=base_mask)
 
     m, batch, csr_raw = _resolve_fit_mesh(data, mesh)
     # Sparse CSR input with the AUTO mesh default (mesh=None) falls back
@@ -535,41 +552,71 @@ def cross_validate(
                                          np.asarray(base_mask))
             fids_sharded = grid.shard_row_array(
                 m, np.asarray(fold_ids), batch.y.shape[0], fill=-1)
-        fit = grid.make_mesh_cv_fit(gradient, updater, batch,
-                                    fids_sharded, m, cfg)
-        val_flat, res_flat = fit(fold_lane, reg_lane, initial_weights)
-        return _collect(val_flat, res_flat, fold_ids, base_mask)
+        mesh_fit = grid.make_mesh_cv_fit(gradient, updater, batch,
+                                         fids_sharded, m, cfg)
+        run = mesh_fit
+    else:
+        X, y, base_mask = _normalize_data(data)
+        n = X.shape[0]
+        if not isinstance(X, CSRMatrix):
+            X = jnp.asarray(X)
+        y = jnp.asarray(y)
+        base_mask = (jnp.ones(n, jnp.float32) if base_mask is None
+                     else jnp.asarray(base_mask, jnp.float32))
+        X, y, _ = gradient.prepare(X, y, None)
+        if getattr(X, "shape", (None,))[0] != n:
+            raise ValueError(
+                "cross_validate drives masks through the kernels, so a "
+                "gradient whose prepare() re-pads rows (e.g. the fused "
+                "Pallas layouts) is not supported here; use the plain "
+                "XLA gradients")
+        fold_ids = _fold_assignment(n)
 
-    X, y, base_mask = _normalize_data(data)
-    n = X.shape[0]
-    if not isinstance(X, CSRMatrix):
-        X = jnp.asarray(X)
-    y = jnp.asarray(y)
-    base_mask = (jnp.ones(n, jnp.float32) if base_mask is None
-                 else jnp.asarray(base_mask, jnp.float32))
-    X, y, _ = gradient.prepare(X, y, None)
-    if getattr(X, "shape", (None,))[0] != n:
-        raise ValueError(
-            "cross_validate drives masks through the kernels, so a "
-            "gradient whose prepare() re-pads rows (e.g. the fused "
-            "Pallas layouts) is not supported here; use the plain "
-            "XLA gradients")
+        def fit_one(fold_k, reg, w0):
+            train_mask = base_mask * (fold_ids != fold_k)
+            val_mask = base_mask * (fold_ids == fold_k)
+            sm = lambda w: gradient.mean_loss_and_grad(w, X, y,
+                                                       train_mask)
+            sl = lambda w: _mean_loss(gradient, w, X, y, train_mask)
+            px, rv = smooth_lib.make_prox(updater, reg)
+            res = agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
+            val = _mean_loss(gradient, res.weights, X, y, val_mask)
+            return val, res
 
-    fold_ids = _fold_assignment(n)
-    w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        step = jax.jit(jax.vmap(fit_one, in_axes=(0, 0, None)))
 
-    def fit_one(fold_k, reg):
-        train_mask = base_mask * (fold_ids != fold_k)
-        val_mask = base_mask * (fold_ids == fold_k)
-        sm = lambda w: gradient.mean_loss_and_grad(w, X, y, train_mask)
-        sl = lambda w: _mean_loss(gradient, w, X, y, train_mask)
-        px, rv = smooth_lib.make_prox(updater, reg)
-        res = agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
-        val = _mean_loss(gradient, res.weights, X, y, val_mask)
-        return val, res
+        def run(fold_lane, reg_lane, initial_weights):
+            w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+            return step(fold_lane, reg_lane, w0)
 
-    val_flat, res_flat = jax.jit(jax.vmap(fit_one))(fold_lane, reg_lane)
-    return _collect(val_flat, res_flat, fold_ids, base_mask)
+    def fit(initial_weights, reg_params):
+        if initial_weights is None:
+            raise ValueError("initial_weights is required")
+        regs = jnp.asarray(reg_params, jnp.float32)
+        if regs.ndim != 1:
+            raise ValueError("reg_params must be 1-D")
+        n_regs = regs.shape[0]
+        fold_lane = jnp.repeat(jnp.arange(n_folds, dtype=jnp.int32),
+                               n_regs)
+        reg_lane = jnp.tile(regs, n_folds)
+        val_flat, res_flat = run(fold_lane, reg_lane, initial_weights)
+        val_loss = val_flat.reshape(n_folds, n_regs)
+        train_result = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_folds, n_regs) + a.shape[1:]),
+            res_flat)
+        # nanmean: a fold emptied by the base mask reports NaN (see
+        # _mean_loss) and must not poison every strength's average; a
+        # strength with NO valid fold stays NaN and argmin will not
+        # pick it (NaN comparisons are false) unless ALL are NaN —
+        # callers refitting on best_index must check finiteness (the
+        # model layer does).
+        mean_val = jnp.nanmean(val_loss, axis=0)
+        return CVResult(val_loss=val_loss, train_result=train_result,
+                        mean_val_loss=mean_val,
+                        best_index=jnp.argmin(mean_val),
+                        fold_ids=fold_ids, base_mask=base_mask)
+
+    return fit
 
 
 def _mean_loss(gradient, w, X, y, mask):

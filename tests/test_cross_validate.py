@@ -216,3 +216,58 @@ class TestTrainerCV:
         assert m2 is None
         np.testing.assert_allclose(np.asarray(cv2.val_loss),
                                    np.asarray(cv.val_loss), rtol=1e-6)
+
+
+class TestMakeCVRunner:
+    def test_compile_once_across_grids(self, problem):
+        """Same grid SHAPE -> one trace; results equal the one-shot
+        cross_validate under the same seed."""
+        X, y, w0 = problem
+        traces = {"n": 0}
+
+        class Counting(losses.LogisticGradient):
+            def batch_loss_and_grad(self, wv, Xv, yv, mask=None):
+                traces["n"] += 1
+                return super().batch_loss_and_grad(wv, Xv, yv, mask)
+
+        fit = api.make_cv_runner(
+            (X, y), Counting(), prox.SquaredL2Updater(), n_folds=2,
+            num_iterations=3, convergence_tol=0.0, seed=7, mesh=False)
+        cv1 = fit(w0, [0.1, 0.5])
+        after_first = traces["n"]
+        cv2 = fit(w0, [0.2, 0.9])  # same shape: no new traces
+        assert traces["n"] == after_first
+        want = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.1, 0.5], n_folds=2, num_iterations=3,
+            convergence_tol=0.0, initial_weights=w0, seed=7, mesh=False)
+        np.testing.assert_allclose(np.asarray(cv1.val_loss),
+                                   np.asarray(want.val_loss), rtol=1e-6)
+        assert cv2.val_loss.shape == (2, 2)
+        assert np.all(np.isfinite(np.asarray(cv2.val_loss)))
+
+    def test_runner_on_mesh(self, problem, cpu_devices):
+        from spark_agd_tpu.parallel import mesh as mesh_lib
+
+        X, y, w0 = problem
+        mesh = mesh_lib.make_mesh({"data": 4}, devices=cpu_devices[:4])
+        fit = api.make_cv_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            n_folds=2, num_iterations=3, convergence_tol=0.0, seed=7,
+            mesh=mesh)
+        cv = fit(w0, [0.1, 0.5])
+        want = api.cross_validate(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            [0.1, 0.5], n_folds=2, num_iterations=3,
+            convergence_tol=0.0, initial_weights=w0, seed=7, mesh=False)
+        np.testing.assert_allclose(np.asarray(cv.val_loss),
+                                   np.asarray(want.val_loss),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_missing_weights_rejected(self, problem):
+        X, y, _ = problem
+        fit = api.make_cv_runner((X, y), losses.LogisticGradient(),
+                                 prox.SquaredL2Updater(), n_folds=2,
+                                 mesh=False)
+        with pytest.raises(ValueError, match="initial_weights"):
+            fit(None, [0.1])
