@@ -487,12 +487,15 @@ def cross_validate(
     BOTH training and validation everywhere.
 
     ``mesh=False`` (default) runs single-device.  Pass a ``Mesh`` /
-    ``None`` / a dense ``ShardedBatch`` to shard rows over the mesh's
-    ``data`` axis — lanes vmapped inside one shard_map
-    (``parallel.grid``), the cluster-wide grid the reference runs as
-    sequential jobs.  Sparse (CSR) mesh CV is not supported (fold ids
-    cannot follow the nnz-balanced row permutation); see
-    ``parallel.grid.make_mesh_cv_fit``.
+    ``None`` / a ``ShardedBatch`` (dense or nnz-balanced
+    ``RowShardedCSR``) to shard rows over the mesh's ``data`` axis —
+    lanes vmapped inside one shard_map (``parallel.grid``), the
+    cluster-wide grid the reference runs as sequential jobs.  Sparse
+    (CSR) fold ids follow the nnz-balanced row permutation through the
+    sharding's extras channel (``mesh.shard_csr_batch``), so raw-CSR
+    mesh CV matches the single-device fold assignment in input-row
+    order; a PRE-placed CSR batch assigns folds in its padded layout
+    order instead (see ``parallel.grid.make_mesh_cv_fit``).
     """
     fit = make_cv_runner(
         data, gradient, updater, n_folds=n_folds,
@@ -525,17 +528,17 @@ def _build_cv(data, gradient, updater, n_folds, convergence_tol,
         return jnp.zeros(n, jnp.int32).at[perm].set(
             jnp.arange(n, dtype=jnp.int32) % n_folds)
 
-    m, batch, csr_raw = _resolve_fit_mesh(data, mesh)
-    # Sparse CSR input with the AUTO mesh default (mesh=None) falls back
-    # to the single-device lane grid (which handles CSR fine) instead of
-    # hitting the mesh path's NotImplementedError — only an EXPLICIT
-    # mesh/ShardedBatch request surfaces that limitation.
-    if csr_raw and mesh is None:
-        m = None
+    m, batch, _ = _resolve_fit_mesh(data, mesh)
     if m is not None:
         from .parallel import grid
 
         if batch is not None:
+            # Pre-placed batch: assign folds in its (padded) row layout —
+            # for a RowShardedCSR that is the nnz-balanced permutation
+            # (not recoverable here), which is equivalent for the random
+            # uniform assignment; callers needing input-row-order folds
+            # shard with shard_csr_batch(extras={"fold_ids": ...}) and
+            # drive parallel.grid.make_mesh_cv_fit directly.
             n = batch.y.shape[0]  # padded layout; mask covers padding
             fold_ids = _fold_assignment(n)
             base_mask = (batch.mask if batch.mask is not None
@@ -548,10 +551,20 @@ def _build_cv(data, gradient, updater, n_folds, convergence_tol,
             fold_ids = _fold_assignment(n)
             base_mask = (jnp.ones(n, jnp.float32) if base_mask is None
                          else jnp.asarray(base_mask, jnp.float32))
-            batch = mesh_lib.shard_batch(m, X, y,
-                                         np.asarray(base_mask))
-            fids_sharded = grid.shard_row_array(
-                m, np.asarray(fold_ids), batch.y.shape[0], fill=-1)
+            if isinstance(X, CSRMatrix):
+                # fold ids ride the extras channel through the
+                # nnz-balanced row permutation, so they stay aligned to
+                # the permuted layout while matching the single-device
+                # assignment in input-row order
+                batch, placed = mesh_lib.shard_csr_batch(
+                    m, X, y, np.asarray(base_mask),
+                    extras={"fold_ids": np.asarray(fold_ids)})
+                fids_sharded = placed["fold_ids"]
+            else:
+                batch = mesh_lib.shard_batch(m, X, y,
+                                             np.asarray(base_mask))
+                fids_sharded = grid.shard_row_array(
+                    m, np.asarray(fold_ids), batch.y.shape[0], fill=-1)
         mesh_fit = grid.make_mesh_cv_fit(gradient, updater, batch,
                                          fids_sharded, m, cfg)
         run = mesh_fit

@@ -179,17 +179,17 @@ def make_mesh_cv_fit(
     ``fold_ids`` must be aligned to the batch's (padded) row layout and
     sharded like its rows; padded rows are excluded by the batch mask on
     BOTH the train and validation sides, exactly as in the
-    single-device path.  Dense batches only: a ``RowShardedCSR``'s
-    row permutation (nnz balancing) happens inside ``shard_csr_batch``,
-    which has no channel for per-row extras yet — use ``sweep`` with
-    manually masked folds for sparse mesh CV.
+    single-device path.  For a ``RowShardedCSR`` batch the aligned fold
+    ids come from the extras channel of the sharding itself —
+    ``shard_csr_batch(..., extras={"fold_ids": fids})`` scatters them
+    through the nnz-balancing row permutation (padding slots read the
+    fill value, which never equals a real fold id).
     """
     X, y, mask = batch
-    if isinstance(X, RowShardedCSR):
-        raise NotImplementedError(
-            "mesh cross-validation over RowShardedCSR is not supported "
-            "(fold ids cannot follow the nnz-balanced row permutation); "
-            "run a mesh sweep per fold with masked (X, y, mask) instead")
+    if isinstance(X, RowShardedCSR) and mask is None:
+        raise ValueError(
+            "RowShardedCSR requires its padding mask; build the batch "
+            "with parallel.mesh.shard_csr_batch")
     row = P(data_axis)
     base_mask = (jnp.ones(X.shape[0], jnp.float32) if mask is None
                  else mask)

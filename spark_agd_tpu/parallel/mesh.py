@@ -183,7 +183,9 @@ def shard_csr_batch(
     axis: str = DATA_AXIS,
     balance: bool = True,
     nnz_per_shard: Optional[int] = None,
-) -> ShardedBatch:
+    extras: Optional[Dict[str, Any]] = None,
+    extras_fill=-1,
+) -> "ShardedBatch | Tuple[ShardedBatch, Dict[str, jax.Array]]":
     """Shard a CSR batch's ROWS over the mesh ``axis`` (sparse DP).
 
     This is the sparse twin of :func:`shard_batch` — the capability the
@@ -213,6 +215,13 @@ def shard_csr_batch(
     deriving it from this batch — the streaming path passes one budget
     for EVERY macro-batch so all batches share a single compiled kernel
     shape.  Raises ``ValueError`` when the batch cannot fit the budget.
+
+    ``extras``: optional dict of per-row arrays (each ``(n_rows,)``, in
+    the INPUT row order) to carry through the nnz-balancing permutation
+    alongside ``y`` — e.g. cross-validation fold ids.  When given, the
+    return value is ``(ShardedBatch, placed_extras)`` where each placed
+    extra is row-sharded exactly like the batch's ``y``; padding slots
+    read ``extras_fill`` (and carry mask 0 regardless).
     """
     n_rows, n_features = X.shape
     if n_rows == 0:
@@ -234,15 +243,28 @@ def shard_csr_batch(
     lay = csr_shard_layout(
         row_ids, col_ids, values, np.asarray(y), mask, n_rows,
         n_features, mesh.shape[axis], balance=balance,
-        with_csc=X.has_csc or X.want_csc, nnz_per_shard=nnz_per_shard)
-    return place_csr_layout(lay, mesh, axis, n_rows, n_features)
+        with_csc=X.has_csc or X.want_csc, nnz_per_shard=nnz_per_shard,
+        extras=extras, extras_fill=extras_fill)
+    batch = place_csr_layout(lay, mesh, axis, n_rows, n_features)
+    if extras is None:
+        return batch
+    spec = NamedSharding(mesh, P(axis))
+    # flatten only the (shard, slot) leading dims — an (n_rows, k)
+    # extra keeps its trailing shape, rows sharded like y
+    placed = {name: jax.device_put(
+                  lay["E_" + name].reshape(
+                      (-1,) + lay["E_" + name].shape[2:]), spec)
+              for name in extras}
+    return batch, placed
 
 
 def csr_shard_layout(row_ids, col_ids, values, y, mask, n_rows: int,
                      n_features: int, n_shards: int, *,
                      balance: bool = True, with_csc: bool = False,
                      nnz_per_shard: Optional[int] = None,
-                     reduce_max=None) -> dict:
+                     reduce_max=None,
+                     extras: Optional[Dict[str, Any]] = None,
+                     extras_fill=-1) -> dict:
     """Pure-host (NumPy) construction of the per-shard CSR layout — the
     core of :func:`shard_csr_batch`, factored out so multi-host ingest
     (``data.ingest.from_partitioned_files_csr``) can build each host's
@@ -254,6 +276,14 @@ def csr_shard_layout(row_ids, col_ids, values, y, mask, n_rows: int,
     Returns ``dict(R, C, V[, Rc, Cc, Vc], Y, M, rps, nnz_shard)`` with
     2-D ``(n_shards, ...)`` arrays ready to flatten and place.
     """
+    for name, arr in (extras or {}).items():
+        # validate before the (expensive at url_combined scale) balance
+        # + sort + pad work below, so a wrong-length extra fails free
+        if np.asarray(arr).shape[:1] != (n_rows,):
+            raise ValueError(
+                f"extras[{name!r}] has "
+                f"{np.asarray(arr).shape[0] if np.asarray(arr).ndim else 0}"
+                f" rows, expected {n_rows}")
     red = reduce_max or (lambda v: int(v))
     rps = red(max(1, -(-n_rows // n_shards) if n_rows else 1))
 
@@ -329,6 +359,16 @@ def csr_shard_layout(row_ids, col_ids, values, y, mask, n_rows: int,
             np.ones(n_rows, np.float32) if mask is None
             else np.asarray(mask, np.float32))
     out.update(Y=Y, M=M)
+    # Per-row extras (e.g. CV fold ids) scatter along the SAME
+    # (shard, local-slot) assignment as y, so anything keyed to input
+    # rows survives the nnz-balancing permutation aligned to the batch.
+    for name, arr in (extras or {}).items():
+        arr = np.asarray(arr)  # shape validated up front
+        E = np.full((n_shards, rps) + arr.shape[1:], extras_fill,
+                    arr.dtype)
+        if n_rows:
+            E[shard_of_row, local_of_row] = arr
+        out["E_" + name] = E
     return out
 
 

@@ -21,6 +21,16 @@ from spark_agd_tpu.parallel import grid, mesh as mesh_lib
 REGS = [0.0, 0.05, 0.5]
 
 
+def csr_problem(rng, n=60, d=8, npr=3):
+    """A small random fixed-nnz-per-row CSR classification problem."""
+    indptr = np.arange(n + 1) * npr
+    X = sparse.CSRMatrix.from_csr_arrays(
+        indptr, rng.integers(0, d, n * npr).astype(np.int32),
+        rng.normal(size=n * npr).astype(np.float32), d)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    return X, y
+
+
 @pytest.fixture
 def problem(rng):
     # 300 rows: NOT divisible by 8, so the mesh path also exercises the
@@ -189,39 +199,112 @@ class TestMeshCV:
                                    np.asarray(cv_1.val_loss),
                                    rtol=1e-5, atol=1e-7)
 
-    def test_csr_auto_mesh_falls_back_to_single_device(self, rng):
-        """r3 review: CSR input with the AUTO mesh default (mesh=None on
-        a multi-device host — the class's default) must take the
-        single-device CV path, which handles CSR, not raise the mesh
-        path's NotImplementedError."""
+    def test_csr_mesh_matches_single_device(self, rng, mesh8):
+        """Raw-CSR mesh CV (fold ids threaded through the nnz-balanced
+        row permutation via the sharding's extras channel) reproduces
+        the single-device CSR CV — same input-row-order fold
+        assignment, same losses to reduction-order noise."""
+        X, y = csr_problem(rng)
+        kw = dict(n_folds=3, num_iterations=4, convergence_tol=0.0,
+                  initial_weights=np.zeros(8, np.float32), seed=5)
+        cv_m = api.cross_validate((X, y), losses.LogisticGradient(),
+                                  prox.SquaredL2Updater(), [0.05, 0.5],
+                                  mesh=mesh8, **kw)
+        cv_1 = api.cross_validate((X, y), losses.LogisticGradient(),
+                                  prox.SquaredL2Updater(), [0.05, 0.5],
+                                  mesh=False, **kw)
+        np.testing.assert_allclose(np.asarray(cv_m.val_loss),
+                                   np.asarray(cv_1.val_loss),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(cv_m.mean_val_loss),
+                                   np.asarray(cv_1.mean_val_loss),
+                                   rtol=1e-5, atol=1e-7)
+        assert int(cv_m.best_index) == int(cv_1.best_index)
+        np.testing.assert_array_equal(np.asarray(cv_m.fold_ids),
+                                      np.asarray(cv_1.fold_ids))
+
+    def test_csr_auto_mesh_distributes(self, rng):
+        """CSR input with the AUTO mesh default (mesh=None on a
+        multi-device host — the class's default) now takes the mesh CV
+        path, like sweep; r3 closed the extras-channel gap that used to
+        force a single-device fallback."""
         from spark_agd_tpu.ops.prox import SquaredL2Updater
 
-        n, d, npr = 60, 8, 3
-        indptr = np.arange(n + 1) * npr
-        X = sparse.CSRMatrix.from_csr_arrays(
-            indptr, rng.integers(0, d, n * npr).astype(np.int32),
-            rng.normal(size=n * npr).astype(np.float32), d)
-        y = (rng.random(n) < 0.5).astype(np.float32)
+        X, y = csr_problem(rng)
         opt = api.AcceleratedGradientDescent(losses.LogisticGradient(),
                                              SquaredL2Updater())
         opt.set_num_iterations(2).set_convergence_tol(0.0)
         cv = opt.cross_validate((X, y), [0.1, 1.0],
-                                np.zeros(d, np.float32), n_folds=2)
+                                np.zeros(8, np.float32), n_folds=2)
         assert cv.val_loss.shape == (2, 2)
         assert np.all(np.isfinite(np.asarray(cv.val_loss)))
 
-    def test_csr_mesh_cv_rejected_clearly(self, rng, mesh8):
-        n, d, npr = 64, 10, 3
+    def test_csr_preplaced_batch_cv_runs(self, rng, mesh8):
+        """A PRE-placed RowShardedCSR batch cross-validates too; folds
+        are assigned in the batch's padded layout order (documented),
+        so assert shape/finiteness, not fold equality."""
+        X, y = csr_problem(rng)
+        batch = mesh_lib.shard_csr_batch(mesh8, X, y)
+        cv = api.cross_validate(batch, losses.LogisticGradient(),
+                                prox.SquaredL2Updater(), [0.1, 1.0],
+                                n_folds=2, num_iterations=2,
+                                convergence_tol=0.0,
+                                initial_weights=np.zeros(8, np.float32))
+        assert cv.val_loss.shape == (2, 2)
+        assert np.all(np.isfinite(np.asarray(cv.val_loss)))
+
+
+class TestCsrExtrasChannel:
+    def test_extras_follow_the_row_permutation(self, rng, mesh8):
+        """shard_csr_batch(extras=...) scatters per-row arrays along the
+        same (shard, slot) assignment as y: wherever the mask is live,
+        the extra identifies its original row."""
+        n, d, npr = 53, 7, 2  # uneven: real padding slots exist
         indptr = np.arange(n + 1) * npr
         X = sparse.CSRMatrix.from_csr_arrays(
             indptr, rng.integers(0, d, n * npr).astype(np.int32),
             rng.normal(size=n * npr).astype(np.float32), d)
-        y = (rng.random(n) < 0.5).astype(np.float32)
-        with pytest.raises(NotImplementedError, match="nnz-balanced"):
-            api.cross_validate((X, y), losses.LogisticGradient(),
-                               prox.SquaredL2Updater(), [0.1],
-                               mesh=mesh8, n_folds=2,
-                               initial_weights=np.zeros(d, np.float32))
+        y = rng.standard_normal(n).astype(np.float32)
+        row_tag = np.arange(n, dtype=np.int32)
+        batch, placed = mesh_lib.shard_csr_batch(
+            mesh8, X, y, extras={"tag": row_tag})
+        tags = np.asarray(placed["tag"])
+        mask = np.asarray(batch.mask)
+        ys = np.asarray(batch.y)
+        live = mask > 0
+        assert live.sum() == n
+        # each live slot's tag names the input row whose y it carries
+        np.testing.assert_allclose(ys[live], y[tags[live]])
+        assert sorted(tags[live].tolist()) == list(range(n))
+        # padding slots read the fill value
+        assert np.all(tags[~live] == -1)
+
+    def test_multidim_extras_keep_trailing_shape(self, rng, mesh8):
+        """An (n_rows, k) extra flattens only its (shard, slot) leading
+        dims: placed shape is (padded_rows, k), rows aligned like y."""
+        n, d, npr, k = 21, 5, 2, 3
+        indptr = np.arange(n + 1) * npr
+        X = sparse.CSRMatrix.from_csr_arrays(
+            indptr, rng.integers(0, d, n * npr).astype(np.int32),
+            rng.normal(size=n * npr).astype(np.float32), d)
+        y = np.arange(n, dtype=np.float32)
+        side = np.stack([np.arange(n)] * k, axis=1).astype(np.float32)
+        batch, placed = mesh_lib.shard_csr_batch(
+            mesh8, X, y, extras={"side": side})
+        got = np.asarray(placed["side"])
+        ys = np.asarray(batch.y)
+        live = np.asarray(batch.mask) > 0
+        assert got.shape == (ys.shape[0], k)
+        # every live slot's k-vector names the same row its y names
+        np.testing.assert_allclose(got[live], np.stack([ys[live]] * k,
+                                                       axis=1))
+
+    def test_extras_shape_rejected(self, rng, mesh8):
+        X, y = csr_problem(rng, n=16)
+        with pytest.raises(ValueError, match="extras"):
+            mesh_lib.shard_csr_batch(
+                mesh8, X, y,
+                extras={"bad": np.arange(5, dtype=np.int32)})
 
 
 class TestShardRowArray:
