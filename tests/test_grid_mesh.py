@@ -259,11 +259,8 @@ class TestCsrExtrasChannel:
         """shard_csr_batch(extras=...) scatters per-row arrays along the
         same (shard, slot) assignment as y: wherever the mask is live,
         the extra identifies its original row."""
-        n, d, npr = 53, 7, 2  # uneven: real padding slots exist
-        indptr = np.arange(n + 1) * npr
-        X = sparse.CSRMatrix.from_csr_arrays(
-            indptr, rng.integers(0, d, n * npr).astype(np.int32),
-            rng.normal(size=n * npr).astype(np.float32), d)
+        n = 53  # uneven vs 8 shards: real padding slots exist
+        X, _ = csr_problem(rng, n=n, d=7, npr=2)
         y = rng.standard_normal(n).astype(np.float32)
         row_tag = np.arange(n, dtype=np.int32)
         batch, placed = mesh_lib.shard_csr_batch(
@@ -282,11 +279,8 @@ class TestCsrExtrasChannel:
     def test_multidim_extras_keep_trailing_shape(self, rng, mesh8):
         """An (n_rows, k) extra flattens only its (shard, slot) leading
         dims: placed shape is (padded_rows, k), rows aligned like y."""
-        n, d, npr, k = 21, 5, 2, 3
-        indptr = np.arange(n + 1) * npr
-        X = sparse.CSRMatrix.from_csr_arrays(
-            indptr, rng.integers(0, d, n * npr).astype(np.int32),
-            rng.normal(size=n * npr).astype(np.float32), d)
+        n, k = 21, 3
+        X, _ = csr_problem(rng, n=n, d=5, npr=2)
         y = np.arange(n, dtype=np.float32)
         side = np.stack([np.arange(n)] * k, axis=1).astype(np.float32)
         batch, placed = mesh_lib.shard_csr_batch(
