@@ -54,7 +54,10 @@ from .ops.losses import (  # noqa: F401
 )
 from .api import (  # noqa: F401
     AcceleratedGradientDescent,
+    LBFGS,
     run,
+    run_lbfgs,
+    make_lbfgs_runner,
     run_minibatch_agd,
     run_minibatch_sgd,
     CVResult,
@@ -66,6 +69,7 @@ from .api import (  # noqa: F401
     sweep_warm_state,
 )
 from .core.agd import AGDConfig, AGDResult  # noqa: F401
+from .core.lbfgs import LBFGSConfig, LBFGSResult  # noqa: F401
 from .parallel.mesh import (  # noqa: F401
     ShardedBatch,
     make_mesh,

@@ -99,6 +99,27 @@ def _resolve_fit_mesh(data: Data, mesh):
     return _resolve_mesh(mesh), None, csr_raw
 
 
+def _reconcile_runner_mesh(data: Data, mesh, dist_mode: str):
+    """Shared ``make_*_runner`` preamble (one copy of the mesh-dispatch
+    policy — per-site variants drifted into real bugs, r3 review):
+    normalize ``data``, recover a pre-placed batch's own mesh (an
+    explicit conflicting ``mesh`` raises), and force the explicit
+    shard_map mode for raw CSR (GSPMD cannot partition the segment-sum's
+    row-id indirection).  Returns ``(data, resolved_mesh, dist_mode)``."""
+    data = _normalize_data(data)
+    if isinstance(data, mesh_lib.ShardedBatch):
+        batch_mesh = _batch_mesh(data)
+        if mesh is None:
+            mesh = batch_mesh
+        elif mesh is not False and mesh != batch_mesh:
+            raise ValueError(
+                "explicit mesh differs from the ShardedBatch's mesh; "
+                "re-shard the batch or drop the mesh argument")
+    elif isinstance(data[0], CSRMatrix):
+        dist_mode = "shard_map"
+    return data, _resolve_mesh(mesh), dist_mode
+
+
 def _build_smooth(gradient, data, mesh, dist_mode):
     if mesh is None:
         if isinstance(data, mesh_lib.ShardedBatch):
@@ -146,25 +167,7 @@ def make_runner(
     steady-state benchmarking).  The runner returned here carries one
     ``jax.jit`` program; every ``fit`` after the first reuses it.
     """
-    data = _normalize_data(data)
-    if isinstance(data, mesh_lib.ShardedBatch):
-        # A pre-placed batch carries its own mesh; recover it rather than
-        # defaulting to an all-device mesh the batch may not live on.
-        batch_mesh = _batch_mesh(data)
-        if mesh is None:
-            mesh = batch_mesh
-        elif mesh is not False and mesh != batch_mesh:
-            raise ValueError(
-                "explicit mesh differs from the ShardedBatch's mesh; "
-                "re-shard the batch or drop the mesh argument")
-    if (not isinstance(data, mesh_lib.ShardedBatch)
-            and isinstance(data[0], CSRMatrix)):
-        # CSR rows shard over the data axis like dense rows do
-        # (mesh.shard_csr_batch, nnz-balanced); the GSPMD 'auto' mode
-        # cannot partition the segment-sum's row-id indirection, so the
-        # sparse mesh path always runs the explicit shard_map mode.
-        dist_mode = "shard_map"
-    m = _resolve_mesh(mesh)
+    data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
     sm, sl = _build_smooth(gradient, data, m, dist_mode)
     px, rv = smooth_lib.make_prox(updater, reg_param)
     cfg = agd.AGDConfig(
@@ -944,3 +947,168 @@ def run_minibatch_sgd(
         lambda w: gd.run_minibatch_sgd(
             gradient, updater, X, y, w, mask=mask, **kw))(w0)
     return res.weights, np.asarray(res.loss_history)
+
+
+def make_lbfgs_runner(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    num_corrections: int = 10,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    *,
+    grad_tol: float = 0.0,
+    mesh=None,
+    dist_mode: str = "shard_map",
+):
+    """Build ``fit(initial_weights) -> LBFGSResult``, compiled ONCE — the
+    quasi-Newton member of the reference's ``Optimizer`` family (MLlib
+    1.3's ``LBFGS``, the other optimizer the reference is drop-in
+    interchangeable with; SURVEY §1 L5).
+
+    The objective is the mean data loss plus the updater's SMOOTH
+    penalty folded in (value + gradient) — exactly MLlib LBFGS's
+    ``CostFun`` treatment of ``SquaredL2Updater``.  A prox-only updater
+    (``L1Updater`` and friends) is rejected up front: MLlib 1.3 has the
+    same limitation (no OWLQN yet); use AGD for non-smooth penalties.
+
+    ``mesh`` composes exactly as in :func:`make_runner`: the psum lives
+    inside the objective, so the identical fused minimizer (two-loop
+    recursion + Wolfe search as one ``lax.while_loop`` program,
+    ``core/lbfgs.py``) runs single-device or row-sharded.
+    """
+    from .core import lbfgs as lbfgs_lib, tvec
+
+    data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
+    if updater.smooth_penalty(jnp.zeros((), jnp.float32),
+                              float(reg_param)) is None:
+        raise ValueError(
+            f"{type(updater).__name__} has no smooth penalty: L-BFGS "
+            "needs a differentiable objective (MLlib 1.3's LBFGS has "
+            "the same limitation — no OWLQN); use "
+            "AcceleratedGradientDescent for prox-only penalties")
+    sm, _ = _build_smooth(gradient, data, m, dist_mode)
+    cfg = lbfgs_lib.LBFGSConfig(
+        num_corrections=num_corrections,
+        convergence_tol=convergence_tol,
+        num_iterations=num_iterations, grad_tol=grad_tol)
+
+    def objective(w):
+        f, g = sm(w)
+        pv, pg = updater.smooth_penalty(w, reg_param)  # non-None: the
+        # eager build-time check above rejected prox-only updaters
+        return f + pv, tvec.add(g, pg)
+
+    step = jax.jit(lambda w: lbfgs_lib.run_lbfgs(objective, w, cfg))
+
+    def fit(initial_weights):
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        if m is not None:
+            w0 = mesh_lib.replicate(w0, m)
+        return step(w0)
+
+    return fit
+
+
+def run_lbfgs(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    num_corrections: int = 10,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    reg_param: float = 0.0,
+    initial_weights: Any = None,
+    *,
+    grad_tol: float = 0.0,
+    mesh=None,
+    dist_mode: str = "shard_map",
+):
+    """Functional L-BFGS entry point — MLlib's ``LBFGS.runLBFGS``
+    equivalent, returning the full ``LBFGSResult`` (its ``(weights,
+    loss_history)`` pair plus the diagnostics MLlib discards)."""
+    if initial_weights is None:
+        raise ValueError("initial_weights is required")
+    fit = make_lbfgs_runner(
+        data, gradient, updater, num_corrections=num_corrections,
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        reg_param=reg_param, grad_tol=grad_tol, mesh=mesh,
+        dist_mode=dist_mode)
+    return fit(initial_weights)
+
+
+class LBFGS:
+    """Config-holder twin of MLlib 1.3's ``LBFGS(gradient, updater)`` —
+    the reference's ``Optimizer`` trait shape (``optimize(data,
+    initial_weights) -> weights``), so it swaps with
+    :class:`AcceleratedGradientDescent` the way the reference swaps with
+    MLlib's optimizers inside ``GeneralizedLinearAlgorithm`` callers."""
+
+    def __init__(self, gradient: Gradient, updater: Prox):
+        self._gradient = gradient
+        self._updater = updater
+        self._num_corrections = 10
+        self._convergence_tol = 1e-4
+        self._num_iterations = 100
+        self._reg_param = 0.0
+        self._grad_tol = 0.0
+        self._mesh = None
+        self._dist_mode = "shard_map"
+
+    def set_num_corrections(self, m: int):
+        self._num_corrections = int(m)
+        return self
+
+    def set_convergence_tol(self, tol: float):
+        self._convergence_tol = float(tol)
+        return self
+
+    def set_num_iterations(self, iters: int):
+        self._num_iterations = int(iters)
+        return self
+
+    def set_reg_param(self, reg_param: float):
+        self._reg_param = float(reg_param)
+        return self
+
+    def set_gradient(self, gradient: Gradient):
+        self._gradient = gradient
+        return self
+
+    def set_updater(self, updater: Prox):
+        self._updater = updater
+        return self
+
+    # TPU-specific knobs (beyond the MLlib surface)
+    def set_grad_tol(self, tol: float):
+        self._grad_tol = float(tol)
+        return self
+
+    def set_mesh(self, mesh):
+        self._mesh = mesh
+        return self
+
+    def set_dist_mode(self, dist_mode: str):
+        self._dist_mode = dist_mode
+        return self
+
+    # camelCase aliases for verbatim ports of MLlib call sites
+    setNumCorrections = set_num_corrections
+    setConvergenceTol = set_convergence_tol
+    setNumIterations = set_num_iterations
+    setRegParam = set_reg_param
+    setGradient = set_gradient
+    setUpdater = set_updater
+
+    def optimize(self, data: Data, initial_weights: Any):
+        res = run_lbfgs(
+            data, self._gradient, self._updater,
+            num_corrections=self._num_corrections,
+            convergence_tol=self._convergence_tol,
+            num_iterations=self._num_iterations,
+            reg_param=self._reg_param,
+            initial_weights=initial_weights,
+            grad_tol=self._grad_tol, mesh=self._mesh,
+            dist_mode=self._dist_mode)
+        return res.weights

@@ -1,0 +1,390 @@
+"""Fused TPU-native L-BFGS: the Optimizer family's quasi-Newton member.
+
+The reference's ``AcceleratedGradientDescent`` implements spark-mllib
+1.3.0's ``Optimizer`` trait precisely so it can be swapped with MLlib's
+other optimizers inside ``GeneralizedLinearAlgorithm``-style callers —
+the reference class doc names the family (reference
+``AcceleratedGradientDescent.scala:41-42`` extends ``Optimizer``; SURVEY
+§1 L5: "drop-in interchangeable with MLlib's own GradientDescent /
+LBFGS").  ``core/gd.py`` provides the GD member; this module provides
+the L-BFGS member, so a migrating user finds the whole
+``mllib.optimization`` optimizer menu.
+
+MLlib 1.3's LBFGS wraps Breeze's: an m-pair two-loop recursion over a
+``CostFun`` whose ``calculate`` is one treeAggregate pass (the same
+broadcast + tree-reduce round-trip as AGD's ``applySmooth``), with a
+strong-Wolfe line search costing 1-3 more such round-trips per
+iteration.  The TPU inversion is the same as ``core/agd.py``: the
+whole minimizer — two-loop recursion, Wolfe bracketing/zoom, curvature
+updates, convergence test — is ONE ``lax.while_loop`` program; every
+objective evaluation is the ``smooth`` callable the caller built, so
+the identical core runs single-device or mesh-sharded (the psum lives
+inside ``smooth``) and control flow stays coherent across devices
+because every decision scalar is post-reduction.
+
+Semantic choices, pinned to the MLlib/Breeze (0.11.x, the spark-1.3 pin)
+behavior they mirror:
+
+- ``num_corrections`` (default 10) — MLlib ``LBFGS.setNumCorrections``
+  default; history pairs live in fixed ``(m, ...)`` ring buffers so the
+  compiled shape is static.
+- curvature-pair safeguard: a pair with ``s·y <= 1e-10·‖s‖·‖y‖`` is
+  SKIPPED (ring not advanced), the standard positive-definiteness guard.
+- line search: strong Wolfe (c1=1e-4, c2=0.9 — Nocedal-Wright alg 3.5/
+  3.6 with bisection zoom, the same conditions Breeze's
+  ``StrongWolfeLineSearch`` enforces), bounded by ``max_ls_steps``.
+- convergence: relative-improvement test
+  ``(f_old - f_new) / max(|f_old|, |f_new|, 1) <= tol`` — Breeze's
+  ``FirstOrderMinimizer`` improvement check that MLlib's
+  ``convergenceTol`` parameterizes; plus an optional gradient-norm stop
+  (``grad_tol``, off by default like MLlib).
+- a failed line search (no Wolfe point within budget) stops the run
+  with ``ls_failed`` set — Breeze throws ``LineSearchFailed``; an
+  error flag composes better with vmapped lanes than an abort.
+- non-finite objective aborts, like the AGD NaN guard (reference
+  ``:309-312``).
+
+The smooth penalty (L2) folds INTO the objective — gradient
+``reg·w`` added to the data gradient — exactly how MLlib's LBFGS
+``CostFun`` handles ``SquaredL2Updater`` regularization; L1 is not
+representable this way and MLlib 1.3 has the same limitation (OWLQN
+arrived later), which the API layer surfaces as an explicit error.
+
+``loss_history[0]`` is the objective at ``w0``; entry ``i >= 1`` is the
+objective after iteration ``i`` (NaN-padded past ``num_iters``), so
+``len == iterations executed + 1``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import tvec
+
+ObjectiveFn = Callable[[Any], Tuple[jax.Array, Any]]
+
+
+@dataclass(frozen=True)
+class LBFGSConfig:
+    """MLlib ``LBFGS``'s four knobs (their 1.3.0 defaults) plus the
+    bounded-loop extras the fused form needs."""
+
+    num_corrections: int = 10
+    convergence_tol: float = 1e-4
+    num_iterations: int = 100
+    grad_tol: float = 0.0  # optional ‖g‖ stop; 0 disables (MLlib has none)
+    c1: float = 1e-4
+    c2: float = 0.9
+    max_ls_steps: int = 12  # per bracket phase and per zoom phase
+    max_step_growth: float = 2.0
+
+
+class LBFGSResult(NamedTuple):
+    weights: Any
+    loss_history: jax.Array  # (num_iterations + 1,), NaN-padded
+    num_iters: jax.Array
+    converged: jax.Array  # stopped by tol (not cap, not failure)
+    ls_failed: jax.Array  # line search exhausted its budget
+    aborted_non_finite: jax.Array
+    grad_norm: jax.Array  # ‖g‖ at exit
+    num_fn_evals: jax.Array  # objective evaluations (distributed passes)
+
+
+class _Ring(NamedTuple):
+    """Fixed-shape history of the last m curvature pairs."""
+
+    s: Any  # each leaf (m, ...): w_{k+1} - w_k
+    y: Any  # each leaf (m, ...): g_{k+1} - g_k
+    rho: jax.Array  # (m,): 1 / (s·y)
+    count: jax.Array  # pairs stored so far (saturates at m)
+    head: jax.Array  # next slot to write
+
+
+def _ring_init(w0, m, sdtype):
+    stack = lambda t: tvec.tmap(
+        lambda l: jnp.zeros((m,) + l.shape, l.dtype), t)
+    return _Ring(s=stack(w0), y=stack(w0),
+                 rho=jnp.zeros((m,), sdtype),
+                 count=jnp.zeros((), jnp.int32),
+                 head=jnp.zeros((), jnp.int32))
+
+
+def _tree_index(t, i):
+    return tvec.tmap(lambda l: lax.dynamic_index_in_dim(
+        l, i, 0, keepdims=False), t)
+
+
+def _ring_push(ring: _Ring, s, y, accept):
+    """Write (s, y) at ``head`` and advance — or leave the ring
+    untouched when the curvature safeguard rejects the pair."""
+    m = ring.rho.shape[0]
+    sy = tvec.dot(s, y)
+    put = lambda H, v: tvec.tmap(
+        lambda Hl, vl: lax.dynamic_update_index_in_dim(
+            Hl, vl.astype(Hl.dtype), ring.head, 0), H, v)
+    new = _Ring(
+        s=put(ring.s, s), y=put(ring.y, y),
+        rho=ring.rho.at[ring.head].set(1.0 / sy),
+        count=jnp.minimum(ring.count + 1, m),
+        head=jnp.mod(ring.head + 1, m))
+    pick = lambda a, b: jax.tree_util.tree_map(
+        lambda x, yv: jnp.where(accept, x, yv), a, b)
+    return _Ring(pick(new.s, ring.s), pick(new.y, ring.y),
+                 pick(new.rho, ring.rho), pick(new.count, ring.count),
+                 pick(new.head, ring.head))
+
+
+def _two_loop(g, ring: _Ring):
+    """H·g via the standard two-loop recursion over the ring, masked to
+    the pairs actually stored; H0 = gamma·I scaled by the newest pair."""
+    m = ring.rho.shape[0]
+    sdtype = ring.rho.dtype
+
+    def newest_first(i):
+        return jnp.mod(ring.head - 1 - i, m)
+
+    def oldest_first(i):
+        return jnp.mod(ring.head - ring.count + i, m)
+
+    def body1(i, carry):
+        q, alphas = carry
+        idx = newest_first(i)
+        valid = i < ring.count
+        a = ring.rho[idx] * tvec.dot(_tree_index(ring.s, idx), q)
+        a = jnp.where(valid, a, jnp.zeros((), sdtype))
+        q = tvec.axpby(1.0, q, -a, _tree_index(ring.y, idx))
+        return q, alphas.at[idx].set(a)
+
+    q, alphas = lax.fori_loop(
+        0, m, body1, (g, jnp.zeros((m,), sdtype)))
+
+    idx_new = jnp.mod(ring.head - 1, m)
+    s_n = _tree_index(ring.s, idx_new)
+    y_n = _tree_index(ring.y, idx_new)
+    yy = tvec.dot(y_n, y_n)
+    gamma = jnp.where(
+        ring.count > 0,
+        tvec.dot(s_n, y_n) / jnp.maximum(yy, jnp.finfo(sdtype).tiny),
+        jnp.ones((), sdtype))
+    r = tvec.scale(gamma, q)
+
+    def body2(i, r):
+        idx = oldest_first(i)
+        valid = i < ring.count
+        b = ring.rho[idx] * tvec.dot(_tree_index(ring.y, idx), r)
+        coef = jnp.where(valid, alphas[idx] - b, jnp.zeros((), sdtype))
+        return tvec.axpby(1.0, r, coef, _tree_index(ring.s, idx))
+
+    return lax.fori_loop(0, m, body2, r)
+
+
+class _LS(NamedTuple):
+    t: jax.Array
+    f_t: jax.Array
+    g_t: Any
+    dg_t: jax.Array
+    t_lo: jax.Array
+    f_lo: jax.Array
+    dg_lo: jax.Array
+    t_hi: jax.Array
+    f_hi: jax.Array
+    it: jax.Array
+    evals: jax.Array
+    stage: jax.Array  # 0 bracket, 1 zoom, 2 accepted, 3 failed
+
+
+def _wolfe_search(objective, w, f0, g0, d, cfg: LBFGSConfig, sdtype):
+    """Strong-Wolfe step along ``d`` (Nocedal-Wright 3.5/3.6, bisection
+    zoom, both phases bounded by ``max_ls_steps``).  Returns
+    ``(t, f_t, g_t, evals, ok)``; ``t = 0`` with ``ok = False`` when the
+    budget is exhausted without a Wolfe point."""
+    dg0 = tvec.dot(g0, d)
+    c1, c2 = cfg.c1, cfg.c2
+    one = jnp.ones((), sdtype)
+    zero = jnp.zeros((), sdtype)
+
+    def eval_at(t):
+        f, g = objective(tvec.axpby(1.0, w, t, d))
+        return f, g, tvec.dot(g, d)
+
+    def cond(st: _LS):
+        return st.stage < 2
+
+    def body(st: _LS):
+        armijo = st.f_t <= f0 + c1 * st.t * dg0
+        curv = jnp.abs(st.dg_t) <= -c2 * dg0
+        in_bracket = st.stage == 0
+
+        # --- bracket phase decisions (Nocedal-Wright alg 3.5) ---
+        # a rise (or a previous-lo dominance) brackets [t_lo, t];
+        # a sign change brackets [t, t_lo]; Wolfe accepts outright
+        rise = (~armijo) | ((st.it > 0) & (st.f_t >= st.f_lo))
+        accept_b = armijo & curv
+        swapped = (~rise) & (st.dg_t >= 0)
+
+        b_t_lo = jnp.where(rise, st.t_lo, st.t)
+        b_f_lo = jnp.where(rise, st.f_lo, st.f_t)
+        b_dg_lo = jnp.where(rise, st.dg_lo, st.dg_t)
+        b_t_hi = jnp.where(rise, st.t, st.t_lo)
+        b_f_hi = jnp.where(rise, st.f_t, st.f_lo)
+        to_zoom_b = rise | swapped
+
+        # --- zoom phase decisions (alg 3.6, bisection trial) ---
+        z_rise = (~armijo) | (st.f_t >= st.f_lo)
+        accept_z = armijo & curv
+        # on a kept (non-rising) trial whose slope points past lo,
+        # hi collapses onto the old lo
+        flip = (~z_rise) & (st.dg_t * (st.t_hi - st.t_lo) >= 0)
+        z_t_hi = jnp.where(z_rise, st.t, jnp.where(flip, st.t_lo,
+                                                   st.t_hi))
+        z_f_hi = jnp.where(z_rise, st.f_t, jnp.where(flip, st.f_lo,
+                                                     st.f_hi))
+        z_t_lo = jnp.where(z_rise, st.t_lo, st.t)
+        z_f_lo = jnp.where(z_rise, st.f_lo, st.f_t)
+        z_dg_lo = jnp.where(z_rise, st.dg_lo, st.dg_t)
+
+        accept = jnp.where(in_bracket, accept_b, accept_z)
+        t_lo = jnp.where(in_bracket, b_t_lo, z_t_lo)
+        f_lo = jnp.where(in_bracket, b_f_lo, z_f_lo)
+        dg_lo = jnp.where(in_bracket, b_dg_lo, z_dg_lo)
+        t_hi = jnp.where(in_bracket, b_t_hi, z_t_hi)
+        f_hi = jnp.where(in_bracket, b_f_hi, z_f_hi)
+        entering_zoom = in_bracket & to_zoom_b & (~accept)
+        stage = jnp.where(
+            accept, 2,
+            jnp.where(in_bracket & ~to_zoom_b, 0, 1)).astype(jnp.int32)
+
+        # next trial point: bracket grows, zoom bisects
+        t_next = jnp.where(
+            stage == 0, st.t * cfg.max_step_growth,
+            0.5 * (t_lo + t_hi))
+        # per-phase iteration budget: the bracket counter carries on
+        # into zoom (fresh budget on entry)
+        it = jnp.where(entering_zoom, jnp.zeros((), jnp.int32),
+                       st.it + 1)
+        exhausted = (st.it + 1 >= cfg.max_ls_steps) & (~accept) & \
+            (stage == st.stage) & (~entering_zoom)
+        stage = jnp.where(exhausted, 3, stage)
+
+        do_eval = stage < 2
+        f_n, g_n, dg_n = lax.cond(
+            do_eval, lambda: eval_at(t_next),
+            lambda: (st.f_t, st.g_t, st.dg_t))
+        return _LS(t=jnp.where(do_eval, t_next, st.t),
+                   f_t=f_n, g_t=g_n, dg_t=dg_n,
+                   t_lo=t_lo, f_lo=f_lo, dg_lo=dg_lo,
+                   t_hi=t_hi, f_hi=f_hi, it=it,
+                   evals=st.evals + do_eval.astype(jnp.int32),
+                   stage=stage)
+
+    f1, g1, dg1 = eval_at(one)
+    init = _LS(t=one, f_t=f1, g_t=g1, dg_t=dg1,
+               t_lo=zero, f_lo=f0, dg_lo=dg0,
+               t_hi=zero, f_hi=f0,
+               it=jnp.zeros((), jnp.int32),
+               evals=jnp.ones((), jnp.int32),
+               stage=jnp.zeros((), jnp.int32))
+    out = lax.while_loop(cond, body, init)
+    ok = out.stage == 2
+    t = jnp.where(ok, out.t, zero)
+    return t, out.f_t, out.g_t, out.evals, ok
+
+
+class _Outer(NamedTuple):
+    w: Any
+    f: jax.Array
+    g: Any
+    ring: _Ring
+    it: jax.Array
+    done: jax.Array
+    converged: jax.Array
+    ls_failed: jax.Array
+    aborted: jax.Array
+    hist: jax.Array
+    evals: jax.Array
+
+
+def run_lbfgs(objective: ObjectiveFn, w0: Any,
+              config: LBFGSConfig = LBFGSConfig()) -> LBFGSResult:
+    """Minimize ``objective(w) -> (f, g)`` from ``w0`` — one compiled
+    program; jit the call (the api layer does)."""
+    cfg = config
+    m = int(cfg.num_corrections)
+    if m < 1:
+        raise ValueError("num_corrections must be >= 1")
+
+    f0, g0 = objective(w0)
+    sdtype = jnp.asarray(f0).dtype
+    hist0 = jnp.full((cfg.num_iterations + 1,), jnp.nan, sdtype)
+    hist0 = hist0.at[0].set(f0)
+
+    def cond(st: _Outer):
+        return (~st.done) & (st.it < cfg.num_iterations)
+
+    def body(st: _Outer):
+        d = tvec.scale(-1.0, _two_loop(st.g, st.ring))
+        # a non-descent direction (stale curvature) falls back to
+        # steepest descent — the standard safeguard
+        descent = tvec.dot(st.g, d) < 0
+        d = jax.tree_util.tree_map(
+            lambda di, gi: jnp.where(descent, di, -gi), d, st.g)
+        t, f_n, g_n, evals, ok = _wolfe_search(
+            objective, st.w, st.f, st.g, d, cfg, sdtype)
+        w_n = tvec.axpby(1.0, st.w, t, d)
+        s = tvec.sub(w_n, st.w)
+        y = tvec.sub(g_n, st.g)
+        # positive-definiteness safeguard on the new pair
+        sy = tvec.dot(s, y)
+        pair_ok = ok & (sy > 1e-10 * tvec.norm(s) * tvec.norm(y))
+        ring = _ring_push(st.ring, s, y, pair_ok)
+
+        non_finite = ~jnp.isfinite(f_n)
+        keep = ok & (~non_finite)
+        improv = (st.f - f_n) / jnp.maximum(
+            jnp.maximum(jnp.abs(st.f), jnp.abs(f_n)), 1.0)
+        conv_tol = keep & (improv <= cfg.convergence_tol)
+        # the grad stop judges the ACCEPTED point only — a failed line
+        # search must never flip converged on a discarded trial's g
+        conv_grad = keep & (cfg.grad_tol > 0) & \
+            (tvec.norm(g_n) < cfg.grad_tol)
+        converged = conv_tol | conv_grad
+        failed = ~ok
+        done = converged | failed | non_finite
+
+        # only accepted steps count as iterations, so the contract
+        # "hist[:num_iters + 1] is finite" survives a failing last step
+        it_n = st.it + keep.astype(st.it.dtype)
+        w_out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(keep, a, b), w_n, st.w)
+        g_out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(keep, a, b), g_n, st.g)
+        f_out = jnp.where(keep, f_n, st.f)
+        hist = st.hist.at[it_n].set(jnp.where(keep, f_n,
+                                              st.hist[it_n]))
+        return _Outer(w=w_out, f=f_out, g=g_out, ring=ring, it=it_n,
+                      done=done,
+                      converged=st.converged | converged,
+                      ls_failed=st.ls_failed | failed,
+                      aborted=st.aborted | non_finite,
+                      hist=hist, evals=st.evals + evals)
+
+    init = _Outer(
+        w=w0, f=f0, g=g0, ring=_ring_init(w0, m, sdtype),
+        it=jnp.zeros((), jnp.int32),
+        done=~jnp.isfinite(f0),
+        converged=jnp.zeros((), bool),
+        ls_failed=jnp.zeros((), bool),
+        aborted=~jnp.isfinite(f0),
+        hist=hist0,
+        evals=jnp.ones((), jnp.int32))
+    out = lax.while_loop(cond, body, init)
+    return LBFGSResult(
+        weights=out.w, loss_history=out.hist, num_iters=out.it,
+        converged=out.converged, ls_failed=out.ls_failed,
+        aborted_non_finite=out.aborted, grad_norm=tvec.norm(out.g),
+        num_fn_evals=out.evals)

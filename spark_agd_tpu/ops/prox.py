@@ -48,6 +48,18 @@ class Prox:
     def reg_value(self, w, reg):
         raise NotImplementedError
 
+    def smooth_penalty(self, w, reg):
+        """``(value, grad)`` of the penalty at ``w`` — or ``None`` when
+        the penalty is not differentiable.
+
+        This is the seam the L-BFGS driver uses: MLlib's ``LBFGS``
+        folds ``SquaredL2Updater`` regularization into its ``CostFun``
+        as an added objective term (value ``reg/2·‖w‖²``, gradient
+        ``reg·w``) rather than a prox step, and supports NO non-smooth
+        penalty in 1.3 (OWLQN came later).  ``None`` means "prox-only
+        penalty"; callers needing a smooth objective must reject it."""
+        return None
+
 
 def _scalar_dtype(w):
     import jax
@@ -67,6 +79,9 @@ class IdentityProx(Prox):
     def reg_value(self, w, reg):
         return jnp.zeros((), _scalar_dtype(w))
 
+    def smooth_penalty(self, w, reg):
+        return jnp.zeros((), _scalar_dtype(w)), tvec.zeros_like(w)
+
 
 class L2Prox(Prox):
     """EXACT prox of ``(reg/2)·‖w‖²``: ``(w - step·g) / (1 + step·reg)``.
@@ -85,6 +100,13 @@ class L2Prox(Prox):
 
     def reg_value(self, w, reg):
         return 0.5 * reg * tvec.sq_norm(w)
+
+    def smooth_penalty(self, w, reg):
+        # differentiable: value reg/2·‖w‖², gradient reg·w — exactly
+        # MLlib LBFGS CostFun's L2 handling (inherited by the
+        # MLlib-faithful subclass: the CostFun term is the same even
+        # though the Updater's prox step is linearized)
+        return self.reg_value(w, reg), tvec.scale(reg, w)
 
 
 class MLlibSquaredL2Updater(L2Prox):
