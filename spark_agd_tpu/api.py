@@ -100,24 +100,21 @@ def _resolve_fit_mesh(data: Data, mesh):
 
 
 def _reconcile_runner_mesh(data: Data, mesh, dist_mode: str):
-    """Shared ``make_*_runner`` preamble (one copy of the mesh-dispatch
-    policy — per-site variants drifted into real bugs, r3 review):
-    normalize ``data``, recover a pre-placed batch's own mesh (an
-    explicit conflicting ``mesh`` raises), and force the explicit
-    shard_map mode for raw CSR (GSPMD cannot partition the segment-sum's
-    row-id indirection).  Returns ``(data, resolved_mesh, dist_mode)``."""
+    """Shared ``make_*_runner`` preamble, built ON
+    :func:`_resolve_fit_mesh` so the batch-mesh conflict policy has one
+    copy (per-site variants drifted into real bugs, r3 review).  Two
+    runner-specific extras: ``mesh=False`` forces single-device even on
+    a pre-placed batch (the grid fits have no such override), and raw
+    CSR forces the explicit shard_map mode (GSPMD cannot partition the
+    segment-sum's row-id indirection).  Returns
+    ``(data, resolved_mesh, dist_mode)``."""
     data = _normalize_data(data)
-    if isinstance(data, mesh_lib.ShardedBatch):
-        batch_mesh = _batch_mesh(data)
-        if mesh is None:
-            mesh = batch_mesh
-        elif mesh is not False and mesh != batch_mesh:
-            raise ValueError(
-                "explicit mesh differs from the ShardedBatch's mesh; "
-                "re-shard the batch or drop the mesh argument")
-    elif isinstance(data[0], CSRMatrix):
+    m, batch, csr_raw = _resolve_fit_mesh(data, mesh)
+    if batch is not None and mesh is False:
+        m = None
+    if csr_raw:
         dist_mode = "shard_map"
-    return data, _resolve_mesh(mesh), dist_mode
+    return data, m, dist_mode
 
 
 def _build_smooth(gradient, data, mesh, dist_mode):

@@ -190,7 +190,6 @@ class _LS(NamedTuple):
     dg_t: jax.Array
     t_lo: jax.Array
     f_lo: jax.Array
-    dg_lo: jax.Array
     t_hi: jax.Array
     f_hi: jax.Array
     it: jax.Array
@@ -229,7 +228,6 @@ def _wolfe_search(objective, w, f0, g0, d, cfg: LBFGSConfig, sdtype):
 
         b_t_lo = jnp.where(rise, st.t_lo, st.t)
         b_f_lo = jnp.where(rise, st.f_lo, st.f_t)
-        b_dg_lo = jnp.where(rise, st.dg_lo, st.dg_t)
         b_t_hi = jnp.where(rise, st.t, st.t_lo)
         b_f_hi = jnp.where(rise, st.f_t, st.f_lo)
         to_zoom_b = rise | swapped
@@ -246,12 +244,10 @@ def _wolfe_search(objective, w, f0, g0, d, cfg: LBFGSConfig, sdtype):
                                                      st.f_hi))
         z_t_lo = jnp.where(z_rise, st.t_lo, st.t)
         z_f_lo = jnp.where(z_rise, st.f_lo, st.f_t)
-        z_dg_lo = jnp.where(z_rise, st.dg_lo, st.dg_t)
 
         accept = jnp.where(in_bracket, accept_b, accept_z)
         t_lo = jnp.where(in_bracket, b_t_lo, z_t_lo)
         f_lo = jnp.where(in_bracket, b_f_lo, z_f_lo)
-        dg_lo = jnp.where(in_bracket, b_dg_lo, z_dg_lo)
         t_hi = jnp.where(in_bracket, b_t_hi, z_t_hi)
         f_hi = jnp.where(in_bracket, b_f_hi, z_f_hi)
         entering_zoom = in_bracket & to_zoom_b & (~accept)
@@ -277,14 +273,14 @@ def _wolfe_search(objective, w, f0, g0, d, cfg: LBFGSConfig, sdtype):
             lambda: (st.f_t, st.g_t, st.dg_t))
         return _LS(t=jnp.where(do_eval, t_next, st.t),
                    f_t=f_n, g_t=g_n, dg_t=dg_n,
-                   t_lo=t_lo, f_lo=f_lo, dg_lo=dg_lo,
+                   t_lo=t_lo, f_lo=f_lo,
                    t_hi=t_hi, f_hi=f_hi, it=it,
                    evals=st.evals + do_eval.astype(jnp.int32),
                    stage=stage)
 
     f1, g1, dg1 = eval_at(one)
     init = _LS(t=one, f_t=f1, g_t=g1, dg_t=dg1,
-               t_lo=zero, f_lo=f0, dg_lo=dg0,
+               t_lo=zero, f_lo=f0,
                t_hi=zero, f_hi=f0,
                it=jnp.zeros((), jnp.int32),
                evals=jnp.ones((), jnp.int32),

@@ -21,6 +21,7 @@ from .glm import (  # noqa: F401
     LinearRegressionWithAGD,
     LogisticRegressionModel,
     LogisticRegressionWithAGD,
+    LogisticRegressionWithLBFGS,
     SVMModel,
     SVMWithAGD,
     SoftmaxRegressionModel,

@@ -281,8 +281,18 @@ class GeneralizedLinearAlgorithm:
     in the optimizer seat the reference was built to occupy."""
 
     def __init__(self, gradient: Gradient, updater: Prox, *,
-                 add_intercept: bool = False, mesh=None):
-        self.optimizer = api.AcceleratedGradientDescent(gradient, updater)
+                 add_intercept: bool = False, mesh=None,
+                 optimizer=None):
+        """``optimizer``: the object in the optimizer seat — default a
+        fresh ``AcceleratedGradientDescent(gradient, updater)``; pass an
+        ``api.LBFGS`` (or anything with the Optimizer trait's
+        ``optimize``) to swap, the exact interchange MLlib's
+        ``GeneralizedLinearAlgorithm`` was built for.  When supplied,
+        ``gradient``/``updater`` are NOT injected into it — the seat
+        carries its own."""
+        self.optimizer = (api.AcceleratedGradientDescent(gradient,
+                                                         updater)
+                          if optimizer is None else optimizer)
         if mesh is not None:
             self.optimizer.set_mesh(mesh)
         self.add_intercept = bool(add_intercept)
@@ -316,6 +326,17 @@ class GeneralizedLinearAlgorithm:
         weights = self.optimizer.optimize((data_X, y), w0)
         return self._create_model(*self._split_intercept(weights))
 
+    def _require_grid_optimizer(self, op_name: str):
+        """The batched grid fits ride the AGD sweep/CV machinery; a
+        trainer whose optimizer seat holds something else (LBFGS) gets
+        a named error instead of an AttributeError."""
+        if not hasattr(self.optimizer, op_name):
+            raise ValueError(
+                f"{op_name} requires an optimizer with batched grid "
+                f"support (AcceleratedGradientDescent); "
+                f"{type(self.optimizer).__name__} fits one strength "
+                f"per train() call")
+
     def train_path(self, X, y, reg_params, initial_weights=None):
         """Fit the regularization path: K typed models from ONE compiled
         program (``api.sweep`` — the dataset stays in HBM once, the K
@@ -326,6 +347,7 @@ class GeneralizedLinearAlgorithm:
         ``reg_params`` order plus the batched ``AGDResult`` (loss
         histories, iteration counts, diagnostics per lane).
         """
+        self._require_grid_optimizer("sweep")
         data_X, w0 = self._prepare_fit(X, initial_weights)
         # config forwarding (and the IdentityProx / mesh guards) live on
         # the optimizer object, next to optimize()'s
@@ -343,6 +365,7 @@ class GeneralizedLinearAlgorithm:
         (``api.cross_validate``), then (``refit=True``) one final fit of
         the winning strength on ALL rows.  Returns ``(model, cv)`` —
         ``model`` is None when ``refit=False``."""
+        self._require_grid_optimizer("cross_validate")
         reg_params = list(reg_params)  # consumed more than once below
         data_X, w0 = self._prepare_fit(X, None)
         cv = self.optimizer.cross_validate((data_X, y), reg_params, w0,
@@ -375,6 +398,30 @@ class LogisticRegressionWithAGD(GeneralizedLinearAlgorithm):
             updater if updater is not None else L2Prox(),
             add_intercept=add_intercept, mesh=mesh)
         self.optimizer.set_reg_param(reg_param)
+
+    def _create_model(self, weights, intercept):
+        return LogisticRegressionModel(weights, intercept)
+
+
+class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
+    """MLlib's ``LogisticRegressionWithLBFGS`` analogue: the same typed
+    model and trainer workflow, with the quasi-Newton member in the
+    optimizer seat (``api.LBFGS``) — the interchange the reference's
+    ``Optimizer`` trait exists to allow.  Smooth (L2) regularization
+    only, as in MLlib 1.3; grid fits (``train_path`` /
+    ``cross_validate``) are AGD-only and raise a named error."""
+
+    def __init__(self, reg_param: float = 0.0,
+                 num_corrections: int = 10, updater: Prox = None,
+                 add_intercept: bool = True, mesh=None):
+        updater = updater if updater is not None else L2Prox()
+        gradient = LogisticGradient()
+        super().__init__(
+            gradient, updater,
+            add_intercept=add_intercept, mesh=mesh,
+            optimizer=api.LBFGS(gradient, updater))
+        self.optimizer.set_reg_param(reg_param)
+        self.optimizer.set_num_corrections(num_corrections)
 
     def _create_model(self, weights, intercept):
         return LogisticRegressionModel(weights, intercept)

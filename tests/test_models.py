@@ -67,6 +67,45 @@ class TestLogisticRegression:
         assert got[1] == pytest.approx(want[1], rel=1e-5)
 
 
+class TestLogisticRegressionWithLBFGS:
+    def test_matches_agd_trainer(self, logistic_data):
+        """The optimizer-seat interchange: same data, same typed model
+        family, agreeing fits from the AGD and LBFGS members."""
+        X, y = logistic_data
+        lr_agd = models.LogisticRegressionWithAGD(reg_param=0.1)
+        lr_agd.optimizer.set_num_iterations(80).set_convergence_tol(
+            1e-10).set_mesh(False)
+        lr_lb = models.LogisticRegressionWithLBFGS(reg_param=0.1)
+        lr_lb.optimizer.set_num_iterations(80).set_convergence_tol(
+            1e-10).set_mesh(False)
+        m_agd = lr_agd.train(X, y)
+        m_lb = lr_lb.train(X, y)
+        np.testing.assert_allclose(np.asarray(m_lb.weights),
+                                   np.asarray(m_agd.weights), atol=2e-3)
+        agree = np.mean(np.asarray(m_lb.predict(X))
+                        == np.asarray(m_agd.predict(X)))
+        assert agree > 0.99
+
+    def test_workflow_and_intercept(self, logistic_data):
+        X, y = logistic_data
+        lr = models.LogisticRegressionWithLBFGS(reg_param=0.01)
+        lr.optimizer.setNumIterations(60).setConvergenceTol(1e-9)
+        lr.optimizer.set_mesh(False)
+        model = lr.train(X, y)
+        acc = np.mean(np.asarray(model.predict(X)) == y)
+        assert acc > 0.8
+        # intercept was learned (the synthetic generator's A=2.0 shift)
+        assert abs(model.intercept) > 0.1
+
+    def test_grid_fits_raise_named_error(self, logistic_data):
+        X, y = logistic_data
+        lr = models.LogisticRegressionWithLBFGS()
+        with pytest.raises(ValueError, match="grid support"):
+            lr.train_path(X, y, [0.1, 1.0])
+        with pytest.raises(ValueError, match="grid support"):
+            lr.cross_validate(X, y, [0.1, 1.0])
+
+
 class TestLinearRegression:
     def test_recovers_weights(self):
         w_true = np.array([1.5, -2.0, 0.5])
