@@ -69,7 +69,15 @@ from .api import (  # noqa: F401
     sweep_warm_state,
 )
 from .core.agd import AGDConfig, AGDResult  # noqa: F401
-from .core.lbfgs import LBFGSConfig, LBFGSResult  # noqa: F401
+from .core.lbfgs import (  # noqa: F401
+    LBFGSConfig,
+    LBFGSResult,
+    make_objective as make_lbfgs_objective,
+)
+from .core.host_lbfgs import (  # noqa: F401
+    HostLBFGSResult,
+    run_lbfgs_host,
+)
 from .parallel.mesh import (  # noqa: F401
     ShardedBatch,
     make_mesh,

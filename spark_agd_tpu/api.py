@@ -975,28 +975,15 @@ def make_lbfgs_runner(
     recursion + Wolfe search as one ``lax.while_loop`` program,
     ``core/lbfgs.py``) runs single-device or row-sharded.
     """
-    from .core import lbfgs as lbfgs_lib, tvec
+    from .core import lbfgs as lbfgs_lib
 
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
-    if updater.smooth_penalty(jnp.zeros((), jnp.float32),
-                              float(reg_param)) is None:
-        raise ValueError(
-            f"{type(updater).__name__} has no smooth penalty: L-BFGS "
-            "needs a differentiable objective (MLlib 1.3's LBFGS has "
-            "the same limitation — no OWLQN); use "
-            "AcceleratedGradientDescent for prox-only penalties")
     sm, _ = _build_smooth(gradient, data, m, dist_mode)
     cfg = lbfgs_lib.LBFGSConfig(
         num_corrections=num_corrections,
         convergence_tol=convergence_tol,
         num_iterations=num_iterations, grad_tol=grad_tol)
-
-    def objective(w):
-        f, g = sm(w)
-        pv, pg = updater.smooth_penalty(w, reg_param)  # non-None: the
-        # eager build-time check above rejected prox-only updaters
-        return f + pv, tvec.add(g, pg)
-
+    objective = lbfgs_lib.make_objective(sm, updater, reg_param)
     step = jax.jit(lambda w: lbfgs_lib.run_lbfgs(objective, w, cfg))
 
     def fit(initial_weights):

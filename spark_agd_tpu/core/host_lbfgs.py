@@ -1,0 +1,185 @@
+"""Host-orchestrated L-BFGS: the streaming / cross-process twin of
+``core.lbfgs``.
+
+Same decision algebra as the fused loop (same Wolfe conditions, same
+curvature safeguard, same convergence test — see ``core/lbfgs.py`` for
+the MLlib/Breeze pinning), but with the outer loop and line search in
+Python and only the math on device, mirroring ``core.host_agd``'s split:
+a *streamed* objective (``data.streaming.make_streaming_smooth`` + the
+updater's smooth penalty) contains a host loop and cannot live inside
+``lax.while_loop``; a cross-process global-array objective cannot be
+closed over by a fused jit.  Control scalars sync to the host once per
+objective evaluation — for macro-batch workloads the stream dominates.
+
+Parity with the fused loop is pinned by
+``tests/test_lbfgs.py::TestHostTwin`` (identical iteration counts and
+trajectories on in-memory problems).  Scope of that exactness: the host
+driver compares control scalars as Python float64, the fused loop in
+the objective's dtype — under x64 (the test suite) every branch is
+bit-identical; with an f32 objective a decision sitting exactly on a
+Wolfe/convergence boundary can round differently, so f32 parity is
+trajectory-level, not branch-level (the multihost smoke asserts
+matching stop modes and objective values, not counts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, NamedTuple
+
+import numpy as np
+
+from . import tvec
+from .lbfgs import LBFGSConfig
+
+
+class HostLBFGSResult(NamedTuple):
+    weights: Any
+    loss_history: np.ndarray  # (num_iters + 1,): f(w0), then per accept
+    num_iters: int
+    converged: bool
+    ls_failed: bool
+    aborted_non_finite: bool
+    grad_norm: float
+    num_fn_evals: int
+
+
+def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
+    """Strong-Wolfe search, the eager mirror of ``lbfgs._wolfe_search``
+    (same bracket/zoom decisions, same budgets)."""
+    dg0 = float(tvec.dot(g0, d))
+    evals = 0
+
+    def eval_at(t):
+        nonlocal evals
+        f, g = objective(tvec.axpby(1.0, w, t, d))
+        evals += 1
+        return float(f), g, float(tvec.dot(g, d))
+
+    t = 1.0
+    f_t, g_t, dg_t = eval_at(t)
+    t_lo, f_lo = 0.0, f0
+    t_hi, f_hi = 0.0, f0
+    stage = 0  # 0 bracket, 1 zoom
+    it = 0
+    while True:
+        armijo = f_t <= f0 + cfg.c1 * t * dg0
+        curv = abs(dg_t) <= -cfg.c2 * dg0
+        if armijo and curv:
+            return t, f_t, g_t, evals, True
+        if stage == 0:
+            rise = (not armijo) or (it > 0 and f_t >= f_lo)
+            if rise:
+                t_lo, f_lo, t_hi, f_hi = t_lo, f_lo, t, f_t
+                stage, it = 1, 0
+            elif dg_t >= 0:
+                t_lo, f_lo, t_hi, f_hi = t, f_t, t_lo, f_lo
+                stage, it = 1, 0
+            else:
+                t_lo, f_lo = t, f_t
+                it += 1
+                if it >= cfg.max_ls_steps:
+                    return 0.0, f0, g0, evals, False
+                t = t * cfg.max_step_growth
+                f_t, g_t, dg_t = eval_at(t)
+                continue
+        else:
+            z_rise = (not armijo) or (f_t >= f_lo)
+            if z_rise:
+                t_hi, f_hi = t, f_t
+            else:
+                if dg_t * (t_hi - t_lo) >= 0:
+                    t_hi, f_hi = t_lo, f_lo
+                t_lo, f_lo = t, f_t
+            it += 1
+            if it >= cfg.max_ls_steps:
+                return 0.0, f0, g0, evals, False
+        t = 0.5 * (t_lo + t_hi)
+        f_t, g_t, dg_t = eval_at(t)
+
+
+def run_lbfgs_host(
+    objective: Callable,
+    w0: Any,
+    config: LBFGSConfig = LBFGSConfig(),
+    *,
+    on_iteration: Callable | None = None,
+) -> HostLBFGSResult:
+    """Minimize a HOST-callable ``objective(w) -> (f, g)`` — e.g. a
+    streamed smooth plus penalty, or an eager cross-process shard_map
+    smooth.  ``on_iteration(state_dict)`` fires after every accepted
+    step with ``{w, f, it}`` — a METRICS hook; it does not carry the
+    curvature pairs, so restarting from a saved ``w`` is a fresh
+    L-BFGS start, not an exact resume (unlike ``host_agd``'s full
+    continuation carry)."""
+    cfg = config
+    m = int(cfg.num_corrections)
+    if m < 1:
+        raise ValueError("num_corrections must be >= 1")
+
+    f, g = objective(w0)
+    f = float(f)
+    w = w0
+    hist: List[float] = [f]
+    evals = 1
+    pairs: List[tuple] = []  # (s, y, rho), oldest first
+    converged = ls_failed = aborted = False
+    it = 0
+    if not np.isfinite(f):
+        aborted = True
+
+    while not (converged or ls_failed or aborted) and \
+            it < cfg.num_iterations:
+        # two-loop recursion, same order as lbfgs._two_loop
+        q = g
+        alphas = []
+        for s, y, rho in reversed(pairs):  # newest -> oldest
+            a = float(rho * tvec.dot(s, q))
+            q = tvec.axpby(1.0, q, -a, y)
+            alphas.append(a)
+        if pairs:
+            s_n, y_n, _ = pairs[-1]
+            yy = float(tvec.dot(y_n, y_n))
+            gamma = float(tvec.dot(s_n, y_n)) / max(
+                yy, np.finfo(np.float64).tiny)
+        else:
+            gamma = 1.0
+        r = tvec.scale(gamma, q)
+        for (s, y, rho), a in zip(pairs, reversed(alphas)):
+            b = float(rho * tvec.dot(y, r))
+            r = tvec.axpby(1.0, r, a - b, s)
+        d = tvec.scale(-1.0, r)
+        if not float(tvec.dot(g, d)) < 0:  # stale curvature fallback
+            d = tvec.scale(-1.0, g)
+
+        t, f_n, g_n, ev, ok = _wolfe_host(objective, w, f, g, d, cfg)
+        evals += ev
+        if not ok:
+            ls_failed = True
+            break
+        if not np.isfinite(f_n):
+            aborted = True
+            break
+        w_n = tvec.axpby(1.0, w, t, d)
+        s = tvec.sub(w_n, w)
+        y = tvec.sub(g_n, g)
+        sy = float(tvec.dot(s, y))
+        if sy > 1e-10 * float(tvec.norm(s)) * float(tvec.norm(y)):
+            pairs.append((s, y, 1.0 / sy))
+            if len(pairs) > m:
+                pairs.pop(0)
+        improv = (f - f_n) / max(abs(f), abs(f_n), 1.0)
+        if improv <= cfg.convergence_tol:
+            converged = True
+        if cfg.grad_tol > 0 and float(tvec.norm(g_n)) < cfg.grad_tol:
+            converged = True
+        w, f, g = w_n, f_n, g_n
+        it += 1
+        hist.append(f)
+        if on_iteration is not None:
+            on_iteration({"w": w, "f": f, "it": it})
+
+    return HostLBFGSResult(
+        weights=w, loss_history=np.asarray(hist), num_iters=it,
+        converged=converged, ls_failed=ls_failed,
+        aborted_non_finite=aborted, grad_norm=float(tvec.norm(g)),
+        num_fn_evals=evals)

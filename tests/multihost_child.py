@@ -55,6 +55,7 @@ def main():
         _ingest_check(sys.argv[4], mesh)
         _sparse_ingest_check(sys.argv[4], mesh)
         _grid_check(mesh)
+        _lbfgs_check(mesh)
     print(f"CHILD_OK pid={pid} psum={float(total)}", flush=True)
 
 
@@ -205,6 +206,53 @@ def _grid_check(mesh):
     np.testing.assert_allclose(np.asarray(wg), np.asarray(wg1),
                                rtol=1e-4, atol=1e-6)
     print(f"GRID_OK pid={jax.process_index()}", flush=True)
+
+
+def _lbfgs_check(mesh):
+    """The quasi-Newton Optimizer-family member across PROCESS
+    boundaries: host-loop L-BFGS (``core.host_lbfgs`` — the fused jit
+    would close over cross-process global arrays) over the eager
+    shard_map smooth, vs the single-device fused answer every child
+    computes locally."""
+    from spark_agd_tpu import api
+    from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
+
+    rng = np.random.default_rng(23)
+    n, d, reg = 80, 5, 0.1
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    batch = mesh_lib.shard_batch(mesh, X, y)
+    sm, _ = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                         mesh=mesh)
+    # Cap at 3 iterations: this problem's improvements stay >=2.6e-5
+    # relative through step 3 — far above f32 rounding — so every
+    # sharding does exactly 3 clean Wolfe steps.  (At 4+ steps the run
+    # sits ON the f32 noise floor, where stop mode, count, and final
+    # micro-position all legitimately differ between reduction orders —
+    # observed: 6-vs-4 counts, mixed ls_failed, ~1e-4 weight wiggle.)
+    obj = lbfgs_lib.make_objective(sm, L2Prox(), reg)
+    cfg = lbfgs_lib.LBFGSConfig(convergence_tol=0.0, num_iterations=3)
+    res = host_lbfgs.run_lbfgs_host(obj, np.zeros(d, np.float32), cfg)
+
+    ref = api.run_lbfgs((X, y), LogisticGradient(), L2Prox(),
+                        reg_param=reg, convergence_tol=0.0,
+                        num_iterations=3,
+                        initial_weights=np.zeros(d, np.float32),
+                        mesh=False)
+    assert not res.aborted_non_finite and not res.ls_failed
+    assert res.num_iters == int(ref.num_iters) == 3, (
+        res.num_iters, int(ref.num_iters))
+    np.testing.assert_allclose(res.loss_history,
+                               np.asarray(ref.loss_history)[:4],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(res.weights),
+                               np.asarray(ref.weights),
+                               rtol=1e-3, atol=1e-5)
+    print(f"LBFGS_OK pid={jax.process_index()} iters={res.num_iters}",
+          flush=True)
 
 
 if __name__ == "__main__":

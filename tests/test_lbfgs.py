@@ -171,6 +171,72 @@ class TestBehavior:
                                       np.asarray(ref.weights))
 
 
+class TestHostTwin:
+    """core/host_lbfgs: the streaming / cross-process driver must make
+    the SAME decisions as the fused loop (the host_agd parity model)."""
+
+    def _objective(self, X, y, reg):
+        from spark_agd_tpu.core import lbfgs as lbfgs_lib, smooth
+        sm = smooth.make_smooth(losses.LogisticGradient(),
+                                jnp.asarray(X), jnp.asarray(y))
+        return lbfgs_lib.make_objective(sm, prox.SquaredL2Updater(), reg)
+
+    def test_host_matches_fused_trajectory(self, rng):
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        X, y = logistic_problem(rng, n=300, d=9)
+        reg = 0.07
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=80)
+        obj = self._objective(X, y, reg)
+        fused = jax.jit(lambda w: lbfgs_lib.run_lbfgs(obj, w, cfg))(
+            jnp.zeros(9))
+        host = host_lbfgs.run_lbfgs_host(obj, jnp.zeros(9), cfg)
+        kf = int(fused.num_iters)
+        assert host.num_iters == kf
+        assert bool(fused.converged) == host.converged
+        np.testing.assert_allclose(
+            host.loss_history,
+            np.asarray(fused.loss_history)[:kf + 1], rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(host.weights),
+                                   np.asarray(fused.weights),
+                                   rtol=1e-10, atol=1e-12)
+        assert host.num_fn_evals == int(fused.num_fn_evals)
+
+    def test_streamed_matches_in_memory(self, rng):
+        """L-BFGS over macro-batched streamed data == the fused
+        in-memory fit — the > HBM composition for the quasi-Newton
+        member."""
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+        from spark_agd_tpu.data import streaming
+
+        X, y = logistic_problem(rng, n=350, d=8)
+        reg = 0.05
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-10,
+                                    num_iterations=60)
+        ds = streaming.StreamingDataset.from_arrays(X, y, batch_rows=64)
+        sm, _ = streaming.make_streaming_smooth(
+            losses.LogisticGradient(), ds, pad_to=64)
+        obj_s = lbfgs_lib.make_objective(sm, prox.SquaredL2Updater(),
+                                         reg)
+        res_s = host_lbfgs.run_lbfgs_host(obj_s, jnp.zeros(8), cfg)
+        res_f = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                              prox.SquaredL2Updater(), reg_param=reg,
+                              convergence_tol=1e-10, num_iterations=60,
+                              initial_weights=np.zeros(8), mesh=False)
+        assert res_s.num_iters == int(res_f.num_iters)
+        np.testing.assert_allclose(np.asarray(res_s.weights),
+                                   np.asarray(res_f.weights),
+                                   rtol=1e-7, atol=1e-9)
+
+    def test_prox_only_rejected_by_objective_builder(self):
+        from spark_agd_tpu.core import lbfgs as lbfgs_lib
+
+        with pytest.raises(ValueError, match="smooth penalty"):
+            lbfgs_lib.make_objective(lambda w: (0.0, w),
+                                     prox.L1Updater(), 0.1)
+
+
 class TestMesh:
     def test_mesh_matches_single_device(self, rng, mesh8):
         X, y = logistic_problem(rng, n=300, d=12)  # 300: padding live
