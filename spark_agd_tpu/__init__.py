@@ -76,6 +76,7 @@ from .core.lbfgs import (  # noqa: F401
 )
 from .core.host_lbfgs import (  # noqa: F401
     HostLBFGSResult,
+    HostLBFGSWarm,
     run_lbfgs_host,
 )
 from .parallel.mesh import (  # noqa: F401

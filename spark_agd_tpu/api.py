@@ -977,6 +977,8 @@ def make_lbfgs_runner(
     """
     from .core import lbfgs as lbfgs_lib
 
+    lbfgs_lib.check_smooth_penalty(updater, reg_param)  # before any
+    # data staging: a prox-only updater must fail free
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
     sm, _ = _build_smooth(gradient, data, m, dist_mode)
     cfg = lbfgs_lib.LBFGSConfig(

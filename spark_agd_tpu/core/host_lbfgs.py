@@ -34,13 +34,44 @@ from .lbfgs import LBFGSConfig
 
 class HostLBFGSResult(NamedTuple):
     weights: Any
-    loss_history: np.ndarray  # (num_iters + 1,): f(w0), then per accept
-    num_iters: int
+    # (num_iters + 1,): entry 0 is f at this SEGMENT's start (f(w0)
+    # cold, warm.f resumed), then one entry per accepted step — so
+    # chained segments join with seg2.loss_history[1:]
+    loss_history: np.ndarray
+    num_iters: int  # iterations executed in THIS segment
     converged: bool
     ls_failed: bool
     aborted_non_finite: bool
     grad_norm: float
     num_fn_evals: int
+    # the exact continuation carry (gradient + curvature pairs) — feed
+    # back as ``warm`` to continue precisely where this run stopped
+    final_g: Any = None
+    final_pairs: tuple = ()
+
+
+class HostLBFGSWarm(NamedTuple):
+    """Complete inter-iteration carry: weights, objective value,
+    gradient, the m curvature pairs (oldest first), and iterations
+    already executed — enough that a resumed run makes decisions
+    IDENTICAL to an uninterrupted one (unlike restarting from bare
+    weights, which forgets the Hessian approximation and degrades to a
+    gamma=1 first step)."""
+
+    w: Any
+    f: float
+    g: Any
+    pairs: tuple  # ((s, y, rho), ...) oldest first, len <= m
+    prior_iters: int
+
+    @classmethod
+    def from_result(cls, res: "HostLBFGSResult",
+                    prior_iters: int = 0) -> "HostLBFGSWarm":
+        """The carry out of a finished segment; ``prior_iters`` is the
+        iteration total BEFORE that segment (chain it forward)."""
+        return cls(w=res.weights, f=float(res.loss_history[-1]),
+                   g=res.final_g, pairs=tuple(res.final_pairs),
+                   prior_iters=prior_iters + res.num_iters)
 
 
 def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
@@ -102,28 +133,43 @@ def run_lbfgs_host(
     w0: Any,
     config: LBFGSConfig = LBFGSConfig(),
     *,
+    warm: HostLBFGSWarm | None = None,
     on_iteration: Callable | None = None,
 ) -> HostLBFGSResult:
     """Minimize a HOST-callable ``objective(w) -> (f, g)`` — e.g. a
     streamed smooth plus penalty, or an eager cross-process shard_map
-    smooth.  ``on_iteration(state_dict)`` fires after every accepted
-    step with ``{w, f, it}`` — a METRICS hook; it does not carry the
-    curvature pairs, so restarting from a saved ``w`` is a fresh
-    L-BFGS start, not an exact resume (unlike ``host_agd``'s full
-    continuation carry)."""
+    smooth.
+
+    ``warm`` (a :class:`HostLBFGSWarm`, e.g.
+    ``HostLBFGSWarm.from_result(prev)``) continues a prior segment
+    EXACTLY: gradient and curvature pairs carry over, no objective
+    re-evaluation at the start, and ``prior_iters`` counts against
+    ``num_iterations`` — a kill/resume chain reproduces the
+    uninterrupted run (``tests/test_lbfgs.py::TestHostTwin``).
+    ``on_iteration(state_dict)`` fires after every accepted step with
+    the full carry ``{w, f, g, pairs, it}`` (``it`` is the TOTAL
+    iteration count including any warm prior) — checkpoint from it with
+    ``HostLBFGSWarm(w=s["w"], f=s["f"], g=s["g"], pairs=s["pairs"],
+    prior_iters=s["it"])``."""
     cfg = config
     m = int(cfg.num_corrections)
     if m < 1:
         raise ValueError("num_corrections must be >= 1")
 
-    f, g = objective(w0)
-    f = float(f)
-    w = w0
+    if warm is not None:
+        w, f, g = warm.w, float(warm.f), warm.g
+        pairs: List[tuple] = list(warm.pairs)[-m:]
+        it = int(warm.prior_iters)
+        evals = 0
+    else:
+        f, g = objective(w0)
+        f = float(f)
+        w = w0
+        pairs = []
+        it = 0
+        evals = 1
     hist: List[float] = [f]
-    evals = 1
-    pairs: List[tuple] = []  # (s, y, rho), oldest first
     converged = ls_failed = aborted = False
-    it = 0
     if not np.isfinite(f):
         aborted = True
 
@@ -176,10 +222,12 @@ def run_lbfgs_host(
         it += 1
         hist.append(f)
         if on_iteration is not None:
-            on_iteration({"w": w, "f": f, "it": it})
+            on_iteration({"w": w, "f": f, "g": g,
+                          "pairs": tuple(pairs), "it": it})
 
+    seg_iters = it - (int(warm.prior_iters) if warm is not None else 0)
     return HostLBFGSResult(
-        weights=w, loss_history=np.asarray(hist), num_iters=it,
+        weights=w, loss_history=np.asarray(hist), num_iters=seg_iters,
         converged=converged, ls_failed=ls_failed,
         aborted_non_finite=aborted, grad_norm=float(tvec.norm(g)),
-        num_fn_evals=evals)
+        num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs))

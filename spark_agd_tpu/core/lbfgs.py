@@ -84,20 +84,26 @@ class LBFGSConfig:
     max_step_growth: float = 2.0
 
 
-def make_objective(smooth: Callable, updater, reg_param: float):
-    """``objective(w) -> (f, g)``: the smooth data term plus the
-    updater's SMOOTH penalty folded in — MLlib LBFGS ``CostFun``'s
-    regularization treatment.  Works for the fused loop, the host twin,
-    and any smooth builder (in-memory, mesh, streamed).  Raises for
-    prox-only updaters (the MLlib-1.3 no-OWLQN limitation)."""
-    probe = updater.smooth_penalty(jnp.zeros((), jnp.float32),
-                                   float(reg_param))
-    if probe is None:
+def check_smooth_penalty(updater, reg_param: float) -> None:
+    """Raise for prox-only updaters (the MLlib-1.3 no-OWLQN
+    limitation).  Cheap: call BEFORE any data staging so a
+    misconfiguration fails free."""
+    if updater.smooth_penalty(jnp.zeros((), jnp.float32),
+                              float(reg_param)) is None:
         raise ValueError(
             f"{type(updater).__name__} has no smooth penalty: L-BFGS "
             "needs a differentiable objective (MLlib 1.3's LBFGS has "
             "the same limitation — no OWLQN); use "
             "AcceleratedGradientDescent for prox-only penalties")
+
+
+def make_objective(smooth: Callable, updater, reg_param: float):
+    """``objective(w) -> (f, g)``: the smooth data term plus the
+    updater's SMOOTH penalty folded in — MLlib LBFGS ``CostFun``'s
+    regularization treatment.  Works for the fused loop, the host twin,
+    and any smooth builder (in-memory, mesh, streamed).  Raises for
+    prox-only updaters (:func:`check_smooth_penalty`)."""
+    check_smooth_penalty(updater, reg_param)
 
     def objective(w):
         f, g = smooth(w)

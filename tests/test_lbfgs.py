@@ -229,6 +229,61 @@ class TestHostTwin:
                                    np.asarray(res_f.weights),
                                    rtol=1e-7, atol=1e-9)
 
+    def test_warm_resume_is_exact(self, rng):
+        """A segmented run (stop after k, resume from the carry) makes
+        decisions IDENTICAL to the uninterrupted run — curvature pairs
+        and gradient carry over, nothing is re-evaluated."""
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        X, y = logistic_problem(rng, n=250, d=7)
+        obj = self._objective(X, y, 0.03)
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=40)
+        full = host_lbfgs.run_lbfgs_host(obj, jnp.zeros(7), cfg)
+        assert full.num_iters >= 6  # enough room to split
+
+        cfg_k = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                      num_iterations=3)
+        seg1 = host_lbfgs.run_lbfgs_host(obj, jnp.zeros(7), cfg_k)
+        assert seg1.num_iters == 3 and not seg1.converged
+        warm = host_lbfgs.HostLBFGSWarm.from_result(seg1)
+        seg2 = host_lbfgs.run_lbfgs_host(obj, jnp.zeros(7), cfg,
+                                         warm=warm)
+        assert 3 + seg2.num_iters == full.num_iters
+        assert seg2.converged == full.converged
+        joined = np.concatenate([seg1.loss_history,
+                                 seg2.loss_history[1:]])
+        np.testing.assert_array_equal(joined, full.loss_history)
+        np.testing.assert_array_equal(np.asarray(seg2.weights),
+                                      np.asarray(full.weights))
+        # the objective was NOT re-evaluated at the resume point
+        assert seg1.num_fn_evals + seg2.num_fn_evals == \
+            int(full.num_fn_evals)
+
+    def test_on_iteration_carry_round_trips(self, rng):
+        """Checkpointing from the hook payload resumes exactly."""
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        X, y = logistic_problem(rng, n=200, d=6)
+        obj = self._objective(X, y, 0.05)
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=30)
+        full = host_lbfgs.run_lbfgs_host(obj, jnp.zeros(6), cfg)
+        snaps = []
+        host_lbfgs.run_lbfgs_host(
+            obj, jnp.zeros(6), cfg,
+            on_iteration=lambda s: snaps.append(s) if s["it"] == 2
+            else None)
+        s = snaps[0]
+        warm = host_lbfgs.HostLBFGSWarm(
+            w=s["w"], f=s["f"], g=s["g"], pairs=s["pairs"],
+            prior_iters=s["it"])
+        seg2 = host_lbfgs.run_lbfgs_host(obj, jnp.zeros(6), cfg,
+                                         warm=warm)
+        np.testing.assert_array_equal(np.asarray(seg2.weights),
+                                      np.asarray(full.weights))
+        assert 2 + seg2.num_iters == full.num_iters
+
     def test_prox_only_rejected_by_objective_builder(self):
         from spark_agd_tpu.core import lbfgs as lbfgs_lib
 
