@@ -122,6 +122,53 @@ def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
     return cap, False
 
 
+def lbfgs_comparison(config: BenchConfig, data, w0, iters: int,
+                     agd_final_loss: float) -> dict:
+    """The OTHER Optimizer-family comparison (``lbfgs_*`` fields):
+    MLlib users weigh AGD not only against GD but against LBFGS, the
+    package's strong default.  Measured the same way as the AGD pass
+    (compile-once runner, steady-state second fit); applicable only to
+    smooth penalties — config 3's L1 reports a note instead, matching
+    MLlib 1.3's own LBFGS limitation."""
+    import jax
+
+    from spark_agd_tpu.core import lbfgs as lbfgs_lib
+
+    updater = config.updater()
+    try:
+        lbfgs_lib.check_smooth_penalty(updater, config.reg_param)
+    except ValueError:
+        return {"lbfgs_note":
+                "prox-only penalty: not applicable (MLlib 1.3 parity)"}
+    fit = api.make_lbfgs_runner(
+        data, config.gradient(), updater, convergence_tol=0.0,
+        num_iterations=iters, reg_param=config.reg_param)
+    t0 = time.perf_counter()
+    res = fit(w0)
+    jax.block_until_ready(res.weights)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = fit(w0)
+    jax.block_until_ready(res.weights)
+    run_s = time.perf_counter() - t0
+    k = int(res.num_iters)
+    hist = np.asarray(res.loss_history)
+    # hist[j] is the objective after j accepted iterations (j=0: at w0),
+    # directly comparable to the AGD history's f + reg accounting
+    hits = np.nonzero(hist[1:k + 1]
+                      <= agd_final_loss * (1 + 1e-6))[0]
+    return {
+        "lbfgs_iters": k,
+        "lbfgs_compile_s": round(compile_s - run_s, 2),
+        "lbfgs_iters_per_sec": round(k / run_s, 2) if k else None,
+        "lbfgs_final_loss": round(float(hist[k]), 6),
+        "lbfgs_iters_to_match_agd": (int(hits[0]) + 1 if len(hits)
+                                     else None),
+        "lbfgs_fn_evals": int(res.num_fn_evals),
+        "lbfgs_ls_failed": bool(res.ls_failed),
+    }
+
+
 def _cast_features(X, dtype: str):
     """Features to bf16 (values only — ids/labels/masks stay as-is): the
     TPU-native dtype, halving the dominant HBM traffic.  Weights and all
@@ -154,7 +201,7 @@ def _cast_features(X, dtype: str):
 def run_config(config: BenchConfig, scale: float, iters: int,
                gd_cap: int = 0, eps: float = 1e-3,
                use_pallas: bool = False, dtype: str = "f32",
-               data=None) -> dict:
+               data=None, lbfgs: bool = False) -> dict:
     """One measured record.  ``data`` (optional pre-generated ``(X, y)``)
     lets a caller measuring several dtypes of the same config pay
     ``make_data`` once; features are cast per call."""
@@ -231,6 +278,13 @@ def run_config(config: BenchConfig, scale: float, iters: int,
         "backtracks": int(res.num_backtracks),
         "restarts": int(res.num_restarts),
     }
+    if lbfgs:
+        try:
+            rec.update(lbfgs_comparison(config, data, w0, iters,
+                                        final_loss))
+        except Exception as e:  # noqa: BLE001 — the ride-along must not
+            # discard the already-measured AGD fields above
+            rec["lbfgs_error"] = f"{type(e).__name__}: {e}"[:300]
     return rec
 
 
@@ -257,6 +311,12 @@ def main(argv=None):
                         "through the fused Pallas kernels on eligible "
                         "configs (same generated data; GD oracle skipped "
                         "- it would repeat the base pass's answer)")
+    p.add_argument("--lbfgs", action="store_true",
+                   help="ride-along L-BFGS comparison per dtype pass "
+                        "(lbfgs_* fields): the Optimizer family's other "
+                        "member, measured with the same compile-once "
+                        "steady-state protocol; L1 configs report a "
+                        "not-applicable note")
     p.add_argument("--pallas", action="store_true",
                    help="use the fused Pallas kernel on eligible dense "
                         "margin configs")
@@ -303,15 +363,16 @@ def main(argv=None):
         # copy, a ~1.5x-dataset HBM peak).  Each config's tpu_scale is
         # sized with >=2x headroom so that peak fits one chip — see the
         # per-config comments above.
-        variants = [(dt, args.pallas, args.gd_cap) for dt in dtypes]
+        variants = [(dt, args.pallas, args.gd_cap, args.lbfgs)
+                    for dt in dtypes]
         if args.pallas_extra and cfg.pallas_ok and not args.pallas:
-            variants.append(("f32", True, 0))
-        for dt, pallas, gd_cap in variants:
+            variants.append(("f32", True, 0, False))
+        for dt, pallas, gd_cap, lbfgs in variants:
             try:
                 rec = run_config(cfg, scale, args.iters,
                                  gd_cap=gd_cap,
                                  use_pallas=pallas, dtype=dt,
-                                 data=data)
+                                 data=data, lbfgs=lbfgs)
             except Exception as e:  # noqa: BLE001 — one config must not
                 # take down the others; the record carries the error
                 import traceback

@@ -3,11 +3,15 @@ profiling.  The reference inherits all of this from Spark or omits it; here
 each is a small first-class module."""
 
 from .checkpoint import (  # noqa: F401
+    CheckpointedLBFGSResult,
     CheckpointedResult,
     fresh_warm_state,
     load_checkpoint,
+    load_lbfgs_checkpoint,
     run_agd_checkpointed,
+    run_lbfgs_checkpointed,
     save_checkpoint,
+    save_lbfgs_checkpoint,
     warm_from_result,
 )
 from .logging import iteration_records, log_result, make_host_logger  # noqa: F401

@@ -42,6 +42,13 @@ def _flat(tree):
     return jax.tree_util.tree_leaves(tree)
 
 
+def _load_tree(data, treedef, n: int, name: str):
+    """Rebuild one pytree from ``{name}_{i}`` npz entries — the ONE copy
+    of the leaf-naming scheme all loaders share."""
+    leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def problem_fingerprint(w0: Any, config: AGDConfig) -> str:
     """A stable id of what a checkpoint continues: the weight pytree's
     structure/shapes/dtypes plus every config field except
@@ -134,9 +141,12 @@ def load_checkpoint(path: str, template: Any,
                 "(run_agd_multi_checkpointed); load it with "
                 "load_multi_checkpoint / resume it with the multi "
                 "driver")
-        def tree(name):
-            leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
-            return jax.tree_util.tree_unflatten(treedef, leaves)
+        if "lbfgs" in data:
+            raise ValueError(
+                f"checkpoint at {path!r} is an L-BFGS checkpoint "
+                "(run_lbfgs_checkpointed); load it with "
+                "load_lbfgs_checkpoint")
+        tree = lambda name: _load_tree(data, treedef, n, name)
 
         warm = AGDWarmState(
             x=tree("x"), z=tree("z"),
@@ -305,9 +315,7 @@ def load_multi_checkpoint(path: str, template: Any,
                 f"checkpoint at {path!r} is a single-run checkpoint, "
                 "not a multi-lane one")
 
-        def tree(name):
-            leaves = [jnp.asarray(data[f"{name}_{i}"]) for i in range(n)]
-            return jax.tree_util.tree_unflatten(treedef, leaves)
+        tree = lambda name: _load_tree(data, treedef, n, name)
 
         warm = host_agd.HostMultiWarm(
             x=tree("x"), z=tree("z"),
@@ -400,3 +408,170 @@ def run_agd_multi_checkpointed(
 
 def _n_lanes(w0_stacked) -> int:
     return jax.tree_util.tree_leaves(w0_stacked)[0].shape[0]
+
+
+# ---------------------------------------------------------------------------
+# L-BFGS checkpointing: same format discipline (atomic npz, fingerprint,
+# terminal semantics) for the quasi-Newton host driver.  The carry is
+# larger than AGD's "2 vectors + 3 scalars": weights, gradient, and up to
+# m curvature pairs — core.host_lbfgs.HostLBFGSWarm — but the same
+# kill/resume contract holds: a resumed chain reproduces the
+# uninterrupted run exactly (gradient and pairs carry over, nothing is
+# re-evaluated at the junction).
+
+
+def save_lbfgs_checkpoint(path: str, warm, loss_history=None, *,
+                          converged: bool = False,
+                          ls_failed: bool = False,
+                          aborted: bool = False,
+                          fingerprint: Optional[str] = None) -> None:
+    """Atomic write of a ``core.host_lbfgs.HostLBFGSWarm`` (+ cumulative
+    history).  ``converged``/``ls_failed``/``aborted`` mark a terminal
+    checkpoint — resuming is a no-op."""
+    payload = {"lbfgs": np.asarray(True)}
+    for i, leaf in enumerate(_flat(warm.w)):
+        payload[f"w_{i}"] = np.asarray(leaf)
+    for i, leaf in enumerate(_flat(warm.g)):
+        payload[f"g_{i}"] = np.asarray(leaf)
+    payload["f"] = np.asarray(float(warm.f))
+    payload["prior_iters"] = np.asarray(int(warm.prior_iters))
+    payload["n_pairs"] = np.asarray(len(warm.pairs))
+    payload["rho"] = np.asarray([p[2] for p in warm.pairs], np.float64)
+    for k, (s, y, _) in enumerate(warm.pairs):
+        for i, leaf in enumerate(_flat(s)):
+            payload[f"p{k}s_{i}"] = np.asarray(leaf)
+        for i, leaf in enumerate(_flat(y)):
+            payload[f"p{k}y_{i}"] = np.asarray(leaf)
+    payload["converged"] = np.asarray(bool(converged))
+    payload["ls_failed"] = np.asarray(bool(ls_failed))
+    payload["aborted"] = np.asarray(bool(aborted))
+    if fingerprint is not None:
+        payload["fingerprint"] = np.asarray(fingerprint)
+    payload["loss_history"] = (np.zeros(0) if loss_history is None
+                               else np.asarray(loss_history))
+    atomic_savez(path, payload)
+
+
+class LoadedLBFGSCheckpoint(NamedTuple):
+    warm: Any  # core.host_lbfgs.HostLBFGSWarm
+    loss_history: np.ndarray
+    converged: bool
+    ls_failed: bool
+    aborted: bool
+    fingerprint: Optional[str]
+
+
+def load_lbfgs_checkpoint(path: str, template: Any,
+                          expect_fingerprint: Optional[str] = None,
+                          ) -> Optional[LoadedLBFGSCheckpoint]:
+    """Rebuild an L-BFGS checkpoint; None if absent.  ``template``
+    supplies the weight pytree structure (normally ``w0``)."""
+    from ..core.host_lbfgs import HostLBFGSWarm
+
+    if not os.path.exists(path):
+        return None
+    treedef = jax.tree_util.tree_structure(template)
+    n = treedef.num_leaves
+    with np.load(path) as data:
+        if "lbfgs" not in data:
+            raise ValueError(
+                f"checkpoint at {path!r} is not an L-BFGS checkpoint; "
+                "load it with load_checkpoint / load_multi_checkpoint")
+        fp = str(data["fingerprint"]) if "fingerprint" in data else None
+        if (expect_fingerprint is not None and fp is not None
+                and fp != expect_fingerprint):
+            raise ValueError(
+                f"checkpoint at {path!r} belongs to a different problem "
+                "(weight structure or config changed); delete it or use "
+                "a different path")
+
+        tree = lambda name: _load_tree(data, treedef, n, name)
+
+        rho = np.asarray(data["rho"])
+        pairs = tuple(
+            (tree(f"p{k}s"), tree(f"p{k}y"), float(rho[k]))
+            for k in range(int(data["n_pairs"])))
+        warm = HostLBFGSWarm(
+            w=tree("w"), f=float(data["f"]), g=tree("g"), pairs=pairs,
+            prior_iters=int(data["prior_iters"]))
+        out = LoadedLBFGSCheckpoint(
+            warm, np.asarray(data["loss_history"]),
+            bool(data["converged"]), bool(data["ls_failed"]),
+            bool(data["aborted"]), fp)
+    return out
+
+
+class CheckpointedLBFGSResult(NamedTuple):
+    weights: Any
+    loss_history: np.ndarray
+    num_iters: int  # TOTAL iterations across all segments
+    converged: bool
+    ls_failed: bool
+    aborted_non_finite: bool
+    resumed_from: int
+
+
+def run_lbfgs_checkpointed(
+    objective,
+    w0: Any,
+    config,
+    path: str,
+    *,
+    segment_iters: int = 10,
+) -> CheckpointedLBFGSResult:
+    """Host L-BFGS with periodic checkpoints: ``segment_iters``
+    iterations per segment, carry persisted after each.  Kill the
+    process anywhere; rerunning the same call continues from the last
+    completed segment to the same answer as an uninterrupted run
+    (``core.host_lbfgs``'s exact-resume contract)."""
+    from ..core import host_lbfgs
+
+    if segment_iters <= 0:
+        raise ValueError("segment_iters must be positive")
+    fp = problem_fingerprint(w0, config)
+    loaded = load_lbfgs_checkpoint(path, w0, expect_fingerprint=fp)
+    if loaded is not None:
+        warm = loaded.warm
+        hist = list(np.asarray(loaded.loss_history))
+        if loaded.converged or loaded.ls_failed or loaded.aborted:
+            return CheckpointedLBFGSResult(
+                weights=warm.w, loss_history=np.asarray(hist),
+                num_iters=int(warm.prior_iters),
+                converged=loaded.converged, ls_failed=loaded.ls_failed,
+                aborted_non_finite=loaded.aborted,
+                resumed_from=int(warm.prior_iters))
+    else:
+        warm = None
+        hist = []
+    resumed_from = int(warm.prior_iters) if warm is not None else 0
+
+    total = config.num_iterations
+    converged = ls_failed = aborted = False
+    while True:
+        prior = warm.prior_iters if warm is not None else 0
+        if warm is not None and prior >= total:
+            break
+        # a fresh run enters at least once even when total == 0, so the
+        # w0 evaluation happens and the return below has a carry
+        cap = min(prior + segment_iters, total)
+        cfg_k = dataclasses.replace(config, num_iterations=cap)
+        res = host_lbfgs.run_lbfgs_host(objective, w0, cfg_k, warm=warm)
+        seg_hist = np.asarray(res.loss_history)
+        hist.extend(seg_hist.tolist() if not hist
+                    else seg_hist[1:].tolist())
+        warm = host_lbfgs.HostLBFGSWarm.from_result(
+            res, prior_iters=prior)
+        converged = bool(res.converged)
+        ls_failed = bool(res.ls_failed)
+        aborted = bool(res.aborted_non_finite)
+        save_lbfgs_checkpoint(path, warm, np.asarray(hist),
+                              converged=converged, ls_failed=ls_failed,
+                              aborted=aborted, fingerprint=fp)
+        if converged or ls_failed or aborted or res.num_iters == 0:
+            break
+
+    return CheckpointedLBFGSResult(
+        weights=warm.w, loss_history=np.asarray(hist),
+        num_iters=int(warm.prior_iters), converged=converged,
+        ls_failed=ls_failed, aborted_non_finite=aborted,
+        resumed_from=resumed_from)

@@ -313,3 +313,103 @@ class TestHostDriverCheckpoint:
                 sm, px, rv, jnp.zeros(d, jnp.float32),
                 agd.AGDConfig(num_iterations=2), path=str(tmp_path / "x"),
                 driver="banana")
+
+
+class TestLBFGSCheckpoint:
+    """run_lbfgs_checkpointed: the quasi-Newton member's kill/resume —
+    the curvature pairs must survive the file round-trip so a resumed
+    chain is the uninterrupted run, not a fresh L-BFGS start."""
+
+    def _objective(self, seed=5, n=300, d=8, reg=0.04):
+        rng = np.random.default_rng(seed)
+        X = rng.standard_normal((n, d))
+        y = (rng.random(n) < 0.5).astype(np.float64)
+        from spark_agd_tpu.core import lbfgs as lbfgs_lib, smooth
+        from spark_agd_tpu.ops.losses import LogisticGradient
+        from spark_agd_tpu.ops.prox import SquaredL2Updater
+
+        sm = smooth.make_smooth(LogisticGradient(), jnp.asarray(X),
+                                jnp.asarray(y))
+        return lbfgs_lib.make_objective(sm, SquaredL2Updater(), reg), d
+
+    def test_segmented_equals_straight(self, tmp_path):
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        obj, d = self._objective()
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=40)
+        straight = host_lbfgs.run_lbfgs_host(obj, np.zeros(d), cfg)
+        path = str(tmp_path / "lb.npz")
+        seg = ckpt.run_lbfgs_checkpointed(
+            obj, np.zeros(d), cfg, path, segment_iters=2)
+        assert seg.resumed_from == 0
+        assert seg.num_iters == straight.num_iters
+        assert seg.converged == straight.converged
+        np.testing.assert_array_equal(np.asarray(seg.weights),
+                                      np.asarray(straight.weights))
+        np.testing.assert_array_equal(seg.loss_history,
+                                      straight.loss_history)
+
+    def test_kill_and_resume_parity(self, tmp_path):
+        """Simulate a kill by capping iterations low, then rerun the
+        full call at the same path: it must resume (resumed_from > 0)
+        and land exactly on the uninterrupted answer."""
+        import dataclasses
+
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        obj, d = self._objective()
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=40)
+        straight = host_lbfgs.run_lbfgs_host(obj, np.zeros(d), cfg)
+        path = str(tmp_path / "lb.npz")
+        cfg_killed = dataclasses.replace(cfg, num_iterations=4)
+        part = ckpt.run_lbfgs_checkpointed(
+            obj, np.zeros(d), cfg_killed, path, segment_iters=2)
+        assert part.num_iters == 4 and not part.converged
+        full = ckpt.run_lbfgs_checkpointed(
+            obj, np.zeros(d), cfg, path, segment_iters=3)
+        assert full.resumed_from == 4
+        np.testing.assert_array_equal(np.asarray(full.weights),
+                                      np.asarray(straight.weights))
+        np.testing.assert_array_equal(full.loss_history,
+                                      straight.loss_history)
+
+    def test_terminal_checkpoint_short_circuits(self, tmp_path):
+        from spark_agd_tpu.core import lbfgs as lbfgs_lib
+
+        obj, d = self._objective()
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=40)
+        path = str(tmp_path / "lb.npz")
+        first = ckpt.run_lbfgs_checkpointed(
+            obj, np.zeros(d), cfg, path, segment_iters=5)
+        assert first.converged
+        calls = []
+        counting = lambda w: (calls.append(1), obj(w))[1]
+        again = ckpt.run_lbfgs_checkpointed(
+            counting, np.zeros(d), cfg, path, segment_iters=5)
+        assert calls == []  # no objective work on a terminal resume
+        assert again.num_iters == first.num_iters
+        np.testing.assert_array_equal(np.asarray(again.weights),
+                                      np.asarray(first.weights))
+
+    def test_wrong_loader_rejected(self, tmp_path):
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        obj, d = self._objective()
+        cfg = lbfgs_lib.LBFGSConfig(num_iterations=3,
+                                    convergence_tol=0.0)
+        path = str(tmp_path / "lb.npz")
+        ckpt.run_lbfgs_checkpointed(obj, np.zeros(d), cfg, path,
+                                          segment_iters=3)
+        with pytest.raises(ValueError, match="L-BFGS checkpoint"):
+            ckpt.load_checkpoint(path, np.zeros(d))
+        # and the reverse direction
+        agd_path = str(tmp_path / "agd.npz")
+        from spark_agd_tpu.core.agd import AGDConfig, AGDWarmState
+
+        ckpt.save_checkpoint(
+            agd_path, AGDWarmState.initial(np.zeros(d), AGDConfig()))
+        with pytest.raises(ValueError, match="not an L-BFGS"):
+            ckpt.load_lbfgs_checkpoint(agd_path, np.zeros(d))
