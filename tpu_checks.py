@@ -298,9 +298,47 @@ def main(argv=None):
         "speedup_vs_k_fits": round(sw_k * single_s / sweep_s, 2),
         "rel_final_loss_err_lane0": rel_loss,
         "rel_weight_err_lane0": rel_w, "ok": bool(sw_ok)}), flush=True)
+    # Fused L-BFGS on the chip (r3: the Optimizer family's quasi-Newton
+    # member) — same problem as the sweep's lane 0, one extra moderate
+    # compile (the probe's fused-small canary precedes every checks
+    # stage, so a wedge would have been named there first).  Reports
+    # steady-state iters/sec and iterations-to-match AGD's final loss.
+    lb_fit = api.make_lbfgs_runner(
+        (Xsw, ysw), LogisticGradient(), SquaredL2Updater(),
+        reg_param=regs[0], num_iterations=sw_iters,
+        convergence_tol=0.0, mesh=False)
+    t0 = time.perf_counter()
+    lr = lb_fit(w0sw)
+    jax.block_until_ready(lr.weights)
+    lb_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    lr = lb_fit(w0sw)
+    jax.block_until_ready(lr.weights)
+    lb_s = time.perf_counter() - t0
+    lk = int(lr.num_iters)
+    lb_hist = np.asarray(lr.loss_history)
+    hits = np.nonzero(lb_hist[1:lk + 1] <= ref_loss * (1 + 1e-6))[0]
+    # gate like the sibling checks: a functional quasi-Newton run must
+    # land at least as low as AGD's same-iteration-cap answer (1% slack
+    # for branch noise) — a wedged Wolfe search or divergence fails
+    lb_ok = (lk > 0 and bool(np.isfinite(lb_hist[lk]))
+             and float(lb_hist[lk]) <= ref_loss * (1 + 1e-2))
+    failures += not lb_ok
+    print(json.dumps({
+        "check": "lbfgs_fused_on_chip",
+        "rows": sw_n, "d": sw_d, "iters": lk,
+        "compile_s": round(lb_compile - lb_s, 1),
+        "iters_per_sec": round(lk / lb_s, 2) if lk else None,
+        "fn_evals": int(lr.num_fn_evals),
+        "final_loss": float(lb_hist[lk]),
+        "agd_final_loss": ref_loss,
+        "iters_to_match_agd": (int(hits[0]) + 1 if len(hits)
+                               else None),
+        "ls_failed": bool(lr.ls_failed), "ok": bool(lb_ok)}),
+        flush=True)
     # the runner closures capture the prepared X inside their jitted
     # smooths — dropping them is what actually frees the 512 MiB dataset
-    del Xsw, ysw, res, r1, sweep_fit, fit
+    del Xsw, ysw, res, r1, sweep_fit, fit, lb_fit, lr
 
     # Sparse gradient layouts on the real chip: scatter-add vs the
     # column-sorted CSC twin (ops/sparse.py docstring) at rcv1-like
