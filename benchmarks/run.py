@@ -127,19 +127,16 @@ def lbfgs_comparison(config: BenchConfig, data, w0, iters: int,
     """The OTHER Optimizer-family comparison (``lbfgs_*`` fields):
     MLlib users weigh AGD not only against GD but against LBFGS, the
     package's strong default.  Measured the same way as the AGD pass
-    (compile-once runner, steady-state second fit); applicable only to
-    smooth penalties — config 3's L1 reports a note instead, matching
-    MLlib 1.3's own LBFGS limitation."""
+    (compile-once runner, steady-state second fit).  Smooth penalties
+    run strong-Wolfe L-BFGS; L1 configs dispatch to OWL-QN (r3 —
+    ``lbfgs_algorithm`` names which ran), so config 3 measures too
+    (with AGD's own hinge-subgradient caveat)."""
     import jax
 
-    from spark_agd_tpu.core import lbfgs as lbfgs_lib
-
     updater = config.updater()
-    try:
-        lbfgs_lib.check_smooth_penalty(updater, config.reg_param)
-    except ValueError:
-        return {"lbfgs_note":
-                "prox-only penalty: not applicable (MLlib 1.3 parity)"}
+    if updater.owlqn_decomposition(float(config.reg_param)) is None:
+        return {"lbfgs_note": "penalty unsupported by the quasi-Newton "
+                              "drivers"}
     fit = api.make_lbfgs_runner(
         data, config.gradient(), updater, convergence_tol=0.0,
         num_iterations=iters, reg_param=config.reg_param)
@@ -158,6 +155,7 @@ def lbfgs_comparison(config: BenchConfig, data, w0, iters: int,
     hits = np.nonzero(hist[1:k + 1]
                       <= agd_final_loss * (1 + 1e-6))[0]
     return {
+        "lbfgs_algorithm": fit.algorithm,
         "lbfgs_iters": k,
         "lbfgs_compile_s": round(compile_s - run_s, 2),
         "lbfgs_iters_per_sec": round(k / run_s, 2) if k else None,

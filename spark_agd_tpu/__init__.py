@@ -73,6 +73,7 @@ from .core.lbfgs import (  # noqa: F401
     LBFGSConfig,
     LBFGSResult,
     make_objective as make_lbfgs_objective,
+    run_owlqn,
 )
 from .core.host_lbfgs import (  # noqa: F401
     HostLBFGSResult,

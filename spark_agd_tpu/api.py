@@ -964,29 +964,48 @@ def make_lbfgs_runner(
     1.3's ``LBFGS``, the other optimizer the reference is drop-in
     interchangeable with; SURVEY §1 L5).
 
-    The objective is the mean data loss plus the updater's SMOOTH
-    penalty folded in (value + gradient) — exactly MLlib LBFGS's
-    ``CostFun`` treatment of ``SquaredL2Updater``.  A prox-only updater
-    (``L1Updater`` and friends) is rejected up front: MLlib 1.3 has the
-    same limitation (no OWLQN yet); use AGD for non-smooth penalties.
+    The objective is the mean data loss plus the updater's penalty.
+    Smooth (L2) penalties fold straight into the objective — exactly
+    MLlib LBFGS's ``CostFun`` treatment of ``SquaredL2Updater`` — and
+    run the strong-Wolfe L-BFGS; an L1 / elastic-net updater routes to
+    **OWL-QN** (``core.lbfgs.run_owlqn``) via
+    ``Prox.owlqn_decomposition``, the same lift Spark applied after 1.3
+    (Breeze OWLQN under ``ml``).  An updater offering neither split is
+    rejected before any data staging.
 
     ``mesh`` composes exactly as in :func:`make_runner`: the psum lives
     inside the objective, so the identical fused minimizer (two-loop
     recursion + Wolfe search as one ``lax.while_loop`` program,
     ``core/lbfgs.py``) runs single-device or row-sharded.
     """
-    from .core import lbfgs as lbfgs_lib
+    from .core import lbfgs as lbfgs_lib, tvec
 
-    lbfgs_lib.check_smooth_penalty(updater, reg_param)  # before any
-    # data staging: a prox-only updater must fail free
+    decomp = updater.owlqn_decomposition(float(reg_param))  # before
+    # any data staging: an unsupported updater must fail free
+    if decomp is None:
+        raise ValueError(
+            f"{type(updater).__name__} offers neither a smooth penalty "
+            "nor an L1+smooth split (Prox.owlqn_decomposition); the "
+            "quasi-Newton drivers cannot represent it — use "
+            "AcceleratedGradientDescent")
+    l1_coeff, extra = decomp
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
     sm, _ = _build_smooth(gradient, data, m, dist_mode)
     cfg = lbfgs_lib.LBFGSConfig(
         num_corrections=num_corrections,
         convergence_tol=convergence_tol,
         num_iterations=num_iterations, grad_tol=grad_tol)
-    objective = lbfgs_lib.make_objective(sm, updater, reg_param)
-    step = jax.jit(lambda w: lbfgs_lib.run_lbfgs(objective, w, cfg))
+
+    def objective(w):
+        f, g = sm(w)
+        pv, pg = extra(w)
+        return f + pv, tvec.add(g, pg)
+
+    if l1_coeff > 0:
+        step = jax.jit(lambda w: lbfgs_lib.run_owlqn(objective, w,
+                                                     l1_coeff, cfg))
+    else:
+        step = jax.jit(lambda w: lbfgs_lib.run_lbfgs(objective, w, cfg))
 
     def fit(initial_weights):
         w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
@@ -994,6 +1013,9 @@ def make_lbfgs_runner(
             w0 = mesh_lib.replicate(w0, m)
         return step(w0)
 
+    # which driver the dispatch chose — reporting callers (benchmarks)
+    # must label numbers with the REAL dispatch, not re-derive it
+    fit.algorithm = "owlqn" if l1_coeff > 0 else "lbfgs"
     return fit
 
 

@@ -60,6 +60,19 @@ class Prox:
         penalty"; callers needing a smooth objective must reject it."""
         return None
 
+    def owlqn_decomposition(self, reg):
+        """``(l1_coeff, smooth_fn)`` splitting this penalty into an
+        ``l1_coeff·‖w‖₁`` part (handled by OWL-QN's pseudo-gradients)
+        plus a differentiable remainder ``smooth_fn(w) -> (value,
+        grad)`` — or ``None`` when the penalty fits neither form.
+        This is how the quasi-Newton driver covers the FULL updater
+        menu: smooth penalties route to plain L-BFGS (``l1_coeff`` 0),
+        L1/elastic-net to OWL-QN — the lift Spark itself applied after
+        1.3 (Breeze OWLQN under ``ml``)."""
+        if self.smooth_penalty(jnp.zeros(()), float(reg)) is None:
+            return None
+        return 0.0, lambda w: self.smooth_penalty(w, reg)
+
 
 def _scalar_dtype(w):
     import jax
@@ -145,6 +158,11 @@ class L1Prox(Prox):
     def reg_value(self, w, reg):
         return reg * tvec.l1_norm(w)
 
+    def owlqn_decomposition(self, reg):
+        zero = lambda w: (jnp.zeros((), _scalar_dtype(w)),
+                          tvec.zeros_like(w))
+        return float(reg), zero
+
 
 class ElasticNetProx(Prox):
     """Prox of ``reg·(l1_ratio·‖w‖₁ + (1-l1_ratio)/2·‖w‖²)``.
@@ -174,6 +192,12 @@ class ElasticNetProx(Prox):
         l1 = reg * self.l1_ratio
         l2 = reg * (1.0 - self.l1_ratio)
         return l1 * tvec.l1_norm(w) + 0.5 * l2 * tvec.sq_norm(w)
+
+    def owlqn_decomposition(self, reg):
+        l2 = reg * (1.0 - self.l1_ratio)
+        smooth = lambda w: (0.5 * l2 * tvec.sq_norm(w),
+                            tvec.scale(l2, w))
+        return float(reg * self.l1_ratio), smooth
 
 
 # API-parity aliases (the names user code migrating from the reference knows).

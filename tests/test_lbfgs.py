@@ -134,12 +134,17 @@ class TestBehavior:
         k = int(res.num_iters)
         assert hist[min(k, 10)] <= float(np.asarray(gd_hist)[-1]) + 1e-12
 
-    def test_prox_only_updater_rejected(self, rng):
-        X, y = logistic_problem(rng, n=50, d=4)
-        with pytest.raises(ValueError, match="smooth penalty"):
-            api.run_lbfgs((X, y), losses.LogisticGradient(),
-                          prox.L1Updater(), reg_param=0.1,
-                          initial_weights=np.zeros(4), mesh=False)
+    def test_l1_updater_routes_to_owlqn(self, rng):
+        """An L1 updater is no longer rejected: it dispatches to the
+        OWL-QN driver (the post-1.3 Spark lift) and produces a sparse
+        solution of the same L1 objective."""
+        X, y = logistic_problem(rng, n=120, d=6)
+        res = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                            prox.L1Updater(), reg_param=0.1,
+                            convergence_tol=1e-10, num_iterations=100,
+                            initial_weights=np.zeros(6), mesh=False)
+        assert bool(res.converged)
+        assert np.all(np.isfinite(np.asarray(res.weights)))
 
     def test_non_finite_objective_aborts(self, rng):
         X = rng.standard_normal((20, 3))
@@ -290,6 +295,113 @@ class TestHostTwin:
         with pytest.raises(ValueError, match="smooth penalty"):
             lbfgs_lib.make_objective(lambda w: (0.0, w),
                                      prox.L1Updater(), 0.1)
+
+
+class TestOWLQN:
+    """run_owlqn vs prox-AGD: both minimize the identical convex
+    F(w) = f(w) + l1·‖w‖₁, so the proximal member IS the independent
+    oracle for the orthant-wise one (and vice versa)."""
+
+    def _objective_F(self, X, y, l1):
+        n = X.shape[0]
+
+        def F(w):
+            z = X @ w
+            return float(np.mean(np.logaddexp(0, z) - y * z)
+                         + l1 * np.abs(w).sum())
+
+        return F
+
+    def test_matches_prox_agd_on_l1_logistic(self, rng):
+        X, y = logistic_problem(rng, n=400, d=12)
+        l1 = 0.05
+        res = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                            prox.L1Updater(), reg_param=l1,
+                            convergence_tol=1e-12, num_iterations=300,
+                            initial_weights=np.zeros(12), mesh=False)
+        w_agd, hist = api.run((X, y), losses.LogisticGradient(),
+                              prox.L1Prox(), reg_param=l1,
+                              convergence_tol=1e-12,
+                              num_iterations=2000,
+                              initial_weights=np.zeros(12), mesh=False)
+        F = self._objective_F(X, y, l1)
+        f_owl, f_agd = F(np.asarray(res.weights)), F(np.asarray(w_agd))
+        # same optimum from two unrelated algorithms
+        assert abs(f_owl - f_agd) <= 1e-6 * max(abs(f_agd), 1.0), \
+            (f_owl, f_agd)
+        # the history tracks the FULL objective and matches F at exit
+        k = int(res.num_iters)
+        np.testing.assert_allclose(float(res.loss_history[k]), f_owl,
+                                   rtol=1e-9)
+
+    def test_produces_exact_zeros(self, rng):
+        X, y = logistic_problem(rng, n=300, d=20)
+        res = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                            prox.L1Updater(), reg_param=0.15,
+                            convergence_tol=1e-11, num_iterations=200,
+                            initial_weights=np.zeros(20), mesh=False)
+        w = np.asarray(res.weights)
+        # the orthant projection writes EXACT zeros, not small values
+        assert np.sum(w == 0.0) > 0, w
+        agd_w, _ = api.run((X, y), losses.LogisticGradient(),
+                           prox.L1Prox(), reg_param=0.15,
+                           convergence_tol=1e-12, num_iterations=2000,
+                           initial_weights=np.zeros(20), mesh=False)
+        # same support as the soft-thresholding prox finds
+        assert set(np.nonzero(w)[0]) == set(
+            np.nonzero(np.asarray(agd_w))[0])
+
+    def test_elastic_net_dispatch(self, rng):
+        X, y = logistic_problem(rng, n=250, d=8)
+        en = prox.ElasticNetProx(l1_ratio=0.5)
+        res = api.run_lbfgs((X, y), losses.LogisticGradient(), en,
+                            reg_param=0.1, convergence_tol=1e-12,
+                            num_iterations=300,
+                            initial_weights=np.zeros(8), mesh=False)
+        w_agd, _ = api.run((X, y), losses.LogisticGradient(), en,
+                           reg_param=0.1, convergence_tol=1e-12,
+                           num_iterations=2000,
+                           initial_weights=np.zeros(8), mesh=False)
+        n = X.shape[0]
+
+        def F(w):
+            z = X @ w
+            return float(np.mean(np.logaddexp(0, z) - y * z)
+                         + 0.05 * np.abs(w).sum()
+                         + 0.025 * (w @ w))
+
+        assert abs(F(np.asarray(res.weights))
+                   - F(np.asarray(w_agd))) <= 1e-6
+
+    def test_mesh_matches_single_device(self, rng, mesh8):
+        X, y = logistic_problem(rng, n=300, d=10)
+        kw = dict(reg_param=0.08, convergence_tol=0.0,
+                  num_iterations=8, initial_weights=np.zeros(10))
+        res_1 = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                              prox.L1Updater(), mesh=False, **kw)
+        res_m = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                              prox.L1Updater(), mesh=mesh8, **kw)
+        assert int(res_m.num_iters) == int(res_1.num_iters)
+        np.testing.assert_allclose(np.asarray(res_m.loss_history),
+                                   np.asarray(res_1.loss_history),
+                                   rtol=1e-8, atol=1e-11)
+        np.testing.assert_allclose(np.asarray(res_m.weights),
+                                   np.asarray(res_1.weights),
+                                   rtol=1e-7, atol=1e-10)
+
+    def test_l1_zero_is_plain_lbfgs(self, rng):
+        """ElasticNet with l1_ratio=0 dispatches to the smooth driver
+        and matches an explicit L2 run exactly."""
+        X, y = logistic_problem(rng, n=200, d=6)
+        kw = dict(reg_param=0.1, convergence_tol=1e-10,
+                  num_iterations=100, initial_weights=np.zeros(6),
+                  mesh=False)
+        r_en = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                             prox.ElasticNetProx(l1_ratio=0.0), **kw)
+        r_l2 = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                             prox.L2Prox(), **kw)
+        np.testing.assert_array_equal(np.asarray(r_en.weights),
+                                      np.asarray(r_l2.weights))
 
 
 class TestMesh:
