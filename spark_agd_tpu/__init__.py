@@ -79,6 +79,7 @@ from .core.host_lbfgs import (  # noqa: F401
     HostLBFGSResult,
     HostLBFGSWarm,
     run_lbfgs_host,
+    run_owlqn_host,
 )
 from .parallel.mesh import (  # noqa: F401
     ShardedBatch,

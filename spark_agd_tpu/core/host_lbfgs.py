@@ -1,15 +1,19 @@
 """Host-orchestrated L-BFGS: the streaming / cross-process twin of
 ``core.lbfgs``.
 
-Same decision algebra as the fused loop (same Wolfe conditions, same
-curvature safeguard, same convergence test — see ``core/lbfgs.py`` for
-the MLlib/Breeze pinning), but with the outer loop and line search in
-Python and only the math on device, mirroring ``core.host_agd``'s split:
-a *streamed* objective (``data.streaming.make_streaming_smooth`` + the
-updater's smooth penalty) contains a host loop and cannot live inside
+Same decision algebra as the fused loops (same Wolfe / orthant-wise
+conditions, same curvature safeguard, same convergence test — see
+``core/lbfgs.py`` for the MLlib/Breeze pinning), but with the outer
+loop and line search in Python and only the math on device, mirroring
+``core.host_agd``'s split: a *streamed* objective
+(``data.streaming.make_streaming_smooth`` + the updater's smooth
+penalty) contains a host loop and cannot live inside
 ``lax.while_loop``; a cross-process global-array objective cannot be
 closed over by a fused jit.  Control scalars sync to the host once per
 objective evaluation — for macro-batch workloads the stream dominates.
+Both quasi-Newton drivers have host twins: :func:`run_lbfgs_host`
+(smooth penalties, strong Wolfe) and :func:`run_owlqn_host` (L1 /
+elastic net, orthant-wise).
 
 Parity with the fused loop is pinned by
 ``tests/test_lbfgs.py::TestHostTwin`` (identical iteration counts and
@@ -48,6 +52,10 @@ class HostLBFGSResult(NamedTuple):
     # back as ``warm`` to continue precisely where this run stopped
     final_g: Any = None
     final_pairs: tuple = ()
+    # the SMOOTH part's value at exit: for the smooth driver this
+    # equals loss_history[-1]; for OWL-QN the history holds F = f + L1
+    # while the warm carry needs f — from_result uses this when set
+    final_f_smooth: Any = None
 
 
 class HostLBFGSWarm(NamedTuple):
@@ -69,7 +77,9 @@ class HostLBFGSWarm(NamedTuple):
                     prior_iters: int = 0) -> "HostLBFGSWarm":
         """The carry out of a finished segment; ``prior_iters`` is the
         iteration total BEFORE that segment (chain it forward)."""
-        return cls(w=res.weights, f=float(res.loss_history[-1]),
+        f = (res.final_f_smooth if res.final_f_smooth is not None
+             else res.loss_history[-1])
+        return cls(w=res.weights, f=float(f),
                    g=res.final_g, pairs=tuple(res.final_pairs),
                    prior_iters=prior_iters + res.num_iters)
 
@@ -128,6 +138,30 @@ def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
         f_t, g_t, dg_t = eval_at(t)
 
 
+def _two_loop_host(q0, pairs):
+    """The host two-loop recursion over ``pairs`` (oldest first) — ONE
+    copy shared by both host drivers, same op order as the fused
+    ``lbfgs._two_loop``."""
+    q = q0
+    alphas = []
+    for s, y, rho in reversed(pairs):  # newest -> oldest
+        a = float(rho * tvec.dot(s, q))
+        q = tvec.axpby(1.0, q, -a, y)
+        alphas.append(a)
+    if pairs:
+        s_n, y_n, _ = pairs[-1]
+        yy = float(tvec.dot(y_n, y_n))
+        gamma = float(tvec.dot(s_n, y_n)) / max(
+            yy, np.finfo(np.float64).tiny)
+    else:
+        gamma = 1.0
+    r = tvec.scale(gamma, q)
+    for (s, y, rho), a in zip(pairs, reversed(alphas)):
+        b = float(rho * tvec.dot(y, r))
+        r = tvec.axpby(1.0, r, a - b, s)
+    return r
+
+
 def run_lbfgs_host(
     objective: Callable,
     w0: Any,
@@ -175,25 +209,7 @@ def run_lbfgs_host(
 
     while not (converged or ls_failed or aborted) and \
             it < cfg.num_iterations:
-        # two-loop recursion, same order as lbfgs._two_loop
-        q = g
-        alphas = []
-        for s, y, rho in reversed(pairs):  # newest -> oldest
-            a = float(rho * tvec.dot(s, q))
-            q = tvec.axpby(1.0, q, -a, y)
-            alphas.append(a)
-        if pairs:
-            s_n, y_n, _ = pairs[-1]
-            yy = float(tvec.dot(y_n, y_n))
-            gamma = float(tvec.dot(s_n, y_n)) / max(
-                yy, np.finfo(np.float64).tiny)
-        else:
-            gamma = 1.0
-        r = tvec.scale(gamma, q)
-        for (s, y, rho), a in zip(pairs, reversed(alphas)):
-            b = float(rho * tvec.dot(y, r))
-            r = tvec.axpby(1.0, r, a - b, s)
-        d = tvec.scale(-1.0, r)
+        d = tvec.scale(-1.0, _two_loop_host(g, pairs))
         if not float(tvec.dot(g, d)) < 0:  # stale curvature fallback
             d = tvec.scale(-1.0, g)
 
@@ -230,4 +246,122 @@ def run_lbfgs_host(
         weights=w, loss_history=np.asarray(hist), num_iters=seg_iters,
         converged=converged, ls_failed=ls_failed,
         aborted_non_finite=aborted, grad_norm=float(tvec.norm(g)),
-        num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs))
+        num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs),
+        final_f_smooth=f)
+
+
+def run_owlqn_host(
+    objective_smooth: Callable,
+    w0: Any,
+    l1_reg: float,
+    config: LBFGSConfig = LBFGSConfig(),
+    *,
+    warm: HostLBFGSWarm | None = None,
+    on_iteration: Callable | None = None,
+) -> HostLBFGSResult:
+    """Host-loop OWL-QN — the streamed / cross-process twin of
+    ``core.lbfgs.run_owlqn``, mirroring its decision algebra the way
+    :func:`run_lbfgs_host` mirrors the smooth driver.  ``warm.f``
+    carries the SMOOTH part's value (the L1 term is recomputed from the
+    weights); ``loss_history`` entries are the full objective F.
+    """
+    import jax.numpy as jnp
+
+    cfg = config
+    m = int(cfg.num_corrections)
+    if m < 1:
+        raise ValueError("num_corrections must be >= 1")
+    if l1_reg < 0:
+        raise ValueError("l1_reg must be >= 0")
+    l1 = float(l1_reg)
+
+    from .lbfgs import _pseudo_gradient
+
+    def pseudo_grad(w, g):
+        return _pseudo_gradient(w, g, l1)
+
+    if warm is not None:
+        w, f, g = warm.w, float(warm.f), warm.g
+        pairs: List[tuple] = list(warm.pairs)[-m:]
+        it = int(warm.prior_iters)
+        evals = 0
+    else:
+        f, g = objective_smooth(w0)
+        f = float(f)
+        w = w0
+        pairs = []
+        it = 0
+        evals = 1
+    big_f = f + l1 * float(tvec.l1_norm(w))
+    hist: List[float] = [big_f]
+    converged = ls_failed = aborted = False
+    if not np.isfinite(big_f):
+        aborted = True
+
+    while not (converged or ls_failed or aborted) and \
+            it < cfg.num_iterations:
+        pg = pseudo_grad(w, g)
+        d = tvec.scale(-1.0, _two_loop_host(pg, pairs))
+        d = tvec.tmap(lambda di, pgi: jnp.where(di * pgi < 0, di, 0.0),
+                      d, pg)
+        if float(tvec.dot(d, d)) == 0:
+            d = tvec.scale(-1.0, pg)
+        xi = tvec.tmap(
+            lambda wi, pgi: jnp.where(wi != 0, jnp.sign(wi),
+                                      jnp.sign(-pgi)), w, pg)
+
+        def trial(t):
+            nonlocal evals
+            w_t = tvec.tmap(
+                lambda wi, di, xii: jnp.where(
+                    (wi + t * di) * xii > 0, wi + t * di, 0.0),
+                w, d, xi)
+            f_t, g_t = objective_smooth(w_t)
+            evals += 1
+            return (w_t, float(f_t),
+                    float(f_t) + l1 * float(tvec.l1_norm(w_t)), g_t)
+
+        t, k, ok = 1.0, 0, False
+        while True:
+            w_n, f_n, big_f_n, g_n = trial(t)
+            gain = float(tvec.dot(pg, tvec.sub(w_n, w)))
+            ok = (big_f_n <= big_f + cfg.c1 * gain
+                  and np.isfinite(big_f_n))
+            k += 1
+            if ok or k >= cfg.max_ls_steps:
+                break
+            t *= 0.5
+        if not ok:
+            ls_failed = True
+            # mirror the fused driver's flags: a budget exhausted ON a
+            # non-finite trial also marks the abort
+            aborted = not np.isfinite(big_f_n)
+            break
+        s = tvec.sub(w_n, w)
+        y = tvec.sub(g_n, g)
+        sy = float(tvec.dot(s, y))
+        if sy > 1e-10 * float(tvec.norm(s)) * float(tvec.norm(y)):
+            pairs.append((s, y, 1.0 / sy))
+            if len(pairs) > m:
+                pairs.pop(0)
+        improv = (big_f - big_f_n) / max(abs(big_f), abs(big_f_n), 1.0)
+        if improv <= cfg.convergence_tol:
+            converged = True
+        if cfg.grad_tol > 0 and float(
+                tvec.norm(pseudo_grad(w_n, g_n))) < cfg.grad_tol:
+            converged = True
+        w, f, g, big_f = w_n, f_n, g_n, big_f_n
+        it += 1
+        hist.append(big_f)
+        if on_iteration is not None:
+            on_iteration({"w": w, "f": f, "g": g,
+                          "pairs": tuple(pairs), "it": it})
+
+    seg_iters = it - (int(warm.prior_iters) if warm is not None else 0)
+    return HostLBFGSResult(
+        weights=w, loss_history=np.asarray(hist), num_iters=seg_iters,
+        converged=converged, ls_failed=ls_failed,
+        aborted_non_finite=aborted,
+        grad_norm=float(tvec.norm(pseudo_grad(w, g))),
+        num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs),
+        final_f_smooth=f)

@@ -50,9 +50,10 @@ The smooth penalty (L2) folds INTO the objective — gradient
 representable this way (MLlib 1.3 has the same limitation).  The API
 layer routes L1 / elastic-net updaters to :func:`run_owlqn` below —
 the orthant-wise variant Spark itself adopted after 1.3 — so the
-fused quasi-Newton path covers the full updater menu; only the HOST
-twin (``core/host_lbfgs.py``, streamed/cross-process) remains
-smooth-only.
+quasi-Newton path covers the full updater menu; the HOST twin
+(``core/host_lbfgs.py``) carries both drivers too
+(``run_lbfgs_host`` / ``run_owlqn_host``) for streamed and
+cross-process objectives.
 
 ``loss_history[0]`` is the objective at ``w0``; entry ``i >= 1`` is the
 objective after iteration ``i`` (NaN-padded past ``num_iters``), so

@@ -303,8 +303,6 @@ class TestOWLQN:
     oracle for the orthant-wise one (and vice versa)."""
 
     def _objective_F(self, X, y, l1):
-        n = X.shape[0]
-
         def F(w):
             z = X @ w
             return float(np.mean(np.logaddexp(0, z) - y * z)
@@ -362,7 +360,6 @@ class TestOWLQN:
                            reg_param=0.1, convergence_tol=1e-12,
                            num_iterations=2000,
                            initial_weights=np.zeros(8), mesh=False)
-        n = X.shape[0]
 
         def F(w):
             z = X @ w
@@ -388,6 +385,76 @@ class TestOWLQN:
         np.testing.assert_allclose(np.asarray(res_m.weights),
                                    np.asarray(res_1.weights),
                                    rtol=1e-7, atol=1e-10)
+
+    def test_host_twin_matches_fused(self, rng):
+        """run_owlqn_host mirrors the fused driver's decisions (x64:
+        branch-identical, like the smooth host twin)."""
+        from spark_agd_tpu.core import (host_lbfgs,
+                                        lbfgs as lbfgs_lib, smooth)
+
+        X, y = logistic_problem(rng, n=250, d=9)
+        sm = smooth.make_smooth(losses.LogisticGradient(),
+                                jnp.asarray(X), jnp.asarray(y))
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=80)
+        fused = jax.jit(lambda w: lbfgs_lib.run_owlqn(sm, w, 0.06,
+                                                      cfg))(
+            jnp.zeros(9))
+        host = host_lbfgs.run_owlqn_host(sm, jnp.zeros(9), 0.06, cfg)
+        kf = int(fused.num_iters)
+        assert host.num_iters == kf
+        np.testing.assert_allclose(
+            host.loss_history,
+            np.asarray(fused.loss_history)[:kf + 1], rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(host.weights),
+                                   np.asarray(fused.weights),
+                                   rtol=1e-10, atol=1e-12)
+        assert host.num_fn_evals == int(fused.num_fn_evals)
+
+    def test_streamed_l1_matches_in_memory(self, rng):
+        """Streamed macro-batch OWL-QN == the fused in-memory L1 fit —
+        larger-than-HBM L1 paths for the quasi-Newton member."""
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+        from spark_agd_tpu.data import streaming
+
+        X, y = logistic_problem(rng, n=330, d=8)
+        ds = streaming.StreamingDataset.from_arrays(X, y, batch_rows=64)
+        sm, _ = streaming.make_streaming_smooth(
+            losses.LogisticGradient(), ds, pad_to=64)
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-10,
+                                    num_iterations=60)
+        res_s = host_lbfgs.run_owlqn_host(sm, jnp.zeros(8), 0.07, cfg)
+        res_f = api.run_lbfgs((X, y), losses.LogisticGradient(),
+                              prox.L1Updater(), reg_param=0.07,
+                              convergence_tol=1e-10, num_iterations=60,
+                              initial_weights=np.zeros(8), mesh=False)
+        assert res_s.num_iters == int(res_f.num_iters)
+        np.testing.assert_allclose(np.asarray(res_s.weights),
+                                   np.asarray(res_f.weights),
+                                   rtol=1e-7, atol=1e-9)
+
+    def test_host_warm_resume_is_exact(self, rng):
+        from spark_agd_tpu.core import (host_lbfgs,
+                                        lbfgs as lbfgs_lib, smooth)
+
+        X, y = logistic_problem(rng, n=200, d=7)
+        sm = smooth.make_smooth(losses.LogisticGradient(),
+                                jnp.asarray(X), jnp.asarray(y))
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=50)
+        full = host_lbfgs.run_owlqn_host(sm, jnp.zeros(7), 0.05, cfg)
+        assert full.num_iters >= 4
+        cfg3 = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                     num_iterations=3)
+        s1 = host_lbfgs.run_owlqn_host(sm, jnp.zeros(7), 0.05, cfg3)
+        # from_result picks the SMOOTH part via final_f_smooth (the
+        # history holds F = f + L1), so the carry round-trips directly
+        warm = host_lbfgs.HostLBFGSWarm.from_result(s1)
+        s2 = host_lbfgs.run_owlqn_host(sm, jnp.zeros(7), 0.05, cfg,
+                                       warm=warm)
+        assert 3 + s2.num_iters == full.num_iters
+        np.testing.assert_array_equal(np.asarray(s2.weights),
+                                      np.asarray(full.weights))
 
     def test_l1_zero_is_plain_lbfgs(self, rng):
         """ElasticNet with l1_ratio=0 dispatches to the smooth driver
