@@ -58,6 +58,7 @@ from .api import (  # noqa: F401
     run,
     run_lbfgs,
     make_lbfgs_runner,
+    make_lbfgs_sweep_runner,
     run_minibatch_agd,
     run_minibatch_sgd,
     CVResult,

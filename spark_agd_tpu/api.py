@@ -117,6 +117,22 @@ def _reconcile_runner_mesh(data: Data, mesh, dist_mode: str):
     return data, m, dist_mode
 
 
+def _check_grid_fit(updater, reg_params, op_name: str):
+    """Shared guard for every batched grid fit (AGD sweep/CV, LBFGS
+    sweep): a grid through the identity prox would be silently
+    ignored."""
+    from .ops.prox import IdentityProx
+
+    reg_params = list(reg_params)
+    if isinstance(updater, IdentityProx) and any(
+            float(r) != 0.0 for r in reg_params):
+        raise ValueError(
+            f"the updater is IdentityProx (no penalty), so "
+            f"reg_params would be ignored; use an explicit updater "
+            f"(e.g. L2Prox()) for {op_name}")
+    return reg_params
+
+
 def _build_smooth(gradient, data, mesh, dist_mode):
     if mesh is None:
         if isinstance(data, mesh_lib.ShardedBatch):
@@ -742,18 +758,7 @@ class AcceleratedGradientDescent:
         return weights
 
     def _check_grid_fit(self, reg_params, op_name: str):
-        """Shared guard for the batched grid fits (sweep / CV): a grid
-        through the identity prox would be silently ignored."""
-        from .ops.prox import IdentityProx
-
-        reg_params = list(reg_params)
-        if isinstance(self._updater, IdentityProx) and any(
-                float(r) != 0.0 for r in reg_params):
-            raise ValueError(
-                f"the updater is IdentityProx (no penalty), so "
-                f"reg_params would be ignored; use an explicit updater "
-                f"(e.g. L2Prox()) for {op_name}")
-        return reg_params
+        return _check_grid_fit(self._updater, reg_params, op_name)
 
     def sweep(self, data: Data, reg_params, initial_weights: Any):
         """Regularization path with this object's configuration: K
@@ -1120,3 +1125,93 @@ class LBFGS:
             grad_tol=self._grad_tol, mesh=self._mesh,
             dist_mode=self._dist_mode)
         return res.weights
+
+    def sweep(self, data: Data, reg_params, initial_weights: Any):
+        """Regularization path with this object's configuration: K
+        strengths in one compiled program (module-level
+        :func:`make_lbfgs_sweep_runner`; smooth penalties only —
+        ``set_reg_param`` is ignored, the grid supplies the strengths).
+        Makes the LBFGS-seated trainers' ``train_path`` work like the
+        AGD-seated ones'."""
+        reg_params = _check_grid_fit(self._updater, reg_params, "sweep")
+        fit = make_lbfgs_sweep_runner(
+            data, self._gradient, self._updater,
+            num_corrections=self._num_corrections,
+            convergence_tol=self._convergence_tol,
+            num_iterations=self._num_iterations,
+            grad_tol=self._grad_tol, mesh=self._mesh)
+        return fit(initial_weights, reg_params)
+
+
+def make_lbfgs_sweep_runner(
+    data: Data,
+    gradient: Gradient,
+    updater: Prox,
+    num_corrections: int = 10,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    *,
+    grad_tol: float = 0.0,
+    mesh=False,
+):
+    """Build ``fit(initial_weights, reg_params) -> batched LBFGSResult``
+    — the regularization path for the quasi-Newton member, K lanes in
+    ONE compiled program (the :func:`make_sweep_runner` twin).  Each
+    lane runs the full fused L-BFGS; under ``vmap`` the ``while_loop``
+    freezes finished lanes, so early-converging strengths cost nothing
+    extra.
+
+    SMOOTH penalties only: the lanes trace one objective with a traced
+    ``reg``, which the OWL-QN dispatch (a static decision) cannot join;
+    for an L1 grid run per-strength :func:`run_lbfgs` fits (each one
+    compiled once) or an AGD :func:`sweep`.
+
+    ``mesh``: as in :func:`make_sweep_runner` — ``False`` single-device,
+    a ``Mesh``/``None``/``ShardedBatch`` shards rows with lanes vmapped
+    inside the shard_map (``parallel.grid.make_mesh_lbfgs_sweep_fit``).
+    """
+    from .core import lbfgs as lbfgs_lib, tvec
+
+    lbfgs_lib.check_smooth_penalty(updater, 1.0)
+    cfg = lbfgs_lib.LBFGSConfig(
+        num_corrections=num_corrections,
+        convergence_tol=convergence_tol,
+        num_iterations=num_iterations, grad_tol=grad_tol)
+
+    m, batch, _ = _resolve_fit_mesh(data, mesh)
+    if m is not None:
+        from .parallel import grid
+
+        if batch is None:
+            batch = mesh_lib.shard_batch(m, *_normalize_data(data))
+        mesh_fit = grid.make_mesh_lbfgs_sweep_fit(gradient, updater,
+                                                  batch, m, cfg)
+
+        def fit(initial_weights, reg_params):
+            return mesh_fit(reg_params, initial_weights)
+
+        return fit
+
+    X, y, mask = _normalize_data(data)
+    sm, _ = _build_smooth(gradient, (X, y, mask), None, "shard_map")
+
+    def fit_one(reg, w0):
+        def objective(w):
+            f, g = sm(w)
+            pv, pg = updater.smooth_penalty(w, reg)
+            return f + pv, tvec.add(g, pg)
+
+        return lbfgs_lib.run_lbfgs(objective, w0, cfg)
+
+    step = jax.jit(jax.vmap(fit_one, in_axes=(0, None)))
+
+    def fit(initial_weights, reg_params):
+        # default float dtype (f64 under x64): lane regs must match the
+        # precision a solo fit's python-float reg_param would carry
+        regs = jnp.asarray(reg_params, jnp.result_type(float))
+        if regs.ndim != 1:
+            raise ValueError("reg_params must be 1-D")
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        return step(regs, w0)
+
+    return fit

@@ -84,6 +84,14 @@ class HostLBFGSWarm(NamedTuple):
                    prior_iters=prior_iters + res.num_iters)
 
 
+def _pin_grad(g, w):
+    """Cast a gradient's leaves to the weight leaves' dtypes — the host
+    mirror of the fused drivers' ``lbfgs._pin_objective`` convention
+    (ONE copy; all evaluation sites use it)."""
+    return tvec.tmap(lambda gi, wi: gi.astype(np.asarray(wi).dtype),
+                     g, w)
+
+
 def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
     """Strong-Wolfe search, the eager mirror of ``lbfgs._wolfe_search``
     (same bracket/zoom decisions, same budgets)."""
@@ -93,6 +101,7 @@ def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
     def eval_at(t):
         nonlocal evals
         f, g = objective(tvec.axpby(1.0, w, t, d))
+        g = _pin_grad(g, w)
         evals += 1
         return float(f), g, float(tvec.dot(g, d))
 
@@ -199,6 +208,7 @@ def run_lbfgs_host(
         f, g = objective(w0)
         f = float(f)
         w = w0
+        g = _pin_grad(g, w)
         pairs = []
         it = 0
         evals = 1
@@ -289,6 +299,7 @@ def run_owlqn_host(
         f, g = objective_smooth(w0)
         f = float(f)
         w = w0
+        g = _pin_grad(g, w)
         pairs = []
         it = 0
         evals = 1
@@ -317,6 +328,7 @@ def run_owlqn_host(
                     (wi + t * di) * xii > 0, wi + t * di, 0.0),
                 w, d, xi)
             f_t, g_t = objective_smooth(w_t)
+            g_t = _pin_grad(g_t, w)
             evals += 1
             return (w_t, float(f_t),
                     float(f_t) + l1 * float(tvec.l1_norm(w_t)), g_t)

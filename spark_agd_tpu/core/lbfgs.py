@@ -118,6 +118,23 @@ def make_objective(smooth: Callable, updater, reg_param: float):
     return objective
 
 
+def _carry_dtype(w0):
+    return jnp.promote_types(
+        jnp.result_type(*jax.tree_util.tree_leaves(w0)), jnp.float32)
+
+
+def _pin_objective(objective, w_template, sdtype):
+    """Pin objective outputs to the carry dtype — the AGD core's
+    ``norm_smooth`` convention (core/agd.py): a smooth computing in a
+    wider/narrower dtype (f64 data under x64 with f32 weights) must not
+    leak its dtype into the ``while_loop`` carry."""
+    def obj(w):
+        f, g = objective(w)
+        return jnp.asarray(f, sdtype), tvec.tmap(
+            lambda gi, wi: gi.astype(wi.dtype), g, w_template)
+    return obj
+
+
 class LBFGSResult(NamedTuple):
     weights: Any
     loss_history: jax.Array  # (num_iterations + 1,), NaN-padded
@@ -348,8 +365,9 @@ def run_lbfgs(objective: ObjectiveFn, w0: Any,
     if m < 1:
         raise ValueError("num_corrections must be >= 1")
 
+    sdtype = _carry_dtype(w0)
+    objective = _pin_objective(objective, w0, sdtype)
     f0, g0 = objective(w0)
-    sdtype = jnp.asarray(f0).dtype
     hist0 = jnp.full((cfg.num_iterations + 1,), jnp.nan, sdtype)
     hist0 = hist0.at[0].set(f0)
 
@@ -486,8 +504,9 @@ def run_owlqn(objective_smooth: ObjectiveFn, w0: Any, l1_reg: float,
     if l1_reg < 0:
         raise ValueError("l1_reg must be >= 0")
 
+    sdtype = _carry_dtype(w0)
+    objective_smooth = _pin_objective(objective_smooth, w0, sdtype)
     f0, g0 = objective_smooth(w0)
-    sdtype = jnp.asarray(f0).dtype
     l1 = jnp.asarray(l1_reg, sdtype)
     big_f0 = f0 + l1 * tvec.l1_norm(w0)
     hist0 = jnp.full((cfg.num_iterations + 1,), jnp.nan, sdtype)

@@ -327,15 +327,16 @@ class GeneralizedLinearAlgorithm:
         return self._create_model(*self._split_intercept(weights))
 
     def _require_grid_optimizer(self, op_name: str):
-        """The batched grid fits ride the AGD sweep/CV machinery; a
-        trainer whose optimizer seat holds something else (LBFGS) gets
-        a named error instead of an AttributeError."""
+        """Batched grid fits need the matching method on the optimizer
+        seat (AGD has ``sweep`` + ``cross_validate``; LBFGS has
+        ``sweep``) — a seat without it gets a named error instead of an
+        AttributeError."""
         if not hasattr(self.optimizer, op_name):
             raise ValueError(
-                f"{op_name} requires an optimizer with batched grid "
-                f"support (AcceleratedGradientDescent); "
-                f"{type(self.optimizer).__name__} fits one strength "
-                f"per train() call")
+                f"{op_name} requires an optimizer seat providing it "
+                f"(AcceleratedGradientDescent: sweep + cross_validate; "
+                f"LBFGS: sweep only); "
+                f"{type(self.optimizer).__name__} does not")
 
     def train_path(self, X, y, reg_params, initial_weights=None):
         """Fit the regularization path: K typed models from ONE compiled
@@ -407,9 +408,10 @@ class LogisticRegressionWithLBFGS(GeneralizedLinearAlgorithm):
     """MLlib's ``LogisticRegressionWithLBFGS`` analogue: the same typed
     model and trainer workflow, with the quasi-Newton member in the
     optimizer seat (``api.LBFGS``) — the interchange the reference's
-    ``Optimizer`` trait exists to allow.  Smooth (L2) regularization
-    only, as in MLlib 1.3; grid fits (``train_path`` /
-    ``cross_validate``) are AGD-only and raise a named error."""
+    ``Optimizer`` trait exists to allow.  L1 / elastic-net penalties
+    dispatch to OWL-QN for single fits; ``train_path`` works from this
+    seat too (``api.LBFGS.sweep``, smooth penalties only);
+    ``cross_validate`` remains AGD-only and raises a named error."""
 
     def __init__(self, reg_param: float = 0.0,
                  num_corrections: int = 10, updater: Prox = None,

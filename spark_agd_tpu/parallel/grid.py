@@ -251,3 +251,60 @@ def shard_row_array(mesh: Mesh, arr, n_padded: int,
         arr = np.concatenate(
             [arr, np.full((pad,) + arr.shape[1:], fill, arr.dtype)])
     return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def make_mesh_lbfgs_sweep_fit(
+    gradient: Gradient,
+    updater: Prox,
+    batch: "mesh_lib.ShardedBatch",
+    mesh: Mesh,
+    cfg,
+    *,
+    data_axis: str = mesh_lib.DATA_AXIS,
+) -> Callable:
+    """Compile-once ``fit(reg_params, initial_weights)`` for the
+    quasi-Newton member over a mesh: K regularization lanes vmapped
+    INSIDE one shard_map over row-sharded data — the L-BFGS twin of
+    :func:`make_mesh_sweep_fit`.  Smooth penalties only (the updater's
+    ``smooth_penalty`` must accept a traced ``reg``); each lane's
+    Wolfe/convergence decisions stay coherent across devices because
+    every control scalar is post-psum.
+    """
+    from ..core import lbfgs as lbfgs_lib
+
+    lbfgs_lib.check_smooth_penalty(updater, 1.0)  # named error, not a
+    # NoneType unpack at trace time
+    X, y, mask = batch
+    args, dspecs, rebuild_local = _shard_data_plumbing(X, y, mask,
+                                                       data_axis)
+
+    def _body(regs, w0, *shard_args):
+        Xl, yl, ml = rebuild_local(*shard_args)
+        sm, _ = _local_smooth_fns(gradient, Xl, yl, ml, data_axis)
+
+        def fit_one(reg, w):
+            def objective(wv):
+                f, g = sm(wv)
+                pv, pg = updater.smooth_penalty(wv, reg)
+                return f + pv, tvec.add(g, pg)
+
+            return lbfgs_lib.run_lbfgs(objective, w, cfg)
+
+        return jax.vmap(fit_one, in_axes=(0, None))(regs, w0)
+
+    step = jax.jit(functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(), P()) + dspecs,
+        out_specs=P(), check_vma=False)(_body))
+
+    def fit(reg_params, initial_weights):
+        # default float dtype (f64 under x64): a lane's reg must carry
+        # the same precision a solo fit's python-float reg would
+        regs = jnp.asarray(reg_params, jnp.result_type(float))
+        if regs.ndim != 1:
+            raise ValueError("reg_params must be 1-D")
+        regs = mesh_lib.replicate(regs, mesh)
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        w0 = mesh_lib.replicate(w0, mesh)
+        return step(regs, w0, *args)
+
+    return fit

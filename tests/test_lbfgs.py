@@ -471,6 +471,72 @@ class TestOWLQN:
                                       np.asarray(r_l2.weights))
 
 
+class TestSweep:
+    """make_lbfgs_sweep_runner: K regularization lanes of the fused
+    quasi-Newton loop in one compiled program."""
+
+    def test_lanes_match_individual_fits(self, rng):
+        X, y = logistic_problem(rng, n=250, d=8)
+        regs = [0.01, 0.1, 1.0]
+        fit = api.make_lbfgs_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+            convergence_tol=1e-10, num_iterations=80, mesh=False)
+        res = fit(np.zeros(8), regs)
+        assert np.asarray(res.weights).shape == (3, 8)
+        for k, reg in enumerate(regs):
+            solo = api.run_lbfgs(
+                (X, y), losses.LogisticGradient(),
+                prox.SquaredL2Updater(), reg_param=reg,
+                convergence_tol=1e-10, num_iterations=80,
+                initial_weights=np.zeros(8), mesh=False)
+            assert int(res.num_iters[k]) == int(solo.num_iters)
+            np.testing.assert_allclose(np.asarray(res.weights)[k],
+                                       np.asarray(solo.weights),
+                                       rtol=1e-9, atol=1e-11)
+
+    def test_mesh_matches_single_device(self, rng, mesh8):
+        X, y = logistic_problem(rng, n=300, d=10)
+        regs = [0.05, 0.5]
+        kw = dict(convergence_tol=0.0, num_iterations=6)
+        fit_m = api.make_lbfgs_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.L2Prox(),
+            mesh=mesh8, **kw)
+        fit_1 = api.make_lbfgs_sweep_runner(
+            (X, y), losses.LogisticGradient(), prox.L2Prox(),
+            mesh=False, **kw)
+        res_m = fit_m(np.zeros(10), regs)
+        res_1 = fit_1(np.zeros(10), regs)
+        np.testing.assert_array_equal(np.asarray(res_m.num_iters),
+                                      np.asarray(res_1.num_iters))
+        np.testing.assert_allclose(np.asarray(res_m.loss_history),
+                                   np.asarray(res_1.loss_history),
+                                   rtol=1e-8, atol=1e-11)
+        np.testing.assert_allclose(np.asarray(res_m.weights),
+                                   np.asarray(res_1.weights),
+                                   rtol=1e-7, atol=1e-10)
+
+    def test_l1_rejected_with_guidance(self, rng):
+        X, y = logistic_problem(rng, n=60, d=4)
+        with pytest.raises(ValueError, match="smooth penalty"):
+            api.make_lbfgs_sweep_runner(
+                (X, y), losses.LogisticGradient(), prox.L1Updater(),
+                mesh=False)
+
+    def test_trainer_train_path_with_lbfgs_seat(self, rng):
+        """GLM train_path now works from the LBFGS seat (the class
+        gained sweep), returning one typed model per strength."""
+        from spark_agd_tpu import models
+
+        X, y = logistic_problem(rng, n=200, d=3)
+        lr = models.LogisticRegressionWithLBFGS()
+        lr.optimizer.set_num_iterations(40).set_convergence_tol(1e-9)
+        lr.optimizer.set_mesh(False)
+        ms, res = lr.train_path(X, y, [0.01, 0.5])
+        assert len(ms) == 2
+        preds = np.asarray(ms[0].predict(X))
+        assert preds.shape == (200,)
+
+
 class TestMesh:
     def test_mesh_matches_single_device(self, rng, mesh8):
         X, y = logistic_problem(rng, n=300, d=12)  # 300: padding live

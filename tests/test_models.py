@@ -97,12 +97,12 @@ class TestLogisticRegressionWithLBFGS:
         # intercept was learned (the synthetic generator's A=2.0 shift)
         assert abs(model.intercept) > 0.1
 
-    def test_grid_fits_raise_named_error(self, logistic_data):
+    def test_cross_validate_raises_named_error(self, logistic_data):
+        """train_path works from the LBFGS seat (api.LBFGS.sweep, r3);
+        cross_validate remains AGD-only with a named error."""
         X, y = logistic_data
         lr = models.LogisticRegressionWithLBFGS()
-        with pytest.raises(ValueError, match="grid support"):
-            lr.train_path(X, y, [0.1, 1.0])
-        with pytest.raises(ValueError, match="grid support"):
+        with pytest.raises(ValueError, match="optimizer seat"):
             lr.cross_validate(X, y, [0.1, 1.0])
 
 
