@@ -26,6 +26,7 @@ from .glm import (  # noqa: F401
     SVMWithAGD,
     SoftmaxRegressionModel,
     SoftmaxRegressionWithAGD,
+    SoftmaxRegressionWithLBFGS,
 )
 from .mlp import (  # noqa: F401
     MLPClassifierWithAGD,

@@ -475,13 +475,16 @@ class SoftmaxRegressionWithAGD(GeneralizedLinearAlgorithm):
 
     def __init__(self, num_classes: int, reg_param: float = 0.0,
                  updater: Prox = None, add_intercept: bool = True,
-                 mesh=None):
+                 mesh=None, optimizer=None):
         super().__init__(
             SoftmaxGradient(num_classes),
             updater if updater is not None else L2Prox(),
-            add_intercept=add_intercept, mesh=mesh)
+            add_intercept=add_intercept, mesh=mesh,
+            optimizer=optimizer)
         self.num_classes = int(num_classes)
         self.optimizer.set_reg_param(reg_param)
+        # model-axis tensor parallelism applies to WHATEVER sits in the
+        # optimizer seat (AGD and LBFGS both expose set_dist_mode)
         if mesh is not None and "model" in getattr(mesh, "shape", {}):
             self.optimizer.set_dist_mode("auto")
 
@@ -496,6 +499,24 @@ class SoftmaxRegressionWithAGD(GeneralizedLinearAlgorithm):
 
     def _create_model(self, weights, intercept):
         return SoftmaxRegressionModel(weights, intercept)
+
+
+class SoftmaxRegressionWithLBFGS(SoftmaxRegressionWithAGD):
+    """Multinomial classification with the quasi-Newton member in the
+    optimizer seat — MLlib 1.3's ``LogisticRegressionWithLBFGS.
+    setNumClasses(K)`` surface (its LBFGS path is the one MLlib
+    recommends for multinomial).  The (D, K) weight matrix is just a
+    pytree leaf to the fused L-BFGS loop."""
+
+    def __init__(self, num_classes: int, reg_param: float = 0.0,
+                 num_corrections: int = 10, updater: Prox = None,
+                 add_intercept: bool = True, mesh=None):
+        updater = updater if updater is not None else L2Prox()
+        super().__init__(
+            num_classes, reg_param=reg_param, updater=updater,
+            add_intercept=add_intercept, mesh=mesh,
+            optimizer=api.LBFGS(SoftmaxGradient(num_classes), updater))
+        self.optimizer.set_num_corrections(num_corrections)
 
 
 _MODEL_CLASSES.update({

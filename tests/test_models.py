@@ -97,6 +97,32 @@ class TestLogisticRegressionWithLBFGS:
         # intercept was learned (the synthetic generator's A=2.0 shift)
         assert abs(model.intercept) > 0.1
 
+    def test_softmax_with_lbfgs_seat(self):
+        """The multinomial trainer from the LBFGS seat (MLlib's
+        setNumClasses surface): (D, K) weights are one pytree leaf to
+        the fused loop."""
+        rng = np.random.default_rng(5)
+        n, d, k = 600, 6, 4
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        W = rng.standard_normal((d, k)).astype(np.float32) * 2
+        y = np.argmax(X @ W + rng.gumbel(size=(n, k)), axis=1).astype(
+            np.float32)
+        sm = models.SoftmaxRegressionWithLBFGS(num_classes=k,
+                                               reg_param=0.01)
+        sm.optimizer.set_num_iterations(60).set_convergence_tol(1e-9)
+        sm.optimizer.set_mesh(False)
+        model = sm.train(X, y)
+        acc = np.mean(np.asarray(model.predict(X)) == y)
+        assert acc > 0.75, acc
+        twin = models.SoftmaxRegressionWithAGD(num_classes=k,
+                                               reg_param=0.01)
+        twin.optimizer.set_num_iterations(150).set_convergence_tol(
+            1e-10).set_mesh(False)
+        m2 = twin.train(X, y)
+        agree = np.mean(np.asarray(model.predict(X))
+                        == np.asarray(m2.predict(X)))
+        assert agree > 0.97, agree
+
     def test_cross_validate_raises_named_error(self, logistic_data):
         """train_path works from the LBFGS seat (api.LBFGS.sweep, r3);
         cross_validate remains AGD-only with a named error."""
