@@ -537,6 +537,51 @@ class TestSweep:
         assert preds.shape == (200,)
 
 
+class TestQuasiNewtonFuzz:
+    """Randomized knob-space parity for the quasi-Newton drivers:
+    single-device vs 8-way mesh on the SAME problem (the
+    test_grid_mesh::TestMeshFuzz pattern).  f64: reduction noise is
+    ~1e-16, so near-strict trajectory equality is the invariant —
+    guarding knob interactions (m, tol, penalty type, dispatch) the
+    enumerated tests don't cover."""
+
+    @pytest.mark.parametrize("case", range(8))
+    def test_random_config_parity(self, case, mesh8):
+        r = np.random.default_rng(9100 + case)
+        n, d = int(r.integers(150, 450)), int(r.integers(4, 16))
+        X = r.standard_normal((n, d))
+        yb = (r.random(n) < 0.5).astype(np.float64)
+        grad = [losses.LogisticGradient(),
+                losses.LeastSquaresGradient()][case % 2]
+        # half the cases dispatch to OWL-QN (L1 / elastic net), half to
+        # strong-Wolfe L-BFGS (L2 / identity)
+        p, reg = [
+            (prox.SquaredL2Updater(), float(r.uniform(0.01, 0.5))),
+            (prox.L1Updater(), float(r.uniform(0.005, 0.1))),
+            (prox.IdentityProx(), 0.0),
+            (prox.ElasticNetProx(float(r.uniform(0.1, 0.9))),
+             float(r.uniform(0.01, 0.3))),
+        ][(case // 2) % 4]
+        kw = dict(reg_param=reg,
+                  num_corrections=int(r.integers(1, 12)),
+                  convergence_tol=float(10.0 ** -r.integers(6, 11)),
+                  num_iterations=int(r.integers(10, 60)),
+                  initial_weights=r.standard_normal(d) * 0.1)
+        res_1 = api.run_lbfgs((X, yb), grad, p, mesh=False, **kw)
+        res_m = api.run_lbfgs((X, yb), grad, p, mesh=mesh8, **kw)
+        assert int(res_m.num_iters) == int(res_1.num_iters), case
+        assert bool(res_m.converged) == bool(res_1.converged)
+        assert bool(res_m.ls_failed) == bool(res_1.ls_failed)
+        k = int(res_1.num_iters)
+        np.testing.assert_allclose(
+            np.asarray(res_m.loss_history)[:k + 1],
+            np.asarray(res_1.loss_history)[:k + 1],
+            rtol=1e-10, atol=1e-13, err_msg=f"case {case}")
+        np.testing.assert_allclose(
+            np.asarray(res_m.weights), np.asarray(res_1.weights),
+            rtol=1e-8, atol=1e-11, err_msg=f"case {case}")
+
+
 class TestMesh:
     def test_mesh_matches_single_device(self, rng, mesh8):
         X, y = logistic_problem(rng, n=300, d=12)  # 300: padding live
