@@ -65,6 +65,7 @@ from .api import (  # noqa: F401
     cross_validate,
     make_cv_runner,
     make_sweep_runner,
+    streaming_lbfgs_sweep,
     streaming_sweep,
     sweep,
     sweep_warm_state,

@@ -797,6 +797,13 @@ class AcceleratedGradientDescent:
             mesh=self._mesh, loss_mode=self._loss_mode, seed=seed)
 
 
+def _stack_lanes(initial_weights, k: int):
+    """Broadcast one starting point onto a leading K lane axis — the
+    streaming grid-fit family's convention (one copy)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.stack([jnp.asarray(a)] * k), initial_weights)
+
+
 def streaming_sweep(
     dataset,
     gradient: Gradient,
@@ -847,9 +854,7 @@ def streaming_sweep(
         gradient, dataset, mesh=mesh, pad_to=pad_to,
         csr_nnz_per_shard=csr_nnz_per_shard, with_grad=False)
     pxm, rvm = host_agd.make_prox_multi(updater, regs)
-    W0 = jax.tree_util.tree_map(
-        lambda a: jnp.stack([jnp.asarray(a)] * len(regs)),
-        initial_weights)
+    W0 = _stack_lanes(initial_weights, len(regs))
     cfg = agd.AGDConfig(
         convergence_tol=convergence_tol, num_iterations=num_iterations,
         l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
@@ -1215,3 +1220,64 @@ def make_lbfgs_sweep_runner(
         return step(regs, w0)
 
     return fit
+
+
+def streaming_lbfgs_sweep(
+    dataset,
+    gradient: Gradient,
+    updater: Prox,
+    reg_params,
+    num_corrections: int = 10,
+    convergence_tol: float = 1e-4,
+    num_iterations: int = 100,
+    initial_weights: Any = None,
+    *,
+    grad_tol: float = 0.0,
+    mesh=None,
+    pad_to=None,
+    csr_nnz_per_shard=None,
+):
+    """A K-strength L-BFGS regularization path over a STREAMED dataset
+    — one stream read per evaluation round for ALL lanes (the
+    :func:`streaming_sweep` twin for the quasi-Newton member).
+
+    Each lane executes the EXACT solo host algorithm
+    (``core.host_lbfgs._lbfgs_gen`` — the same generator
+    ``run_lbfgs_host`` drives), with the lanes' pending objective
+    evaluations batched into one
+    ``data.streaming.make_streaming_eval_multi`` pass (the K margin
+    products fuse into one ``(rows, D) @ (D, K)`` contraction per
+    macro-batch).  Smooth penalties only, like
+    :func:`make_lbfgs_sweep_runner`.
+
+    Returns a ``core.host_lbfgs.HostLBFGSMultiResult`` (leading K axis;
+    ``eval_rounds`` counts the stream passes consumed — sequential solo
+    fits would pay ``sum(num_fn_evals)`` passes).
+    """
+    if initial_weights is None:
+        raise ValueError("initial_weights is required")
+    from .core import host_lbfgs, lbfgs as lbfgs_lib, tvec
+    from .data import streaming as streaming_lib
+
+    lbfgs_lib.check_smooth_penalty(updater, 1.0)
+    regs = jnp.asarray(list(reg_params), jnp.result_type(float))
+    if regs.ndim != 1:
+        raise ValueError("reg_params must be 1-D")
+    sm_multi = streaming_lib.make_streaming_eval_multi(
+        gradient, dataset, mesh=mesh, pad_to=pad_to,
+        csr_nnz_per_shard=csr_nnz_per_shard)
+
+    pen_multi = jax.jit(jax.vmap(
+        lambda wk, rk: updater.smooth_penalty(wk, rk)))
+
+    def objective_multi(W):
+        fs, Gs = sm_multi(W)
+        pv, pg = pen_multi(W, regs)
+        return fs + pv, tvec.add(Gs, pg)
+
+    W0 = _stack_lanes(initial_weights, int(regs.shape[0]))
+    cfg = lbfgs_lib.LBFGSConfig(
+        num_corrections=num_corrections,
+        convergence_tol=convergence_tol,
+        num_iterations=num_iterations, grad_tol=grad_tol)
+    return host_lbfgs.run_lbfgs_host_multi(objective_multi, W0, cfg)

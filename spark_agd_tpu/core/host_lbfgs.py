@@ -92,21 +92,26 @@ def _pin_grad(g, w):
                      g, w)
 
 
-def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
-    """Strong-Wolfe search, the eager mirror of ``lbfgs._wolfe_search``
-    (same bracket/zoom decisions, same budgets)."""
+def _wolfe_gen(w, f0, g0, d, cfg: LBFGSConfig):
+    """Strong-Wolfe search as a GENERATOR — the eager mirror of
+    ``lbfgs._wolfe_search`` (same bracket/zoom decisions, same
+    budgets), with every objective evaluation expressed as
+    ``f, g = yield w_trial``.  The solo driver feeds it directly; the
+    multi-lane scheduler batches many lanes' pending yields into one
+    multi-evaluation — ONE copy of the decision algebra either way.
+    Returns ``(t, f_t, g_t, evals, ok)`` via StopIteration."""
     dg0 = float(tvec.dot(g0, d))
     evals = 0
 
-    def eval_at(t):
-        nonlocal evals
-        f, g = objective(tvec.axpby(1.0, w, t, d))
-        g = _pin_grad(g, w)
-        evals += 1
-        return float(f), g, float(tvec.dot(g, d))
+    def _eval(t):
+        # one copy of evaluate-and-pin (the old eval_at closure)
+        f, g = yield tvec.axpby(1.0, w, t, d)
+        return float(f), _pin_grad(g, w)
 
     t = 1.0
-    f_t, g_t, dg_t = eval_at(t)
+    f_t, g_t = yield from _eval(t)
+    evals += 1
+    dg_t = float(tvec.dot(g_t, d))
     t_lo, f_lo = 0.0, f0
     t_hi, f_hi = 0.0, f0
     stage = 0  # 0 bracket, 1 zoom
@@ -130,7 +135,9 @@ def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
                 if it >= cfg.max_ls_steps:
                     return 0.0, f0, g0, evals, False
                 t = t * cfg.max_step_growth
-                f_t, g_t, dg_t = eval_at(t)
+                f_t, g_t = yield from _eval(t)
+                evals += 1
+                dg_t = float(tvec.dot(g_t, d))
                 continue
         else:
             z_rise = (not armijo) or (f_t >= f_lo)
@@ -144,7 +151,9 @@ def _wolfe_host(objective, w, f0, g0, d, cfg: LBFGSConfig):
             if it >= cfg.max_ls_steps:
                 return 0.0, f0, g0, evals, False
         t = 0.5 * (t_lo + t_hi)
-        f_t, g_t, dg_t = eval_at(t)
+        f_t, g_t = yield from _eval(t)
+        evals += 1
+        dg_t = float(tvec.dot(g_t, d))
 
 
 def _two_loop_host(q0, pairs):
@@ -194,6 +203,21 @@ def run_lbfgs_host(
     iteration count including any warm prior) — checkpoint from it with
     ``HostLBFGSWarm(w=s["w"], f=s["f"], g=s["g"], pairs=s["pairs"],
     prior_iters=s["it"])``."""
+    gen = _lbfgs_gen(w0, config, warm=warm, on_iteration=on_iteration)
+    try:
+        wq = next(gen)
+        while True:
+            wq = gen.send(objective(wq))
+    except StopIteration as e:
+        return e.value
+
+
+def _lbfgs_gen(w0, config: LBFGSConfig, *, warm=None,
+               on_iteration=None):
+    """The host L-BFGS algorithm as a generator (``f, g = yield w`` per
+    evaluation) — the ONE copy both :func:`run_lbfgs_host` (solo
+    driver) and :func:`run_lbfgs_host_multi` (lock-step lane scheduler)
+    execute, so per-lane decisions cannot drift from solo runs."""
     cfg = config
     m = int(cfg.num_corrections)
     if m < 1:
@@ -205,7 +229,7 @@ def run_lbfgs_host(
         it = int(warm.prior_iters)
         evals = 0
     else:
-        f, g = objective(w0)
+        f, g = yield w0
         f = float(f)
         w = w0
         g = _pin_grad(g, w)
@@ -223,7 +247,7 @@ def run_lbfgs_host(
         if not float(tvec.dot(g, d)) < 0:  # stale curvature fallback
             d = tvec.scale(-1.0, g)
 
-        t, f_n, g_n, ev, ok = _wolfe_host(objective, w, f, g, d, cfg)
+        t, f_n, g_n, ev, ok = yield from _wolfe_gen(w, f, g, d, cfg)
         evals += ev
         if not ok:
             ls_failed = True
@@ -377,3 +401,97 @@ def run_owlqn_host(
         grad_norm=float(tvec.norm(pseudo_grad(w, g))),
         num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs),
         final_f_smooth=f)
+
+
+class HostLBFGSMultiResult(NamedTuple):
+    """Per-lane fields stacked on a leading K axis; ``loss_history`` is
+    ``(K, max_iters + 1)`` NaN-padded per lane (lane k's live prefix is
+    ``[:num_iters[k] + 1]``).  ``eval_rounds`` counts the multi-
+    evaluations (stream passes) the lock-step schedule consumed — the
+    savings claim vs ``sum(num_fn_evals)`` sequential passes."""
+
+    weights: Any
+    loss_history: np.ndarray
+    num_iters: np.ndarray
+    converged: np.ndarray
+    ls_failed: np.ndarray
+    aborted_non_finite: np.ndarray
+    grad_norm: np.ndarray
+    num_fn_evals: np.ndarray
+    eval_rounds: int
+
+
+def run_lbfgs_host_multi(
+    objective_multi: Callable,
+    w0_stacked: Any,
+    config: LBFGSConfig = LBFGSConfig(),
+) -> HostLBFGSMultiResult:
+    """K lock-step L-BFGS lanes over ONE multi-evaluation per round —
+    the quasi-Newton twin of ``host_agd.run_agd_host_multi``.
+
+    ``objective_multi(W_stacked) -> ((K,) values, stacked grads)`` —
+    e.g. ``data.streaming.make_streaming_eval_multi`` plus per-lane
+    penalties: K regularization strengths then share one stream read
+    per evaluation round instead of re-streaming per lane.
+
+    Each lane executes the EXACT solo algorithm (:func:`_lbfgs_gen` —
+    the same generator ``run_lbfgs_host`` drives), so the scheduler
+    cannot change any lane's decision logic; per-lane results match
+    solo runs to the multi-evaluation kernel's own rounding (a vmapped
+    kernel may fuse reductions ~1 ulp differently than the solo one —
+    pinned in ``tests/test_lbfgs.py::TestStreamedMultiLane``).  A lane
+    that finishes early contributes its final weights to later rounds
+    (the multi-evaluation needs a full stack) and its result is frozen.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(w0_stacked)
+    if not leaves:
+        raise ValueError("w0_stacked must have at least one leaf")
+    k_lanes = leaves[0].shape[0]
+    lane = lambda tree, k: jax.tree_util.tree_map(
+        lambda l: l[k], tree)
+
+    gens = []
+    queries: List[Any] = []
+    results: List[Any] = [None] * k_lanes
+    for k in range(k_lanes):
+        g = _lbfgs_gen(lane(w0_stacked, k), config)
+        gens.append(g)
+        queries.append(next(g))  # a fresh gen always yields w0 first
+
+    import jax.numpy as jnp
+
+    rounds = 0
+    while any(r is None for r in results):
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls),
+            *[queries[k] if results[k] is None else results[k].weights
+              for k in range(k_lanes)])
+        fs, Gs = objective_multi(stacked)
+        rounds += 1
+        fs = np.asarray(fs)
+        for k in range(k_lanes):
+            if results[k] is not None:
+                continue
+            try:
+                queries[k] = gens[k].send((fs[k], lane(Gs, k)))
+            except StopIteration as e:
+                results[k] = e.value
+
+    max_it = max(r.num_iters for r in results)
+    hist = np.full((k_lanes, max_it + 1), np.nan)
+    for k, r in enumerate(results):
+        hist[k, :r.num_iters + 1] = r.loss_history
+    return HostLBFGSMultiResult(
+        weights=jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *[r.weights for r in results]),
+        loss_history=hist,
+        num_iters=np.asarray([r.num_iters for r in results]),
+        converged=np.asarray([r.converged for r in results]),
+        ls_failed=np.asarray([r.ls_failed for r in results]),
+        aborted_non_finite=np.asarray(
+            [r.aborted_non_finite for r in results]),
+        grad_norm=np.asarray([r.grad_norm for r in results]),
+        num_fn_evals=np.asarray([r.num_fn_evals for r in results]),
+        eval_rounds=rounds)

@@ -537,6 +537,93 @@ class TestSweep:
         assert preds.shape == (200,)
 
 
+class TestStreamedMultiLane:
+    """run_lbfgs_host_multi / api.streaming_lbfgs_sweep: K lock-step
+    lanes over one multi-evaluation per round.  The per-lane contract
+    is the run_agd_host_multi standard: EXACT equality with solo host
+    runs of the same objective."""
+
+    def test_lanes_exactly_match_solo_runs(self, rng):
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+        from spark_agd_tpu.core import smooth
+        from spark_agd_tpu.core import tvec
+
+        X, y = logistic_problem(rng, n=280, d=9)
+        regs = [0.01, 0.1, 1.0]
+        sm = smooth.make_smooth(losses.LogisticGradient(),
+                                jnp.asarray(X), jnp.asarray(y))
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-10,
+                                    num_iterations=60)
+
+        def obj_k(reg):
+            def obj(w):
+                f, g = sm(w)
+                pv, pg = prox.SquaredL2Updater().smooth_penalty(w, reg)
+                return f + pv, tvec.add(g, pg)
+            return obj
+
+        def objective_multi(W):
+            fs, gs = jax.vmap(
+                lambda wk, rk: obj_k(rk)(wk))(W, jnp.asarray(regs))
+            return fs, gs
+
+        multi = host_lbfgs.run_lbfgs_host_multi(
+            objective_multi, jnp.zeros((3, 9)), cfg)
+        total_evals = 0
+        for k, reg in enumerate(regs):
+            solo = host_lbfgs.run_lbfgs_host(obj_k(reg), jnp.zeros(9),
+                                             cfg)
+            assert int(multi.num_iters[k]) == solo.num_iters, k
+            assert bool(multi.converged[k]) == solo.converged
+            assert int(multi.num_fn_evals[k]) == solo.num_fn_evals
+            # decisions are identical by construction (same generator);
+            # VALUES agree to the vmapped kernel's own rounding (~1
+            # ulp: vmap can fuse the reduction differently than the
+            # solo kernel)
+            np.testing.assert_allclose(
+                multi.loss_history[k][:solo.num_iters + 1],
+                solo.loss_history, rtol=1e-13, atol=1e-15)
+            np.testing.assert_allclose(
+                np.asarray(multi.weights)[k], np.asarray(solo.weights),
+                rtol=1e-12, atol=1e-14)
+            total_evals += solo.num_fn_evals
+        # the lock-step claim: rounds = max lane evals, not the sum
+        assert multi.eval_rounds == int(np.max(multi.num_fn_evals))
+        assert multi.eval_rounds < total_evals
+
+    def test_streaming_sweep_api(self, rng):
+        from spark_agd_tpu.data import streaming
+
+        X, y = logistic_problem(rng, n=300, d=8)
+        regs = [0.02, 0.2]
+        ds = streaming.StreamingDataset.from_arrays(X, y, batch_rows=64)
+        res = api.streaming_lbfgs_sweep(
+            ds, losses.LogisticGradient(), prox.SquaredL2Updater(),
+            regs, convergence_tol=1e-10, num_iterations=60,
+            initial_weights=np.zeros(8))
+        # each lane == the fused in-memory fit at its strength
+        for k, reg in enumerate(regs):
+            fused = api.run_lbfgs(
+                (X, y), losses.LogisticGradient(),
+                prox.SquaredL2Updater(), reg_param=reg,
+                convergence_tol=1e-10, num_iterations=60,
+                initial_weights=np.zeros(8), mesh=False)
+            assert int(res.num_iters[k]) == int(fused.num_iters)
+            np.testing.assert_allclose(
+                np.asarray(res.weights)[k], np.asarray(fused.weights),
+                rtol=1e-9, atol=1e-12)
+
+    def test_l1_rejected(self, rng):
+        from spark_agd_tpu.data import streaming
+
+        X, y = logistic_problem(rng, n=60, d=4)
+        ds = streaming.StreamingDataset.from_arrays(X, y, batch_rows=32)
+        with pytest.raises(ValueError, match="smooth penalty"):
+            api.streaming_lbfgs_sweep(
+                ds, losses.LogisticGradient(), prox.L1Updater(),
+                [0.1], initial_weights=np.zeros(4))
+
+
 class TestQuasiNewtonFuzz:
     """Randomized knob-space parity for the quasi-Newton drivers:
     single-device vs 8-way mesh on the SAME problem (the
