@@ -518,17 +518,31 @@ def run_lbfgs_checkpointed(
     path: str,
     *,
     segment_iters: int = 10,
+    l1_reg: float = 0.0,
 ) -> CheckpointedLBFGSResult:
     """Host L-BFGS with periodic checkpoints: ``segment_iters``
     iterations per segment, carry persisted after each.  Kill the
     process anywhere; rerunning the same call continues from the last
     completed segment to the same answer as an uninterrupted run
-    (``core.host_lbfgs``'s exact-resume contract)."""
+    (``core.host_lbfgs``'s exact-resume contract).
+
+    ``l1_reg > 0`` drives the OWL-QN host twin instead (``objective``
+    is then the SMOOTH part; histories hold the full F = f + l1·‖w‖₁).
+    ``l1_reg`` participates in the fingerprint, so a checkpoint written
+    at one strength cannot silently resume another."""
     from ..core import host_lbfgs
 
     if segment_iters <= 0:
         raise ValueError("segment_iters must be positive")
+    if l1_reg < 0:
+        raise ValueError("l1_reg must be >= 0")
+    # suffix only for the OWL-QN mode: an l1_reg=0 fingerprint stays
+    # byte-identical to pre-upgrade checkpoints, so existing kill/
+    # resume chains keep resuming; nonzero strengths still refuse to
+    # cross-resume each other (or a smooth run)
     fp = problem_fingerprint(w0, config)
+    if l1_reg > 0:
+        fp += f"|l1={float(l1_reg)!r}"
     loaded = load_lbfgs_checkpoint(path, w0, expect_fingerprint=fp)
     if loaded is not None:
         warm = loaded.warm
@@ -555,7 +569,12 @@ def run_lbfgs_checkpointed(
         # w0 evaluation happens and the return below has a carry
         cap = min(prior + segment_iters, total)
         cfg_k = dataclasses.replace(config, num_iterations=cap)
-        res = host_lbfgs.run_lbfgs_host(objective, w0, cfg_k, warm=warm)
+        if l1_reg > 0:
+            res = host_lbfgs.run_owlqn_host(objective, w0, l1_reg,
+                                            cfg_k, warm=warm)
+        else:
+            res = host_lbfgs.run_lbfgs_host(objective, w0, cfg_k,
+                                            warm=warm)
         seg_hist = np.asarray(res.loss_history)
         hist.extend(seg_hist.tolist() if not hist
                     else seg_hist[1:].tolist())

@@ -413,3 +413,33 @@ class TestLBFGSCheckpoint:
             agd_path, AGDWarmState.initial(np.zeros(d), AGDConfig()))
         with pytest.raises(ValueError, match="not an L-BFGS"):
             ckpt.load_lbfgs_checkpoint(agd_path, np.zeros(d))
+
+    def test_owlqn_kill_and_resume_parity(self, tmp_path):
+        """l1_reg > 0 drives the OWL-QN twin with the same kill/resume
+        contract; the l1 strength is fingerprinted."""
+        import dataclasses
+
+        from spark_agd_tpu.core import host_lbfgs, lbfgs as lbfgs_lib
+
+        obj, d = self._objective(reg=0.0)  # pure smooth part
+        l1 = 0.05
+        cfg = lbfgs_lib.LBFGSConfig(convergence_tol=1e-11,
+                                    num_iterations=40)
+        straight = host_lbfgs.run_owlqn_host(obj, np.zeros(d), l1, cfg)
+        path = str(tmp_path / "owl.npz")
+        part = ckpt.run_lbfgs_checkpointed(
+            obj, np.zeros(d), dataclasses.replace(cfg,
+                                                  num_iterations=3),
+            path, segment_iters=2, l1_reg=l1)
+        assert part.num_iters == 3
+        full = ckpt.run_lbfgs_checkpointed(
+            obj, np.zeros(d), cfg, path, segment_iters=4, l1_reg=l1)
+        assert full.resumed_from == 3
+        np.testing.assert_array_equal(np.asarray(full.weights),
+                                      np.asarray(straight.weights))
+        np.testing.assert_array_equal(full.loss_history,
+                                      straight.loss_history)
+        # a different strength at the same path must refuse
+        with pytest.raises(ValueError, match="different problem"):
+            ckpt.run_lbfgs_checkpointed(obj, np.zeros(d), cfg, path,
+                                        segment_iters=4, l1_reg=0.2)
