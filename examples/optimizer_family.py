@@ -1,0 +1,88 @@
+"""The Optimizer family on one problem — a runnable tour.
+
+The reference implements MLlib's ``Optimizer`` trait so optimizers
+interchange inside one training workflow (SURVEY §1 L5); this demo runs
+the whole family this framework ships on the same L2-regularized
+logistic problem and prints the comparison the docs
+(``docs/OPTIMIZERS.md``) describe, then shows the L1 pair (prox-AGD vs
+OWL-QN) agreeing on optimum AND support.
+
+    JAX_PLATFORMS=cpu python examples/optimizer_family.py
+
+Runs distributed over every visible device by default (the data-axis
+mesh), exactly like the library entry points.
+"""
+
+import numpy as np
+
+import spark_agd_tpu as sat
+from spark_agd_tpu import api
+from spark_agd_tpu.ops import losses, prox
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d = 20_000, 50
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = (rng.standard_normal(d) * (rng.random(d) < 0.3)).astype(
+        np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+    y = (rng.random(n) < p).astype(np.float32)
+    w0 = np.zeros(d, np.float32)
+    reg = 0.01
+
+    # --- the smooth trio: GD (the reference's oracle), AGD, L-BFGS ---
+    gd_w, gd_hist = api.run_minibatch_sgd(
+        (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+        step_size=1.0, num_iterations=100, reg_param=reg,
+        initial_weights=w0, mesh=None)  # all-device mesh, like run()
+    agd_w, agd_hist = api.run(
+        (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+        reg_param=reg, convergence_tol=0.0, num_iterations=30,
+        initial_weights=w0)
+    lb = api.run_lbfgs(
+        (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+        reg_param=reg, convergence_tol=1e-9, num_iterations=30,
+        initial_weights=w0)
+    lb_hist = np.asarray(lb.loss_history)[:int(lb.num_iters) + 1]
+    print(f"GD    @100 iters: loss {float(np.asarray(gd_hist)[-1]):.6f}")
+    print(f"AGD   @30 iters:  loss {float(np.asarray(agd_hist)[-1]):.6f}")
+    print(f"LBFGS @{int(lb.num_iters)} iters "
+          f"({int(lb.num_fn_evals)} evals): loss {lb_hist[-1]:.6f} "
+          f"(converged={bool(lb.converged)})")
+
+    # --- the L1 pair: prox-AGD and OWL-QN reach the same sparse optimum
+    l1 = 0.02
+    agd_l1_w, _ = api.run(
+        (X, y), losses.LogisticGradient(), prox.L1Prox(), reg_param=l1,
+        convergence_tol=1e-10, num_iterations=500, initial_weights=w0)
+    owl = api.run_lbfgs(  # L1Updater dispatches to OWL-QN
+        (X, y), losses.LogisticGradient(), prox.L1Updater(),
+        reg_param=l1, convergence_tol=1e-10, num_iterations=200,
+        initial_weights=w0)
+    za = int(np.sum(np.asarray(agd_l1_w) == 0))
+    zo = int(np.sum(np.asarray(owl.weights) == 0))
+    same_support = set(np.nonzero(np.asarray(agd_l1_w))[0]) == set(
+        np.nonzero(np.asarray(owl.weights))[0])
+    print(f"L1: prox-AGD zeros {za}/{d}, OWL-QN zeros {zo}/{d}, "
+          f"same support: {same_support}")
+
+    # --- a regularization path, every member batched ------------------
+    regs = [1e-4, 1e-3, 1e-2, 1e-1]
+    sw = api.sweep((X, y), losses.LogisticGradient(),
+                   prox.SquaredL2Updater(), regs, num_iterations=20,
+                   convergence_tol=0.0, initial_weights=w0, mesh=None)
+    fit = sat.make_lbfgs_sweep_runner(
+        (X, y), losses.LogisticGradient(), prox.SquaredL2Updater(),
+        convergence_tol=1e-8, num_iterations=30, mesh=None)
+    lsw = fit(w0, regs)
+    print("path (4 strengths, one compiled program each):")
+    for k, r in enumerate(regs):
+        ah = np.asarray(sw.loss_history)[k][int(sw.num_iters[k]) - 1]
+        lh = np.asarray(lsw.loss_history)[k][int(lsw.num_iters[k])]
+        print(f"  reg={r:g}: AGD {float(ah):.6f} @20 | "
+              f"LBFGS {float(lh):.6f} @{int(lsw.num_iters[k])}")
+
+
+if __name__ == "__main__":
+    main()
