@@ -142,6 +142,11 @@ RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 15))
 # near-instant and 700 s covers compile + run many times over; during
 # an outage the claim queue exceeds any worker budget anyway.
 WORKER_TIMEOUT_S = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", 700))
+# Shape-ladder policy shared with tpu_all.py's in-process ladder: only
+# shapes at least LADDER_MIN_ROWS get a reduced rung, at 1/LADDER_DIVISOR
+# of the rows, run lean (ride-alongs off).
+LADDER_MIN_ROWS = 1 << 16
+LADDER_DIVISOR = 8
 
 # Per-chip peaks for roofline accounting: device_kind substring ->
 # (dense bf16 TFLOP/s, HBM GB/s).  Public spec-sheet numbers; matmuls on
@@ -547,11 +552,12 @@ def worker_main():
     print(json.dumps(out), flush=True)
 
 
-def _run_worker(tag):
-    """Launch one worker attempt; returns the parsed JSON dict or None."""
+def _run_worker(tag, extra_env=None):
+    """Launch one worker attempt; returns the parsed JSON dict or None.
+    ``extra_env`` overrides knobs for this attempt (the retry ladder)."""
     log(f"worker attempt ({tag}), timeout {WORKER_TIMEOUT_S:.0f}s, "
         f"init budget {INIT_BUDGET_S:.0f}s/step")
-    env = dict(os.environ, BENCH_STAGE="worker")
+    env = dict(os.environ, BENCH_STAGE="worker", **(extra_env or {}))
     # Seed the deepest marker before the spawn: the axon plugin registers
     # at interpreter startup, which can hang before any bench.py code
     # runs — only the parent can record that mode.  The Probe-based seed
@@ -673,7 +679,23 @@ def main():
     if out is None:
         log(f"pausing {RETRY_PAUSE_S:.0f}s before retry")
         time.sleep(RETRY_PAUSE_S)
-        out = _run_worker("retry")
+        # Retry at 1/8 rows when the full shape is large: the one
+        # observed healthy-claim failure mode is the FULL-SHAPE fused
+        # compile/execute wedging (AVAILABILITY.md r3) — a banked
+        # smaller measured-TPU record beats a second identical wedge
+        # followed by a CPU fallback.  tpu_all.py's in-process ladder
+        # does the same in the opposite order (bank small first).
+        if N_ROWS >= LADDER_MIN_ROWS:
+            retry_rows = N_ROWS // LADDER_DIVISOR
+            out = _run_worker("retry", extra_env={
+                "BENCH_ROWS": str(retry_rows),
+                # lean rung: the ride-alongs' extra compiles are the
+                # wedge exposure this retry exists to avoid
+                "BENCH_ALT_DTYPE": "0", "BENCH_LOSS_MODES": "0"})
+            if out is not None:
+                out["bench_rows_scale"] = round(retry_rows / N_ROWS, 4)
+        else:
+            out = _run_worker("retry")
     if out is None or out.get("error"):
         rep = _find_replay()
         if rep is not None:

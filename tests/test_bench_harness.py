@@ -41,7 +41,8 @@ class TestBenchFallbackChain:
         """Both worker attempts fail -> in-process CPU fallback must still
         emit ONE parseable JSON line with a degraded error marker and a
         real measurement (the driver parses exactly this)."""
-        monkeypatch.setattr(bench, "_run_worker", lambda tag: None)
+        monkeypatch.setattr(bench, "_run_worker",
+                            lambda tag, extra_env=None: None)
         monkeypatch.setattr(bench, "_find_replay", lambda: None)
         monkeypatch.setattr(bench, "_EMITTED", False)
         monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
@@ -174,7 +175,8 @@ class TestBenchFallbackChain:
                "measured_at_unix": _time.time() - 60}
         with open("BENCH_MANUAL_r99.json", "w") as f:
             f.write(json.dumps(rec) + "\n")
-        monkeypatch.setattr(bench, "_run_worker", lambda tag: None)
+        monkeypatch.setattr(bench, "_run_worker",
+                            lambda tag, extra_env=None: None)
         monkeypatch.setattr(bench, "_EMITTED", False)
         monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
         with pytest.raises(SystemExit) as exc:
@@ -424,7 +426,7 @@ class TestFallbackWatchdog:
             f"{os.path.join(REPO, 'bench.py')!r})\n"
             "b = importlib.util.module_from_spec(spec)\n"
             "spec.loader.exec_module(b)\n"
-            "b._run_worker = lambda tag: None\n"
+            "b._run_worker = lambda tag, extra_env=None: None\n"
             "b.RETRY_PAUSE_S = 0.0\n"
             "b.cpu_fallback = lambda reason: time.sleep(60)\n"
             "os.environ['BENCH_FALLBACK_BUDGET_S'] = '2'\n"
@@ -440,3 +442,57 @@ class TestFallbackWatchdog:
                  if ln.strip()]
         out = json.loads(lines[-1])
         assert "exceeded its budget" in out["error"]
+
+
+class TestRetryLadder:
+    def test_retry_uses_reduced_lean_shape(self, bench, monkeypatch,
+                                           capsys):
+        """After a failed full-shape attempt, the retry must request
+        1/LADDER_DIVISOR rows with the ride-alongs off, and the banked
+        record must carry its scale label."""
+        calls = []
+
+        def fake_worker(tag, extra_env=None):
+            calls.append((tag, extra_env))
+            if tag == "first":
+                return None
+            return {"value": 5.0, "unit": "iters/sec",
+                    "platform": "tpu", "error": None}
+
+        monkeypatch.setattr(bench, "_run_worker", fake_worker)
+        monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+        monkeypatch.setattr(bench, "N_ROWS", bench.LADDER_MIN_ROWS)
+        monkeypatch.setattr(bench, "_EMITTED", False)
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+        assert exc.value.code == 0
+        assert calls[0] == ("first", None)
+        tag, env = calls[1]
+        assert tag == "retry"
+        assert env == {
+            "BENCH_ROWS": str(bench.LADDER_MIN_ROWS
+                              // bench.LADDER_DIVISOR),
+            "BENCH_ALT_DTYPE": "0", "BENCH_LOSS_MODES": "0"}
+        out = json.loads([ln for ln in
+                          capsys.readouterr().out.splitlines()
+                          if ln.strip()][-1])
+        assert out["bench_rows_scale"] == round(
+            1.0 / bench.LADDER_DIVISOR, 4)
+
+    def test_small_shapes_retry_unchanged(self, bench, monkeypatch):
+        calls = []
+
+        def fake_worker(tag, extra_env=None):
+            calls.append((tag, extra_env))
+            return None if tag == "first" else {
+                "value": 1.0, "unit": "iters/sec", "platform": "tpu",
+                "error": None}
+
+        monkeypatch.setattr(bench, "_run_worker", fake_worker)
+        monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+        monkeypatch.setattr(bench, "N_ROWS",
+                            bench.LADDER_MIN_ROWS // 2)
+        monkeypatch.setattr(bench, "_EMITTED", False)
+        with pytest.raises(SystemExit):
+            bench.main()
+        assert calls[1] == ("retry", None)

@@ -422,8 +422,8 @@ def main(argv=None):
         full_flags = {k: (v if v is not None else "1")
                       for k, v in prior_env.items()}
         rungs = [(full_rows, args.bench_budget, full_flags)]
-        if full_rows >= (1 << 16):
-            rungs.insert(0, (full_rows // 8, 900,
+        if full_rows >= bench.LADDER_MIN_ROWS:
+            rungs.insert(0, (full_rows // bench.LADDER_DIVISOR, 900,
                              dict.fromkeys(prior_env, "0")))
         banked = None
         try:
