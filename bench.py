@@ -32,6 +32,29 @@ indefinitely when the TPU tunnel is wedged):
 - main() emits ONE parseable JSON line on stdout in EVERY outcome,
   including unexpected exceptions (``error`` field set, rc=1).
 
+Claim-conversion ladder (VERDICT r3 items 1-3): the ONE healthy claim of
+rounds 2-3 was burned by running the full-shape fused program first — it
+wedged >45 min in compile/execute and the watchdog kill discarded
+everything (AVAILABILITY.md).  The worker therefore climbs a SMALL-FIRST
+ladder inside one claim, banking every healthy measured-TPU record to
+disk (``BENCH_MANUAL_roundend.json``) the moment it exists:
+
+    host driver @ lean shape   — only simple matmul-class compiles, the
+    host driver @ full shape     program class the r3 healthy claim
+                                 PROVED works (tiny_compile 0.75 s,
+                                 TPU_PROBE_r03.json)
+    fused loop  @ lean shape   — the real design, 1/8 rows
+    fused loop  @ full shape   — the headline shape, riskiest last
+    ride-alongs (pallas, alt dtype, loss modes) after the headline banks
+
+Every fused compile is AOT-split (``jit(...).lower()`` / ``.compile()`` /
+first execute) with per-phase probe markers and budgets, so the next
+wedge names WHICH phase the backend cannot do instead of hanging in one
+opaque call.  A wedge at any rung kills the process (watchdog) but the
+bank survives; the orchestrator's replay path then emits the banked
+record.  The final emission is the best-ranked healthy rung (fused over
+host, then larger scale), with the full ladder summary attached.
+
 Roofline accounting (VERDICT r1 item 2): each smooth evaluation is two
 N×D matmuls (forward margins + gradient), i.e. 4·N·D flops and two full
 reads of X from HBM; the fused Pallas path reads X once.  The JSON reports
@@ -134,14 +157,24 @@ if BENCH_DTYPE not in ("f32", "bf16"):
 PARITY_ITERS = int(os.environ.get("BENCH_PARITY_ITERS", 10))
 REG = 0.1
 RETRY_PAUSE_S = float(os.environ.get("BENCH_RETRY_PAUSE_S", 15))
-# Hard ceiling on one worker attempt (backend init + compile + run).
-# Sized so the WHOLE chain (attempt + pause + retry + CPU fallback,
-# ~2*700 + 15 + ~120 ≈ 1550 s) fits inside a 30-minute caller timeout —
-# a driver that kills the orchestrator mid-chain gets no JSON at all,
-# which is round 1's failure mode.  On a healthy pool the claim is
-# near-instant and 700 s covers compile + run many times over; during
-# an outage the claim queue exceeds any worker budget anyway.
-WORKER_TIMEOUT_S = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", 700))
+# Host-driver rung length: enough outer iterations for a stable
+# iters/sec over the tunnel's dispatch latency, short enough to stay a
+# fast banking rung.
+NUM_ITERS_HOST = int(os.environ.get("BENCH_ITERS_HOST", 20))
+# Where the worker banks each healthy measured-TPU rung as it happens.
+# The name matches the ``BENCH_MANUAL_*.json`` replay glob, so a worker
+# that wedges mid-ladder still converts: the orchestrator replays the
+# bank.
+BANK_PATH = os.environ.get("BENCH_BANK_PATH", "BENCH_MANUAL_roundend.json")
+# Hard ceiling on one worker attempt (backend init + the full ladder).
+# Chain math for the 30-minute caller budget (round 1's failure mode was
+# the caller killing the orchestrator mid-chain with nothing on stdout):
+# ladder attempt 1150 + pause 15 + lean retry 250 + CPU fallback 300
+# ≈ 1715 s < 1800.  During an outage the claim step's 150 s watchdog
+# exits long before these ceilings; on a healthy pool the ladder banks
+# rung-by-rung, so even a timeout kill here converts via the bank.
+WORKER_TIMEOUT_S = float(os.environ.get("BENCH_WORKER_TIMEOUT_S", 1150))
+RETRY_TIMEOUT_S = float(os.environ.get("BENCH_RETRY_TIMEOUT_S", 250))
 # Shape-ladder policy shared with tpu_all.py's in-process ladder: only
 # shapes at least LADDER_MIN_ROWS get a reduced rung, at 1/LADDER_DIVISOR
 # of the rows, run lean (ride-alongs off).
@@ -221,7 +254,7 @@ def probe_backend():
     return d
 
 
-def make_data_device(seed=7):
+def make_data_device(seed=7, rows=None):
     """Generate the bench dataset ON the accelerator (no bulk H2D).
 
     ``data.device_synth.class_logistic`` is elementwise-only, so the host
@@ -235,20 +268,22 @@ def make_data_device(seed=7):
 
     from spark_agd_tpu.data import device_synth
 
+    rows = N_ROWS if rows is None else rows
     key = jax.random.PRNGKey(seed)
     return device_synth.device_gen(
-        lambda k: device_synth.class_logistic(k, N_ROWS, N_FEATURES), key)
+        lambda k: device_synth.class_logistic(k, rows, N_FEATURES), key)
 
 
-def make_data_host(seed=7):
+def make_data_host(seed=7, rows=None):
     """The CPU-backend twin of ``make_data_device`` (same bits)."""
     import jax
 
     from spark_agd_tpu.data import device_synth
 
+    rows = N_ROWS if rows is None else rows
     key = jax.random.PRNGKey(seed)
     Xh, yh = device_synth.host_gen(
-        lambda k: device_synth.class_logistic(k, N_ROWS, N_FEATURES), key)
+        lambda k: device_synth.class_logistic(k, rows, N_FEATURES), key)
     return np.asarray(Xh), np.asarray(yh)
 
 
@@ -283,23 +318,28 @@ def _time_step(step, w0):
     return res, run_s, compile_s
 
 
-def _roofline(res, run_s, device, x_reads_per_pass=2, itemsize=4):
+def _roofline(res, run_s, device, x_reads_per_pass=2, itemsize=4,
+              rows=None):
     """iters/sec plus MFU / HBM-bandwidth fraction for one timed run.
 
     ``x_reads_per_pass``: full HBM reads of X per smooth evaluation — 2
     for the XLA lowering (forward matmul + gradient matmul), 1 for the
     fused Pallas kernel.  ``itemsize``: bytes per X element (4 f32,
-    2 bf16).
+    2 bf16).  Shape-agnostic: ``rows`` defaults to the module-level
+    bench shape (the ladder passes each rung's own rows).
     """
+    rows = N_ROWS if rows is None else rows
     iters = int(res.num_iters)
     n_bt = int(res.num_backtracks)
-    # Smooth-evaluation count for the fused loop, loss_mode='x': each
-    # trial is a y-eval plus an x-eval, trials = iters + backtracks, and
-    # the loss history reuses the trial's f(x) (no third pass) —
-    # core/agd.py module docstring.
+    # Smooth-evaluation count, loss_mode='x': each trial is a y-eval
+    # plus an x-eval, trials = iters + backtracks, and the loss history
+    # reuses the trial's f(x) (no third pass) — core/agd.py module
+    # docstring.  The HOST driver has the identical count (same
+    # recurrence, same reuse — core/host_agd.py), so this function
+    # serves both rung kinds.
     passes = 2 * (iters + n_bt)
-    flops = passes * 4.0 * N_ROWS * N_FEATURES
-    hbm_bytes = passes * x_reads_per_pass * N_ROWS * N_FEATURES * itemsize
+    flops = passes * 4.0 * rows * N_FEATURES
+    hbm_bytes = passes * x_reads_per_pass * rows * N_FEATURES * itemsize
     out = {
         "iters_per_sec": iters / run_s,
         "smooth_passes": passes,
@@ -419,10 +459,496 @@ def bench_cpu(X, y):
     return iters / run_s, res
 
 
-def run_bench():
+# ---------------------------------------------------------------------------
+# Claim-conversion ladder (module docstring).  Worker-side: one claim,
+# rungs cheapest/safest first, every healthy record banked to disk
+# immediately.  ``mark``/``done`` are probe hooks — the worker wires its
+# own (_probe_mark/_probe_done), tpu_all.py passes its Probe's methods so
+# the same ladder runs in-process under the watcher with per-stage
+# budgets arming ITS watchdog.
+# ---------------------------------------------------------------------------
+
+
+def _time_step_aot(step, w0, tag, mark, done, compile_budget=480):
+    """AOT-split timing: trace, compile, and first execute are separate
+    probe-marked phases (VERDICT r3 item 2: the r3 wedge was one opaque
+    >45 min compile+execute call — the next one must name its phase)."""
+    import jax
+
+    mark(f"{tag}-trace", 240)
+    t0 = time.perf_counter()
+    lowered = step.lower(w0)
+    trace_s = time.perf_counter() - t0
+    done(f"{tag}-trace", **{f"{tag.replace('-', '_')}_trace_s":
+                            round(trace_s, 2)})
+    mark(f"{tag}-compile", compile_budget)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    done(f"{tag}-compile", **{f"{tag.replace('-', '_')}_compile_s":
+                              round(compile_s, 2)})
+    mark(f"{tag}-execute", 360)
+    t0 = time.perf_counter()
+    res = compiled(w0)
+    jax.block_until_ready(res)
+    first_exec_s = time.perf_counter() - t0
+    done(f"{tag}-execute", **{f"{tag.replace('-', '_')}_execute_s":
+                              round(first_exec_s, 2)})
+    # steady-state timing: a second run of the already-compiled program
+    # (marked too — device work must never run outside a budget window)
+    mark(f"{tag}-run", 360)
+    t0 = time.perf_counter()
+    res = compiled(w0)
+    jax.block_until_ready(res)
+    run_s = time.perf_counter() - t0
+    done(f"{tag}-run")
+    return res, run_s, compile_s, trace_s, first_exec_s
+
+
+def _drift(hist, cpu_hist):
+    """Max relative loss-trajectory deviation vs the f64 oracle over the
+    overlapping prefix (default-precision check: warn-level only — bf16
+    MXU drift is expected, not a failure)."""
+    k = min(len(hist), len(cpu_hist))
+    if k == 0:
+        return 0.0
+    ref = np.asarray(cpu_hist)[:k]
+    return float(np.max(np.abs((np.asarray(hist)[:k] - ref) / ref)))
+
+
+def _full_rows_ref():
+    """The session's TRUE full shape for ``bench_rows_scale`` labels.
+
+    A retry worker runs with BENCH_ROWS already reduced, so its module
+    N_ROWS is NOT the session's full shape — the orchestrator passes
+    the original via BENCH_FULL_ROWS so banked records can never claim
+    a scale they weren't measured at (review finding: an unlabeled 1/8
+    rung replayed as full-scale would inflate the headline)."""
+    return int(os.environ.get("BENCH_FULL_ROWS", 0)) or N_ROWS
+
+
+def _record_rank(rec):
+    """The ONE ladder/replay ordering: fused over host (it IS the
+    design under test), then rows scale.  Records missing the labels
+    are treated as full fused — the pre-ladder record shape."""
+    return (2 if rec.get("bench_driver", "fused") == "fused" else 1,
+            float(rec.get("bench_rows_scale", 1.0)))
+
+
+def _ladder_record(driver, rows, stats, compile_s, run_s, cpu_ips,
+                   drift, device, dtype, trace_s=None, first_exec_s=None):
+    """One rung's record, same schema as the single-shot bench plus the
+    ladder labels (``bench_driver``, ``bench_rows_scale``)."""
+    out = {
+        "metric": f"agd_iterations_per_sec_logistic_{rows}x{N_FEATURES}",
+        "value": round(stats["iters_per_sec"], 2),
+        "measured_at_unix": round(time.time(), 1),
+        "unit": "iters/sec",
+        "vs_baseline": (None if not cpu_ips
+                        else round(stats["iters_per_sec"] / cpu_ips, 2)),
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "dtype": dtype,
+        "bench_driver": driver,
+        "bench_rows": rows,
+        "bench_rows_scale": round(rows / _full_rows_ref(), 4),
+        "compile_s": round(compile_s, 1),
+        "run_s": round(run_s, 3),
+        "mfu": None if stats["mfu"] is None else round(stats["mfu"], 4),
+        "hbm_bw_frac": (None if stats["hbm_bw_frac"] is None
+                        else round(stats["hbm_bw_frac"], 3)),
+        "tflops_per_sec": round(stats["tflops_per_sec"], 2),
+        "hbm_gbps": round(stats["hbm_gbps"], 1),
+        "trajectory_drift_rel": round(drift, 6),
+        "error": None,
+    }
+    if trace_s is not None:
+        out["trace_s"] = round(trace_s, 2)
+    if first_exec_s is not None:
+        out["first_execute_s"] = round(first_exec_s, 2)
+    return out
+
+
+def _oracle(rows, cache, mark, done):
+    """Per-shape f64 CPU oracle (host twin data + driver loop): the
+    ``vs_baseline`` denominator and the parity/drift reference.  Pure
+    host work — cannot wedge the chip; budgeted only against
+    pathological slowness."""
+    if rows in cache:
+        return cache[rows]
+    mark(f"oracle-{rows}r", 900)
+    Xh, yh = make_data_host(rows=rows)
+    cpu_ips, cpu_res = bench_cpu(Xh, yh)
+    done(f"oracle-{rows}r", **{f"oracle_{rows}r_ips": round(cpu_ips, 2)})
+    cache[rows] = (cpu_ips, np.asarray(cpu_res.loss_history))
+    return cache[rows]
+
+
+def _device_data(rows, cache, mark, done):
+    """Per-shape on-device dataset (f32), generated once per ladder."""
+    import jax
+
+    if rows in cache:
+        return cache[rows]
+    mark(f"data-{rows}r", 300)
+    t0 = time.perf_counter()
+    Xd, yd = make_data_device(rows=rows)
+    jax.block_until_ready(Xd)
+    done(f"data-{rows}r", **{f"data_{rows}r_s":
+                             round(time.perf_counter() - t0, 2)})
+    cache[rows] = (Xd, yd)
+    return cache[rows]
+
+
+def bench_host(rows, device, cpu_ips, cpu_hist, mark, done, data_cache):
+    """Host-driver rung: the reference's own driver architecture
+    (``core/host_agd.py``; reference ``AcceleratedGradientDescent.scala:
+    237-332``) run ON the chip — Python orchestrates, only the smooth /
+    prox kernels are device programs.  Needs nothing but simple
+    matmul-class compiles, the program class the r3 healthy claim PROVED
+    works (tiny_compile 0.75 s, ``TPU_PROBE_r03.json``), so it banks a
+    real measured-TPU iters/sec + MFU even if the big fused while_loop
+    never compiles on this toolchain (VERDICT r4 item 3).  Its delta to
+    the fused rung IS the measured win of fusing the driver away."""
     import jax
     import jax.numpy as jnp
 
+    from spark_agd_tpu.core import agd as agd_lib
+    from spark_agd_tpu.core import host_agd
+    from spark_agd_tpu.core import smooth as smooth_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    tag = f"host-{rows}r"
+    Xd, yd = _device_data(rows, data_cache, mark, done)
+    w0 = jnp.zeros(N_FEATURES, jnp.float32)
+    # make_smooth runs gradient.prepare() eagerly — device work, so it
+    # gets its own budget window
+    mark(f"{tag}-stage", 180)
+    sm = jax.jit(smooth_lib.make_smooth(LogisticGradient(), Xd, yd, None))
+    done(f"{tag}-stage")
+    # AOT-compile the one nontrivial program (the smooth kernel) with
+    # split phase markers; prox/axpby are trivial elementwise kernels
+    # compiled during the warm-up below.
+    mark(f"{tag}-smooth-trace", 180)
+    t0 = time.perf_counter()
+    lowered = sm.lower(w0)
+    done(f"{tag}-smooth-trace")
+    mark(f"{tag}-smooth-compile", 360)
+    compiled_sm = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    done(f"{tag}-smooth-compile",
+         **{f"host_{rows}r_smooth_compile_s": round(compile_s, 2)})
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    pxj, rvj = jax.jit(px), jax.jit(rv)
+
+    def smooth_fn(w):
+        return compiled_sm(w)
+
+    mark(f"{tag}-warmup", 300)
+    host_agd.run_agd_host(
+        smooth_fn, pxj, rvj, w0,
+        agd_lib.AGDConfig(convergence_tol=0.0, num_iterations=2))
+    done(f"{tag}-warmup")
+    mark(f"{tag}-run", 900)
+    t0 = time.perf_counter()
+    res = host_agd.run_agd_host(
+        smooth_fn, pxj, rvj, w0,
+        agd_lib.AGDConfig(convergence_tol=0.0,
+                          num_iterations=NUM_ITERS_HOST))
+    run_s = time.perf_counter() - t0
+    done(f"{tag}-run", **{f"host_{rows}r_run_s": round(run_s, 2)})
+    stats = _roofline(res, run_s, device, rows=rows)
+    drift = _drift(res.loss_history[:res.num_iters], cpu_hist)
+    log(f"host rung {rows}r: compile={compile_s:.1f}s run={run_s:.2f}s "
+        f"iters={res.num_iters} backtracks={res.num_backtracks} "
+        f"ips={stats['iters_per_sec']:.2f} mfu={stats['mfu']} "
+        f"drift={drift:.2e}")
+    return _ladder_record("host", rows, stats, compile_s, run_s, cpu_ips,
+                          drift, device, "f32")
+
+
+def host_parity(rows, cpu_hist, data_cache, mark, done):
+    """Highest-precision host-driver parity gate vs the f64 oracle —
+    the host twin of ``check_parity``, used when the ladder's best rung
+    is a host record (the fused gate never ran)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import agd as agd_lib
+    from spark_agd_tpu.core import host_agd
+    from spark_agd_tpu.core import smooth as smooth_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    Xd, yd = data_cache[rows]
+    k = min(PARITY_ITERS, len(cpu_hist))
+    w0 = jnp.zeros(N_FEATURES, jnp.float32)
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    mark(f"host-{rows}r-parity", 420)
+    with jax.default_matmul_precision("highest"):
+        sm = jax.jit(smooth_lib.make_smooth(
+            LogisticGradient(), Xd, yd, None))
+        res = host_agd.run_agd_host(
+            sm, jax.jit(px), jax.jit(rv), w0,
+            agd_lib.AGDConfig(convergence_tol=0.0, num_iterations=k))
+    done(f"host-{rows}r-parity")
+    np.testing.assert_allclose(
+        res.loss_history[:k], np.asarray(cpu_hist)[:k], rtol=1e-3,
+        err_msg="host-driver TPU (highest precision) and CPU-oracle "
+                "loss trajectories diverged")
+    log(f"host-driver loss-trajectory parity ok over {k} iterations")
+
+
+def bench_fused_rung(rows, device, cpu_ips, cpu_hist, mark, done,
+                     data_cache):
+    """One fused-program rung at ``rows``, AOT-split and roofline'd."""
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.ops.losses import LogisticGradient
+
+    tag = f"fused-{rows}r"
+    Xd32, yd = _device_data(rows, data_cache, mark, done)
+    # the dtype cast and gradient.prepare() staging are device work —
+    # budgeted like every other phase (review finding: no device op may
+    # run in a watchdog gap)
+    mark(f"{tag}-stage", 240)
+    Xd = Xd32.astype(jnp.bfloat16) if BENCH_DTYPE == "bf16" else Xd32
+    w0 = jnp.zeros(N_FEATURES, jnp.float32)
+    step = _make_step(LogisticGradient(), Xd, yd, NUM_ITERS_TPU)
+    done(f"{tag}-stage")
+    res, run_s, compile_s, trace_s, first_exec_s = _time_step_aot(
+        step, w0, tag, mark, done)
+    iters = int(res.num_iters)
+    hist = np.asarray(res.loss_history)[:iters]
+    stats = _roofline(res, run_s, device, itemsize=Xd.dtype.itemsize,
+                      rows=rows)
+    drift = _drift(hist, cpu_hist)
+    log(f"fused rung {rows}r: trace={trace_s:.1f}s "
+        f"compile={compile_s:.1f}s first_exec={first_exec_s:.1f}s "
+        f"run={run_s * 1e3:.1f}ms iters={iters} "
+        f"ips={stats['iters_per_sec']:.2f} mfu={stats['mfu']} "
+        f"bw_frac={stats['hbm_bw_frac']} drift={drift:.2e}")
+    return _ladder_record("fused", rows, stats, compile_s, run_s,
+                          cpu_ips, drift, device, BENCH_DTYPE,
+                          trace_s=trace_s, first_exec_s=first_exec_s)
+
+
+def _ride_alongs(rec, rows, device, data_cache, mark, done):
+    """Comparison points measured only after the headline fused rung
+    banked: Pallas single-HBM-pass kernel, the alternate dtype, the
+    loss-mode cost-parity pair.  Each is budgeted and failure-isolated —
+    a ride-along may fail, never the banked record."""
+    import jax.numpy as jnp
+
+    Xd32, yd = data_cache[rows]
+    w0 = jnp.zeros(N_FEATURES, jnp.float32)
+    Xd = Xd32.astype(jnp.bfloat16) if BENCH_DTYPE == "bf16" else Xd32
+    global N_ROWS
+    saved_rows = N_ROWS
+    N_ROWS = rows  # bench_tpu_pallas/_roofline default-shape callees
+    try:
+        mark("pallas-ride-along", 600)
+        pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
+        done("pallas-ride-along")
+        if pallas is not None:
+            rec["pallas_iters_per_sec"] = round(
+                pallas["iters_per_sec"], 2)
+            rec["pallas_hbm_bw_frac"] = (
+                None if pallas["hbm_bw_frac"] is None
+                else round(pallas["hbm_bw_frac"], 3))
+        else:
+            rec["pallas_iters_per_sec"] = None
+            rec["pallas_note"] = pallas_note
+        if os.environ.get("BENCH_ALT_DTYPE") == "1":
+            alt_dt = (jnp.float32 if BENCH_DTYPE == "bf16"
+                      else jnp.bfloat16)
+            alt_name = "f32" if BENCH_DTYPE == "bf16" else "bf16"
+            try:
+                mark("alt-dtype-ride-along", 600)
+                alt, _, _ = bench_tpu(Xd32.astype(alt_dt), yd, w0, device)
+                done("alt-dtype-ride-along")
+                rec[f"{alt_name}_iters_per_sec"] = round(
+                    alt["iters_per_sec"], 2)
+                rec[f"{alt_name}_hbm_bw_frac"] = (
+                    None if alt["hbm_bw_frac"] is None
+                    else round(alt["hbm_bw_frac"], 3))
+            except Exception as e:  # noqa: BLE001 — comparison only
+                done("alt-dtype-ride-along")
+                log(f"alt-dtype ride-along failed: "
+                    f"{type(e).__name__}: {e}")
+        if os.environ.get("BENCH_LOSS_MODES") == "1":
+            from spark_agd_tpu.ops.losses import LogisticGradient
+            for lm in ("x_strict", "y"):
+                try:
+                    mark(f"loss-mode-{lm}", 600)
+                    step = _make_step(LogisticGradient(), Xd, yd,
+                                      NUM_ITERS_TPU, loss_mode=lm)
+                    res, run_s, _ = _time_step(step, w0)
+                    done(f"loss-mode-{lm}")
+                    rec[f"loss_mode_{lm}_iters_per_sec"] = round(
+                        int(res.num_iters) / run_s, 2)
+                except Exception as e:  # noqa: BLE001
+                    done(f"loss-mode-{lm}")
+                    log(f"loss_mode={lm} failed: {type(e).__name__}: {e}")
+    finally:
+        N_ROWS = saved_rows
+
+
+def _write_bank(path, best, records, failed):
+    """Atomically persist the current best record (+ ladder summary) —
+    the artifact a dead worker leaves behind for the replay path."""
+    rec = dict(best)
+    rec["ladder"] = {k: dict(v) for k, v in records.items()}
+    if failed:
+        rec["rungs_failed"] = dict(failed)
+    tmp = path + ".bank.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    os.replace(tmp, path)
+    return rec
+
+
+def run_ladder(device=None, mark=None, done=None, bank_path=None):
+    """Climb the claim-conversion ladder (module docstring) on an
+    already-claimed backend; returns the best-ranked healthy record with
+    the full ladder summary attached.  Raises only when NO rung measured
+    — anything banked survives on disk regardless of how this process
+    ends."""
+    from spark_agd_tpu.data import device_synth
+
+    mark = mark or _probe_mark
+    done = done or _probe_done
+    bank_path = bank_path or BANK_PATH
+    device_synth.ensure_cpu_backend()  # oracle twins need the cpu backend
+    if device is None:
+        device = probe_backend()
+    full_rows = N_ROWS
+    shapes = [full_rows]
+    if full_rows >= LADDER_MIN_ROWS:
+        shapes.insert(0, full_rows // LADDER_DIVISOR)
+    oracle_cache, data_cache = {}, {}
+    records, failed = {}, {}
+    healthy = []
+    best = None
+
+    _rank = _record_rank  # shared with the replay path's ordering
+
+    bank_wrote = [False]
+
+    def _rebank():
+        nonlocal best
+        live = [r for r in healthy if not r.get("error")]
+        best = max(live, key=_rank) if live else None
+        if best is not None:
+            _write_bank(bank_path, best, records, failed)
+            bank_wrote[0] = True
+        elif bank_wrote[0] and healthy:
+            # everything this ladder banked has since been poisoned
+            # (e.g. the only rung's parity gate failed): the on-disk
+            # record must not keep advertising error=None, or the
+            # replay path would emit a trajectory-divergent number as
+            # healthy (review finding) — rewrite it WITH its error
+            _write_bank(bank_path, max(healthy, key=_rank), records,
+                        failed)
+
+    def _climb(name, fn):
+        try:
+            rec = fn()
+        except Exception as e:  # noqa: BLE001 — a failed rung must not
+            # stop the climb (the watchdog handles hangs by killing the
+            # process; the bank survives either way)
+            failed[name] = f"{type(e).__name__}: {e}"[:300]
+            log(f"rung {name} failed: {failed[name]}")
+            return None
+        records[name] = {k: rec.get(k) for k in (
+            "value", "vs_baseline", "mfu", "hbm_bw_frac", "compile_s",
+            "run_s", "trace_s", "first_execute_s",
+            "trajectory_drift_rel")}
+        healthy.append(rec)
+        _rebank()
+        return rec
+
+    # host rungs first (both shapes): the proven program class — bank a
+    # real TPU number before ANY fused compile is attempted
+    for rows in shapes:
+        _climb(f"host-{rows}", lambda r=rows: bench_host(
+            r, device, *_oracle(r, oracle_cache, mark, done),
+            mark, done, data_cache))
+    # then the fused design, lean before full (riskiest last)
+    fused_recs = {}
+    for rows in shapes:
+        rec = _climb(f"fused-{rows}", lambda r=rows: bench_fused_rung(
+            r, device, *_oracle(r, oracle_cache, mark, done),
+            mark, done, data_cache))
+        if rec is not None:
+            fused_recs[rows] = rec
+            # parity gate AFTER banking (r3 lesson: never leave a claim
+            # empty-handed); a failure poisons this rung's record and
+            # the bank re-ranks
+            try:
+                import jax.numpy as jnp
+
+                Xd32, yd = data_cache[rows]
+                mark(f"fused-{rows}r-parity", 480)
+                check_parity(Xd32, yd,
+                             jnp.zeros(N_FEATURES, jnp.float32),
+                             oracle_cache[rows][1])
+                done(f"fused-{rows}r-parity")
+                rec["parity"] = "ok"
+            except AssertionError as e:
+                done(f"fused-{rows}r-parity")
+                rec["error"] = f"parity failed: {e}"[:300]
+                failed[f"fused-{rows}-parity"] = rec["error"]
+                log(f"fused {rows}r parity FAILED — rung discarded "
+                    f"from ranking")
+            except Exception as e:  # noqa: BLE001 — a parity-harness
+                # crash is not trajectory divergence; keep the record
+                # but say the gate didn't run
+                done(f"fused-{rows}r-parity")
+                rec["parity"] = f"gate errored: {type(e).__name__}: {e}"[:200]
+            _rebank()
+    if best is not None and best["bench_driver"] == "fused" \
+            and best["bench_rows_scale"] >= 1.0:
+        try:
+            _ride_alongs(best, full_rows, device, data_cache, mark, done)
+        except Exception as e:  # noqa: BLE001
+            log(f"ride-alongs failed: {type(e).__name__}: {e}")
+        _rebank()
+    if best is not None and best["bench_driver"] == "host":
+        try:
+            host_parity(best["bench_rows"],
+                        oracle_cache[best["bench_rows"]][1],
+                        data_cache, mark, done)
+            best["parity"] = "ok"
+        except AssertionError as e:
+            best["parity_error"] = str(e)[:300]
+            log(f"host parity FAILED (record kept, flagged): "
+                f"{best['parity_error']}")
+        except Exception as e:  # noqa: BLE001
+            best["parity"] = f"gate errored: {type(e).__name__}: {e}"[:200]
+        _rebank()
+    if best is None:
+        raise BackendError(
+            f"no ladder rung produced a healthy record: {failed}")
+    # the fused/host delta at matched shape (VERDICT r4 item 3)
+    for rows, frec in fused_recs.items():
+        hrec = next((r for r in healthy
+                     if r["bench_driver"] == "host"
+                     and r["metric"] == frec["metric"]
+                     and not r.get("error")), None)
+        if hrec is not None and not frec.get("error") and hrec["value"]:
+            frec["fused_vs_host_speedup"] = round(
+                frec["value"] / hrec["value"], 2)
+    out = _write_bank(bank_path, best, records, failed)
+    if device.platform != "tpu":
+        out["error"] = "degraded: not running on a TPU backend"
+    return out
+
+
+def _init_backend():
+    """Shared init for both worker paths: CPU-twin backend, persistent
+    compile cache (optimization, never a gate), then the probed claim."""
     from spark_agd_tpu.data import device_synth
     from spark_agd_tpu.utils import compile_cache
 
@@ -433,7 +959,27 @@ def run_bench():
         compile_cache.enable()
     except Exception as e:  # noqa: BLE001
         log(f"compilation cache unavailable: {type(e).__name__}: {e}")
-    device = probe_backend()
+    return probe_backend()
+
+
+def run_bench_entry():
+    """Worker-side dispatch: the banking ladder on a real TPU claim
+    (the round's conversion policy), the single-shot path otherwise
+    (CPU fallback / degraded dev-box runs, where banking tiny rungs
+    buys nothing)."""
+    device = _init_backend()
+    if device.platform == "tpu" and \
+            os.environ.get("BENCH_LADDER", "1") != "0":
+        return run_ladder(device)
+    return run_bench(device)
+
+
+def run_bench(device=None):
+    import jax
+    import jax.numpy as jnp
+
+    if device is None:
+        device = _init_backend()
     log(f"data: {N_ROWS}x{N_FEATURES} f32 "
         f"({N_ROWS * N_FEATURES * 4 / 2**30:.2f} GiB), generated on-device")
     t0 = time.perf_counter()
@@ -542,7 +1088,7 @@ def worker_main():
     """One measured attempt, in its own process so a hang is killable."""
     threading.Thread(target=_init_watchdog_loop, daemon=True).start()
     try:
-        out = run_bench()
+        out = run_bench_entry()
     except Exception as e:  # noqa: BLE001 — always emit parseable JSON
         import traceback
         traceback.print_exc(file=sys.stderr)
@@ -552,12 +1098,19 @@ def worker_main():
     print(json.dumps(out), flush=True)
 
 
-def _run_worker(tag, extra_env=None):
+def _run_worker(tag, extra_env=None, timeout=None):
     """Launch one worker attempt; returns the parsed JSON dict or None.
-    ``extra_env`` overrides knobs for this attempt (the retry ladder)."""
-    log(f"worker attempt ({tag}), timeout {WORKER_TIMEOUT_S:.0f}s, "
+    ``extra_env`` overrides knobs for this attempt (the retry ladder);
+    ``timeout`` overrides the full-ladder ceiling (the lean retry uses
+    a short one)."""
+    timeout = WORKER_TIMEOUT_S if timeout is None else timeout
+    log(f"worker attempt ({tag}), timeout {timeout:.0f}s, "
         f"init budget {INIT_BUDGET_S:.0f}s/step")
-    env = dict(os.environ, BENCH_STAGE="worker", **(extra_env or {}))
+    # BENCH_FULL_ROWS pins the session's true full shape so a reduced-
+    # rows retry worker labels its banked records' bench_rows_scale
+    # against THIS shape, not its own shrunken N_ROWS
+    env = dict(os.environ, BENCH_STAGE="worker",
+               BENCH_FULL_ROWS=str(N_ROWS), **(extra_env or {}))
     # Seed the deepest marker before the spawn: the axon plugin registers
     # at interpreter startup, which can hang before any bench.py code
     # runs — only the parent can record that mode.  The Probe-based seed
@@ -573,10 +1126,11 @@ def _run_worker(tag, extra_env=None):
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
-            stdout=subprocess.PIPE, timeout=WORKER_TIMEOUT_S)
+            stdout=subprocess.PIPE, timeout=timeout)
     except subprocess.TimeoutExpired:
-        log(f"worker ({tag}) TIMED OUT after {WORKER_TIMEOUT_S:.0f}s "
-            f"(hung backend init?) — killed")
+        log(f"worker ({tag}) TIMED OUT after {timeout:.0f}s "
+            f"(hung backend init or mid-ladder wedge?) — killed; any "
+            f"banked rung records survive for the replay path")
         return None
     lines = proc.stdout.decode().strip().splitlines()
     if not lines:
@@ -651,11 +1205,16 @@ def _find_replay():
     mtime at checkout, so file mtime cannot distinguish sessions) with a
     max age of ``BENCH_REPLAY_MAX_AGE_S`` (default 12 h, the session
     length).
+
+    Candidates are ranked like the ladder (fused over host, then rows
+    scale, then recency), so a dead worker's banked host-lean rung never
+    shadows a watcher cycle's full fused record.
     """
     import glob
 
     max_age = float(os.environ.get("BENCH_REPLAY_MAX_AGE_S", 43200))
     best = None
+    best_key = None
     for p in glob.glob("BENCH_MANUAL_*.json"):
         try:
             with open(p) as f:
@@ -666,8 +1225,9 @@ def _find_replay():
         if (rec.get("platform") == "tpu" and not rec.get("error")
                 and isinstance(ts, (int, float))
                 and 0 <= time.time() - ts <= max_age):
-            if best is None or ts > best[0]:
-                best = (ts, p, rec)
+            key = (*_record_rank(rec), ts)
+            if best is None or key > best_key:
+                best, best_key = (ts, p, rec), key
     return best
 
 
@@ -675,27 +1235,50 @@ def main():
     if os.environ.get("BENCH_STAGE") == "worker":
         worker_main()
         return
+    # Attempt 1 IS the small-first banking ladder (worker-side): host
+    # rungs, then fused lean, then fused full — every healthy rung
+    # written to BENCH_MANUAL_roundend.json as it lands, so even a
+    # mid-ladder wedge converts via the replay path below.
     out = _run_worker("first")
     if out is None:
         log(f"pausing {RETRY_PAUSE_S:.0f}s before retry")
         time.sleep(RETRY_PAUSE_S)
-        # Retry at 1/8 rows when the full shape is large: the one
-        # observed healthy-claim failure mode is the FULL-SHAPE fused
-        # compile/execute wedging (AVAILABILITY.md r3) — a banked
-        # smaller measured-TPU record beats a second identical wedge
-        # followed by a CPU fallback.  tpu_all.py's in-process ladder
-        # does the same in the opposite order (bank small first).
+        # Short lean-only retry at 1/8 rows: attempt 1 dying before
+        # banking anything means even its EARLY rungs couldn't run —
+        # retry only the cheap end of the ladder, under a short
+        # timeout, with the ride-alongs off.
         if N_ROWS >= LADDER_MIN_ROWS:
             retry_rows = N_ROWS // LADDER_DIVISOR
             out = _run_worker("retry", extra_env={
                 "BENCH_ROWS": str(retry_rows),
+                # its OWN bank file: the retry's (necessarily lower-
+                # ranked) rungs must never clobber anything attempt 1
+                # banked before wedging (review finding) — the replay
+                # glob ranks across both files
+                "BENCH_BANK_PATH": "BENCH_MANUAL_roundend_retry.json",
                 # lean rung: the ride-alongs' extra compiles are the
                 # wedge exposure this retry exists to avoid
-                "BENCH_ALT_DTYPE": "0", "BENCH_LOSS_MODES": "0"})
+                "BENCH_ALT_DTYPE": "0", "BENCH_LOSS_MODES": "0"},
+                timeout=RETRY_TIMEOUT_S)
             if out is not None:
-                out["bench_rows_scale"] = round(retry_rows / N_ROWS, 4)
+                rows = out.get("bench_rows", retry_rows)
+                out["bench_rows_scale"] = round(rows / N_ROWS, 4)
         else:
-            out = _run_worker("retry")
+            out = _run_worker("retry", timeout=RETRY_TIMEOUT_S)
+    if out is not None and not out.get("error"):
+        # a banked record can outrank the live attempt's best rung
+        # (e.g. attempt 1 banked fused-lean then wedged; the retry only
+        # reached host-lean): emit the best evidence, clearly labeled
+        rep = _find_replay()
+        if rep is not None and _record_rank(rep[2]) > _record_rank(out):
+            measured_ts, path, rec = rep
+            rec["replayed_from"] = path
+            rec["replayed_age_s"] = round(time.time() - measured_ts, 1)
+            rec["replay_reason"] = ("banked record outranks the live "
+                                    "attempt's best rung")
+            log(f"replaying higher-ranked banked record {path}")
+            _emit_once(rec)
+            sys.exit(0)
     if out is None or out.get("error"):
         rep = _find_replay()
         if rep is not None:

@@ -1193,6 +1193,11 @@ def make_lbfgs_sweep_runner(
                                                   batch, m, cfg)
 
         def fit(initial_weights, reg_params):
+            # same IdentityProx-vs-nonzero-grid guard LBFGS.sweep
+            # applies: a no-penalty updater ignores reg, so K lanes
+            # would silently be identical (r3 advisor)
+            reg_params = _check_grid_fit(updater, reg_params,
+                                         "make_lbfgs_sweep_runner")
             return mesh_fit(reg_params, initial_weights)
 
         return fit
@@ -1211,6 +1216,8 @@ def make_lbfgs_sweep_runner(
     step = jax.jit(jax.vmap(fit_one, in_axes=(0, None)))
 
     def fit(initial_weights, reg_params):
+        reg_params = _check_grid_fit(updater, reg_params,
+                                     "make_lbfgs_sweep_runner")
         # default float dtype (f64 under x64): lane regs must match the
         # precision a solo fit's python-float reg_param would carry
         regs = jnp.asarray(reg_params, jnp.result_type(float))
@@ -1259,6 +1266,11 @@ def streaming_lbfgs_sweep(
     from .core import host_lbfgs, lbfgs as lbfgs_lib, tvec
     from .data import streaming as streaming_lib
 
+    # same IdentityProx-vs-nonzero-grid guard LBFGS.sweep applies
+    # (r3 advisor: a no-penalty updater would silently return K
+    # identical lanes)
+    reg_params = _check_grid_fit(updater, reg_params,
+                                 "streaming_lbfgs_sweep")
     lbfgs_lib.check_smooth_penalty(updater, 1.0)
     regs = jnp.asarray(list(reg_params), jnp.result_type(float))
     if regs.ndim != 1:

@@ -33,7 +33,20 @@ from typing import Any, Callable, List, NamedTuple
 import numpy as np
 
 from . import tvec
-from .lbfgs import LBFGSConfig
+from .lbfgs import (LBFGSConfig, LS_STOP_ARMIJO, LS_STOP_NOISE_FLOOR,
+                    LS_STOP_NONE)
+
+
+def _carry_eps(w0) -> float:
+    """The host mirror of the fused driver's carry-dtype resolution
+    (``lbfgs._carry_dtype``) — the noise-floor classification
+    threshold's machine epsilon."""
+    import jax
+
+    dt = np.promote_types(np.result_type(
+        *[np.asarray(l).dtype
+          for l in jax.tree_util.tree_leaves(w0)]), np.float32)
+    return float(np.finfo(dt).eps)
 
 
 class HostLBFGSResult(NamedTuple):
@@ -56,6 +69,10 @@ class HostLBFGSResult(NamedTuple):
     # equals loss_history[-1]; for OWL-QN the history holds F = f + L1
     # while the warm carry needs f — from_result uses this when set
     final_f_smooth: Any = None
+    # WHY the line search stopped the run (``lbfgs.LS_STOP_*`` codes;
+    # 0/none when ``ls_failed`` is False) — the host mirror of the
+    # fused result's ``ls_stop_reason``
+    ls_stop_reason: int = 0
 
 
 class HostLBFGSWarm(NamedTuple):
@@ -99,7 +116,9 @@ def _wolfe_gen(w, f0, g0, d, cfg: LBFGSConfig):
     ``f, g = yield w_trial``.  The solo driver feeds it directly; the
     multi-lane scheduler batches many lanes' pending yields into one
     multi-evaluation — ONE copy of the decision algebra either way.
-    Returns ``(t, f_t, g_t, evals, ok)`` via StopIteration."""
+    Returns ``(t, f_t, g_t, evals, ok, fail_info)`` via StopIteration;
+    ``fail_info = (fail_phase, f_best, t_last, dg0)`` mirrors the fused
+    ``_wolfe_search`` and feeds the ``ls_stop_reason`` split."""
     dg0 = float(tvec.dot(g0, d))
     evals = 0
 
@@ -120,7 +139,7 @@ def _wolfe_gen(w, f0, g0, d, cfg: LBFGSConfig):
         armijo = f_t <= f0 + cfg.c1 * t * dg0
         curv = abs(dg_t) <= -cfg.c2 * dg0
         if armijo and curv:
-            return t, f_t, g_t, evals, True
+            return t, f_t, g_t, evals, True, (0, f_lo, t, dg0)
         if stage == 0:
             rise = (not armijo) or (it > 0 and f_t >= f_lo)
             if rise:
@@ -133,7 +152,7 @@ def _wolfe_gen(w, f0, g0, d, cfg: LBFGSConfig):
                 t_lo, f_lo = t, f_t
                 it += 1
                 if it >= cfg.max_ls_steps:
-                    return 0.0, f0, g0, evals, False
+                    return 0.0, f0, g0, evals, False, (1, f_lo, t, dg0)
                 t = t * cfg.max_step_growth
                 f_t, g_t = yield from _eval(t)
                 evals += 1
@@ -149,7 +168,7 @@ def _wolfe_gen(w, f0, g0, d, cfg: LBFGSConfig):
                 t_lo, f_lo = t, f_t
             it += 1
             if it >= cfg.max_ls_steps:
-                return 0.0, f0, g0, evals, False
+                return 0.0, f0, g0, evals, False, (2, f_lo, t, dg0)
         t = 0.5 * (t_lo + t_hi)
         f_t, g_t = yield from _eval(t)
         evals += 1
@@ -238,6 +257,8 @@ def _lbfgs_gen(w0, config: LBFGSConfig, *, warm=None,
         evals = 1
     hist: List[float] = [f]
     converged = ls_failed = aborted = False
+    ls_reason = LS_STOP_NONE
+    eps = _carry_eps(w0)
     if not np.isfinite(f):
         aborted = True
 
@@ -247,10 +268,21 @@ def _lbfgs_gen(w0, config: LBFGSConfig, *, warm=None,
         if not float(tvec.dot(g, d)) < 0:  # stale curvature fallback
             d = tvec.scale(-1.0, g)
 
-        t, f_n, g_n, ev, ok = yield from _wolfe_gen(w, f, g, d, cfg)
+        t, f_n, g_n, ev, ok, ls_info = yield from _wolfe_gen(
+            w, f, g, d, cfg)
         evals += ev
         if not ok:
             ls_failed = True
+            # same classification as the fused driver (lbfgs.LS_STOP_*
+            # docs): noise floor iff no trial improved f beyond the
+            # carry dtype's resolution AND the last trial's first-order
+            # expected decrease was below it too
+            fail_phase, f_best, t_last, dg0 = ls_info
+            tol_f = 32 * eps * max(abs(f), 1.0)
+            if (f - f_best) <= tol_f and abs(dg0 * t_last) <= tol_f:
+                ls_reason = LS_STOP_NOISE_FLOOR
+            else:
+                ls_reason = int(fail_phase)
             break
         if not np.isfinite(f_n):
             aborted = True
@@ -281,7 +313,7 @@ def _lbfgs_gen(w0, config: LBFGSConfig, *, warm=None,
         converged=converged, ls_failed=ls_failed,
         aborted_non_finite=aborted, grad_norm=float(tvec.norm(g)),
         num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs),
-        final_f_smooth=f)
+        final_f_smooth=f, ls_stop_reason=ls_reason)
 
 
 def run_owlqn_host(
@@ -330,6 +362,8 @@ def run_owlqn_host(
     big_f = f + l1 * float(tvec.l1_norm(w))
     hist: List[float] = [big_f]
     converged = ls_failed = aborted = False
+    ls_reason = LS_STOP_NONE
+    eps = _carry_eps(w0)
     if not np.isfinite(big_f):
         aborted = True
 
@@ -372,6 +406,14 @@ def run_owlqn_host(
             # mirror the fused driver's flags: a budget exhausted ON a
             # non-finite trial also marks the abort
             aborted = not np.isfinite(big_f_n)
+            # same classification as the fused OWL-QN (lbfgs.LS_STOP_*)
+            tol_f = 32 * eps * max(abs(big_f), 1.0)
+            if np.isfinite(big_f_n) and \
+                    abs(big_f_n - big_f) <= tol_f and \
+                    abs(gain) <= tol_f:
+                ls_reason = LS_STOP_NOISE_FLOOR
+            else:
+                ls_reason = LS_STOP_ARMIJO
             break
         s = tvec.sub(w_n, w)
         y = tvec.sub(g_n, g)
@@ -400,7 +442,7 @@ def run_owlqn_host(
         aborted_non_finite=aborted,
         grad_norm=float(tvec.norm(pseudo_grad(w, g))),
         num_fn_evals=evals, final_g=g, final_pairs=tuple(pairs),
-        final_f_smooth=f)
+        final_f_smooth=f, ls_stop_reason=ls_reason)
 
 
 class HostLBFGSMultiResult(NamedTuple):
@@ -419,6 +461,7 @@ class HostLBFGSMultiResult(NamedTuple):
     grad_norm: np.ndarray
     num_fn_evals: np.ndarray
     eval_rounds: int
+    ls_stop_reason: np.ndarray = None  # (K,) lbfgs.LS_STOP_* codes
 
 
 def run_lbfgs_host_multi(
@@ -494,4 +537,6 @@ def run_lbfgs_host_multi(
             [r.aborted_non_finite for r in results]),
         grad_norm=np.asarray([r.grad_norm for r in results]),
         num_fn_evals=np.asarray([r.num_fn_evals for r in results]),
-        eval_rounds=rounds)
+        eval_rounds=rounds,
+        ls_stop_reason=np.asarray(
+            [r.ls_stop_reason for r in results]))

@@ -42,7 +42,7 @@ class TestBenchFallbackChain:
         emit ONE parseable JSON line with a degraded error marker and a
         real measurement (the driver parses exactly this)."""
         monkeypatch.setattr(bench, "_run_worker",
-                            lambda tag, extra_env=None: None)
+                            lambda tag, extra_env=None, timeout=None: None)
         monkeypatch.setattr(bench, "_find_replay", lambda: None)
         monkeypatch.setattr(bench, "_EMITTED", False)
         monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
@@ -176,7 +176,7 @@ class TestBenchFallbackChain:
         with open("BENCH_MANUAL_r99.json", "w") as f:
             f.write(json.dumps(rec) + "\n")
         monkeypatch.setattr(bench, "_run_worker",
-                            lambda tag, extra_env=None: None)
+                            lambda tag, extra_env=None, timeout=None: None)
         monkeypatch.setattr(bench, "_EMITTED", False)
         monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
         with pytest.raises(SystemExit) as exc:
@@ -426,7 +426,8 @@ class TestFallbackWatchdog:
             f"{os.path.join(REPO, 'bench.py')!r})\n"
             "b = importlib.util.module_from_spec(spec)\n"
             "spec.loader.exec_module(b)\n"
-            "b._run_worker = lambda tag, extra_env=None: None\n"
+            "b._run_worker = lambda tag, extra_env=None, timeout=None: "
+            "None\n"
             "b.RETRY_PAUSE_S = 0.0\n"
             "b.cpu_fallback = lambda reason: time.sleep(60)\n"
             "os.environ['BENCH_FALLBACK_BUDGET_S'] = '2'\n"
@@ -447,13 +448,14 @@ class TestFallbackWatchdog:
 class TestRetryLadder:
     def test_retry_uses_reduced_lean_shape(self, bench, monkeypatch,
                                            capsys):
-        """After a failed full-shape attempt, the retry must request
-        1/LADDER_DIVISOR rows with the ride-alongs off, and the banked
-        record must carry its scale label."""
+        """After a dead first (full-ladder) attempt, the retry must
+        request 1/LADDER_DIVISOR rows with the ride-alongs off and a
+        SHORT timeout, and the emitted record must carry its scale
+        label."""
         calls = []
 
-        def fake_worker(tag, extra_env=None):
-            calls.append((tag, extra_env))
+        def fake_worker(tag, extra_env=None, timeout=None):
+            calls.append((tag, extra_env, timeout))
             if tag == "first":
                 return None
             return {"value": 5.0, "unit": "iters/sec",
@@ -466,12 +468,14 @@ class TestRetryLadder:
         with pytest.raises(SystemExit) as exc:
             bench.main()
         assert exc.value.code == 0
-        assert calls[0] == ("first", None)
-        tag, env = calls[1]
+        assert calls[0] == ("first", None, None)
+        tag, env, timeout = calls[1]
         assert tag == "retry"
+        assert timeout == bench.RETRY_TIMEOUT_S
         assert env == {
             "BENCH_ROWS": str(bench.LADDER_MIN_ROWS
                               // bench.LADDER_DIVISOR),
+            "BENCH_BANK_PATH": "BENCH_MANUAL_roundend_retry.json",
             "BENCH_ALT_DTYPE": "0", "BENCH_LOSS_MODES": "0"}
         out = json.loads([ln for ln in
                           capsys.readouterr().out.splitlines()
@@ -479,11 +483,37 @@ class TestRetryLadder:
         assert out["bench_rows_scale"] == round(
             1.0 / bench.LADDER_DIVISOR, 4)
 
+    def test_retry_rescales_worker_reported_rows(self, bench,
+                                                 monkeypatch, capsys):
+        """A retry worker that itself laddered down (bench_rows in its
+        record) gets its scale recomputed against the ORIGINAL full
+        shape, not the retry's request."""
+        retry_rows = bench.LADDER_MIN_ROWS // bench.LADDER_DIVISOR
+
+        def fake_worker(tag, extra_env=None, timeout=None):
+            if tag == "first":
+                return None
+            return {"value": 5.0, "unit": "iters/sec", "platform": "tpu",
+                    "bench_rows": retry_rows // bench.LADDER_DIVISOR,
+                    "error": None}
+
+        monkeypatch.setattr(bench, "_run_worker", fake_worker)
+        monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+        monkeypatch.setattr(bench, "N_ROWS", bench.LADDER_MIN_ROWS)
+        monkeypatch.setattr(bench, "_EMITTED", False)
+        with pytest.raises(SystemExit):
+            bench.main()
+        out = json.loads([ln for ln in
+                          capsys.readouterr().out.splitlines()
+                          if ln.strip()][-1])
+        assert out["bench_rows_scale"] == round(
+            1.0 / bench.LADDER_DIVISOR ** 2, 4)
+
     def test_small_shapes_retry_unchanged(self, bench, monkeypatch):
         calls = []
 
-        def fake_worker(tag, extra_env=None):
-            calls.append((tag, extra_env))
+        def fake_worker(tag, extra_env=None, timeout=None):
+            calls.append((tag, extra_env, timeout))
             return None if tag == "first" else {
                 "value": 1.0, "unit": "iters/sec", "platform": "tpu",
                 "error": None}
@@ -495,4 +525,194 @@ class TestRetryLadder:
         monkeypatch.setattr(bench, "_EMITTED", False)
         with pytest.raises(SystemExit):
             bench.main()
-        assert calls[1] == ("retry", None)
+        assert calls[1] == ("retry", None, bench.RETRY_TIMEOUT_S)
+
+
+class TestClaimLadder:
+    """The worker-side small-first banking ladder (VERDICT r3 items
+    1-3): host rungs before fused rungs, every healthy record banked to
+    disk the moment it exists, AOT phase markers naming trace / compile
+    / execute, fused outranking host at the final emission."""
+
+    @pytest.fixture()
+    def tiny(self, bench, monkeypatch, tmp_path, cpu_devices):
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setattr(bench, "N_ROWS", 2048)
+        monkeypatch.setattr(bench, "N_FEATURES", 16)
+        monkeypatch.setattr(bench, "NUM_ITERS_TPU", 3)
+        monkeypatch.setattr(bench, "NUM_ITERS_CPU", 2)
+        monkeypatch.setattr(bench, "NUM_ITERS_HOST", 3)
+        monkeypatch.setattr(bench, "PARITY_ITERS", 2)
+        monkeypatch.setattr(bench, "LADDER_MIN_ROWS", 1024)
+        monkeypatch.setattr(bench, "LADDER_DIVISOR", 4)
+        return bench
+
+    def test_ladder_order_banks_and_ranks(self, tiny, cpu_devices):
+        """Rung order is host-lean, host-full, fused-lean, fused-full;
+        the bank file exists after the FIRST healthy rung; the final
+        record is the full-shape fused rung with the ladder summary and
+        the fused/host delta attached."""
+        bench = tiny
+        marks = []
+        out = bench.run_ladder(device=cpu_devices[0],
+                               mark=lambda s, b=None, **kv:
+                               marks.append(s),
+                               done=lambda s, **kv: None)
+        # order: oracle+data+host rungs at 512 then 2048, then fused
+        host_runs = [m for m in marks
+                     if m.startswith("host") and m.endswith("-run")]
+        assert host_runs == ["host-512r-run", "host-2048r-run"]
+        fused_compiles = [m for m in marks
+                         if m.startswith("fused") and
+                         m.endswith("-compile")]
+        assert fused_compiles == ["fused-512r-compile",
+                                  "fused-2048r-compile"]
+        assert marks.index("host-2048r-run") < marks.index(
+            "fused-512r-trace")
+        assert out["bench_driver"] == "fused"
+        assert out["bench_rows_scale"] == 1.0
+        assert out["parity"] == "ok"
+        assert set(out["ladder"]) == {"host-512", "host-2048",
+                                      "fused-512", "fused-2048"}
+        assert out["fused_vs_host_speedup"] > 0
+        assert out["trace_s"] is not None
+        assert out["first_execute_s"] is not None
+        # the bank file holds the same best record
+        rec = json.loads(open("BENCH_MANUAL_roundend.json").read())
+        assert rec["bench_driver"] == "fused"
+        assert rec["bench_rows_scale"] == 1.0
+
+    def test_fused_failure_leaves_host_record(self, tiny, cpu_devices,
+                                              monkeypatch):
+        """Every fused rung failing must still emit (and bank) the
+        best host record, with the failures named — the r3 lesson:
+        never leave a healthy claim empty-handed."""
+        bench = tiny
+
+        def boom(*a, **k):
+            raise RuntimeError("mosaic refused")
+
+        monkeypatch.setattr(bench, "bench_fused_rung", boom)
+        out = bench.run_ladder(device=cpu_devices[0],
+                               mark=lambda s, b=None, **kv: None,
+                               done=lambda s, **kv: None)
+        assert out["bench_driver"] == "host"
+        assert out["bench_rows_scale"] == 1.0
+        assert out["parity"] == "ok"
+        assert set(out["rungs_failed"]) == {"fused-512", "fused-2048"}
+        assert "mosaic refused" in out["rungs_failed"]["fused-2048"]
+        rec = json.loads(open("BENCH_MANUAL_roundend.json").read())
+        assert rec["bench_driver"] == "host"
+
+    def test_parity_failure_poisons_fused_rung(self, tiny, cpu_devices,
+                                               monkeypatch):
+        """A fused rung whose highest-precision parity gate FAILS must
+        drop out of the ranking (banked best falls back) but stay in
+        the failure log."""
+        bench = tiny
+
+        def bad_parity(*a, **k):
+            raise AssertionError("trajectories diverged")
+
+        monkeypatch.setattr(bench, "check_parity", bad_parity)
+        out = bench.run_ladder(device=cpu_devices[0],
+                               mark=lambda s, b=None, **kv: None,
+                               done=lambda s, **kv: None)
+        assert out["bench_driver"] == "host"
+        assert "fused-2048-parity" in out["rungs_failed"]
+
+    def test_all_rungs_failing_raises(self, tiny, cpu_devices,
+                                      monkeypatch):
+        bench = tiny
+
+        def boom(*a, **k):
+            raise RuntimeError("nope")
+
+        monkeypatch.setattr(bench, "bench_fused_rung", boom)
+        monkeypatch.setattr(bench, "bench_host", boom)
+        with pytest.raises(bench.BackendError):
+            bench.run_ladder(device=cpu_devices[0],
+                             mark=lambda s, b=None, **kv: None,
+                             done=lambda s, **kv: None)
+
+    def test_poisoned_only_rung_poisons_the_bank(self, tiny,
+                                                 cpu_devices,
+                                                 monkeypatch):
+        """When the ONLY banked rung is later invalidated (parity
+        failed) and nothing healthy remains, the on-disk bank must be
+        rewritten WITH the error — a stale error=None bank would be
+        replayed as a healthy measurement."""
+        bench = tiny
+
+        def boom(*a, **k):
+            raise RuntimeError("no host rung")
+
+        def bad_parity(*a, **k):
+            raise AssertionError("trajectories diverged")
+
+        monkeypatch.setattr(bench, "bench_host", boom)
+        monkeypatch.setattr(bench, "check_parity", bad_parity)
+        with pytest.raises(bench.BackendError):
+            bench.run_ladder(device=cpu_devices[0],
+                             mark=lambda s, b=None, **kv: None,
+                             done=lambda s, **kv: None)
+        rec = json.loads(open("BENCH_MANUAL_roundend.json").read())
+        assert rec["error"] and "parity failed" in rec["error"]
+
+    def test_emits_higher_ranked_bank_over_live_result(self, bench,
+                                                       monkeypatch,
+                                                       tmp_path,
+                                                       capsys):
+        """A live retry that only reached a host-lean rung must yield
+        to a higher-ranked banked record from the dead first attempt."""
+        import time as _time
+
+        monkeypatch.chdir(tmp_path)
+        with open("BENCH_MANUAL_roundend.json", "w") as f:
+            f.write(json.dumps({
+                "platform": "tpu", "value": 80.0, "error": None,
+                "unit": "iters/sec", "bench_driver": "fused",
+                "bench_rows_scale": 0.125,
+                "measured_at_unix": _time.time() - 60}) + "\n")
+
+        def fake_worker(tag, extra_env=None, timeout=None):
+            if tag == "first":
+                return None
+            return {"value": 7.0, "unit": "iters/sec",
+                    "platform": "tpu", "error": None,
+                    "bench_driver": "host", "bench_rows_scale": 0.125}
+
+        monkeypatch.setattr(bench, "_run_worker", fake_worker)
+        monkeypatch.setattr(bench, "RETRY_PAUSE_S", 0.0)
+        monkeypatch.setattr(bench, "N_ROWS", bench.LADDER_MIN_ROWS)
+        monkeypatch.setattr(bench, "_EMITTED", False)
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+        assert exc.value.code == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["value"] == 80.0
+        assert out["replayed_from"] == "BENCH_MANUAL_roundend.json"
+        assert "outranks" in out["replay_reason"]
+
+    def test_replay_prefers_fused_over_fresher_host(self, bench,
+                                                    monkeypatch,
+                                                    tmp_path):
+        """A dead worker's banked host-lean rung must not shadow an
+        older same-session full fused record from the watcher."""
+        import time as _time
+
+        monkeypatch.chdir(tmp_path)
+        now = _time.time()
+        with open("BENCH_MANUAL_watch.json", "w") as f:
+            f.write(json.dumps({
+                "platform": "tpu", "value": 100.0, "error": None,
+                "bench_driver": "fused", "bench_rows_scale": 1.0,
+                "measured_at_unix": now - 3600}) + "\n")
+        with open("BENCH_MANUAL_roundend.json", "w") as f:
+            f.write(json.dumps({
+                "platform": "tpu", "value": 7.0, "error": None,
+                "bench_driver": "host", "bench_rows_scale": 0.125,
+                "measured_at_unix": now - 10}) + "\n")
+        ts, path, rec = bench._find_replay()
+        assert path == "BENCH_MANUAL_watch.json"
+        assert rec["value"] == 100.0

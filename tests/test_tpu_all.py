@@ -61,6 +61,32 @@ def test_pallas_ride_along_skips_oracle(cpu_ok, tmp_path, monkeypatch,
     assert recs[1]["agd_vs_gd_iters"] is None  # oracle skipped
 
 
+def test_bench_stage_runs_shared_ladder(cpu_ok, tmp_path, monkeypatch,
+                                        cpu_devices):
+    """The bench stage delegates to bench.run_ladder with this driver's
+    probe hooks and banks the best record into the cycle artifact."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("BENCH_ROWS", "1024")
+    monkeypatch.setenv("BENCH_FEATURES", "16")
+    monkeypatch.setenv("BENCH_ITERS_TPU", "2")
+    monkeypatch.setenv("BENCH_ITERS_CPU", "2")
+    monkeypatch.setenv("BENCH_ITERS_HOST", "2")
+    monkeypatch.setenv("BENCH_PARITY_ITERS", "2")
+    # drop the module-cached bench so the env shapes take effect
+    monkeypatch.delitem(sys.modules, "bench", raising=False)
+    monkeypatch.setattr(tpu_all, "PROBE_RNG_SHAPE", (256, 64))
+    rc = tpu_all.main(["--tag", "lb", "--skip-checks", "--skip-configs"])
+    assert rc == 0
+    rec = json.loads(open("BENCH_MANUAL_lb.json").read())
+    assert rec["unit"] == "iters/sec"
+    assert rec["value"] > 0
+    assert rec["bench_driver"] in ("fused", "host")
+    assert "ladder" in rec
+    # rehearsal backend is the CPU mesh; a real claim writes tpu here
+    assert rec["platform"] == "cpu"
+    tpu_all._WD["deadline"] = None
+
+
 def test_garbage_configs_fail_before_stages(cpu_ok):
     with pytest.raises(SystemExit) as exc:
         tpu_all.main(["--configs", "1,oops"])

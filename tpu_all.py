@@ -318,12 +318,9 @@ def main(argv=None):
                    help="seconds the watchdog allows jax.devices() "
                         "(observed queue: ~25 min then UNAVAILABLE)")
     p.add_argument("--probe-budget", type=float, default=420)
-    p.add_argument("--bench-budget", type=float, default=2700,
-                   help="covers headline + pallas + parity + the alt-"
-                        "dtype and loss-mode ride-alongs (each a full "
-                        "compile): ~6 compiles at the observed worst-"
-                        "case ~5 min/compile must fit, else the watchdog "
-                        "discards already-measured results")
+    # (no --bench-budget any more: the shared ladder arms its own
+    # per-phase budgets through the probe hooks — a single opaque
+    # stage budget was exactly the r3 wedge's hiding place)
     p.add_argument("--checks-budget", type=float, default=1800)
     p.add_argument("--configs-budget", type=float, default=1200,
                    help="per-config budget (each config re-arms it)")
@@ -405,58 +402,38 @@ def main(argv=None):
     if not args.skip_bench:
         import bench
 
-        # Shape ladder (added after r3 cycle 1: the first healthy claim
-        # wedged >45 min in the FULL-shape fused compile/execute and the
-        # watchdog's kill discarded everything).  Rung 1 measures at 1/8
-        # rows with the ride-alongs off and WRITES its record to disk;
-        # only then does rung 2 risk the full shape (ride-alongs on) and
-        # overwrite with the better record on success.  A full-shape
-        # wedge now costs the cycle but keeps a real measured-TPU
-        # artifact, which --reuse-artifacts honors next cycle.
-        full_rows = bench.N_ROWS
-        # operator overrides still win for the full rung (the old
-        # setdefault semantics); the small banking rung always runs
-        # lean — its job is a fast record on disk, not coverage
+        # The shared claim-conversion ladder (bench.run_ladder, module
+        # docstring there): host rungs first (the proven simple-program
+        # class), then fused lean, then fused full — every healthy rung
+        # banked straight into this cycle's artifact file as it lands,
+        # with AOT trace/compile/execute phase markers arming THIS
+        # process's watchdog through the probe hooks.  A wedge kills the
+        # cycle but the banked artifact survives, and --reuse-artifacts
+        # honors it next cycle.
+        stage("bench ladder")
         prior_env = {k: os.environ.get(k)
                      for k in ("BENCH_ALT_DTYPE", "BENCH_LOSS_MODES")}
-        full_flags = {k: (v if v is not None else "1")
-                      for k, v in prior_env.items()}
-        rungs = [(full_rows, args.bench_budget, full_flags)]
-        if full_rows >= bench.LADDER_MIN_ROWS:
-            rungs.insert(0, (full_rows // bench.LADDER_DIVISOR, 900,
-                             dict.fromkeys(prior_env, "0")))
-        banked = None
+        os.environ.update({k: (v if v is not None else "1")
+                           for k, v in prior_env.items()})
+        bank = f"BENCH_MANUAL_{args.tag}.json"
         try:
-            for rows, budget, flags in rungs:
-                stage(f"bench rows={rows}", budget)
-                bench.N_ROWS = rows
-                os.environ.update(flags)
-                try:
-                    out = bench.run_bench()
-                except Exception as e:  # noqa: BLE001 — later stages run
-                    log(f"bench rows={rows} failed: "
-                        f"{type(e).__name__}: {e}")
-                    failures += 1  # a rung that cannot measure is a
-                    # failure even when a smaller rung banked a record
-                    # (module contract: exit 0 == all stages healthy)
-                    if banked is not None:
-                        # keep the banked record but name the miss so
-                        # the artifact itself says the full shape is
-                        # unmeasured (artifact_ok still accepts it)
-                        banked["full_shape_error"] = (
-                            f"{type(e).__name__}: {e}"[:300])
-                        out = banked
-                    else:
-                        out = bench._error_json(
-                            f"{type(e).__name__}: {e}")
-                else:
-                    out["bench_rows_scale"] = round(rows / full_rows, 4)
-                    if not out.get("error"):
-                        banked = out
-                with open(f"BENCH_MANUAL_{args.tag}.json", "w") as f:
-                    f.write(json.dumps(out) + "\n")
+            out = bench.run_ladder(device=d, mark=probe.inflight,
+                                   done=probe.done, bank_path=bank)
+            n_rung_fail = len(out.get("rungs_failed", {}))
+            if n_rung_fail:
+                log(f"bench ladder: {n_rung_fail} rung(s) failed "
+                    f"({sorted(out['rungs_failed'])}); best banked "
+                    f"record kept")
+                failures += n_rung_fail  # exit 0 == every rung healthy
+        except Exception as e:  # noqa: BLE001 — no rung measured; leave
+            # an error artifact so the retry loop re-runs the stage
+            log(f"bench ladder produced no record: "
+                f"{type(e).__name__}: {e}")
+            failures += 1
+            with open(bank, "w") as f:
+                f.write(json.dumps(bench._error_json(
+                    f"{type(e).__name__}: {e}")) + "\n")
         finally:
-            bench.N_ROWS = full_rows
             for k, v in prior_env.items():
                 if v is None:
                     os.environ.pop(k, None)

@@ -23,6 +23,13 @@ import time
 import numpy as np
 
 
+def _lbfgs_reason_name(res):
+    """Artifact-friendly name for a result's ``ls_stop_reason``."""
+    from spark_agd_tpu.core import lbfgs as lbfgs_core
+
+    return lbfgs_core.ls_stop_reason_name(res.ls_stop_reason)
+
+
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
@@ -327,14 +334,15 @@ def main(argv=None):
     print(json.dumps({
         "check": "lbfgs_fused_on_chip",
         "rows": sw_n, "d": sw_d, "iters": lk,
-        "compile_s": round(lb_compile - lb_s, 1),
+        "compile_s": round(max(0.0, lb_compile - lb_s), 1),
         "iters_per_sec": round(lk / lb_s, 2) if lk else None,
         "fn_evals": int(lr.num_fn_evals),
         "final_loss": float(lb_hist[lk]),
         "agd_final_loss": ref_loss,
         "iters_to_match_agd": (int(hits[0]) + 1 if len(hits)
                                else None),
-        "ls_failed": bool(lr.ls_failed), "ok": bool(lb_ok)}),
+        "ls_failed": bool(lr.ls_failed),
+        "ls_stop_reason": _lbfgs_reason_name(lr), "ok": bool(lb_ok)}),
         flush=True)
     # the runner closures capture the prepared X inside their jitted
     # smooths — dropping them is what actually frees the 512 MiB dataset
