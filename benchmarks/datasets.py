@@ -25,13 +25,19 @@ from spark_agd_tpu.ops.sparse import CSRMatrix
 
 
 def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
-                    seed: int):
-    """Random CSR with exactly nnz_per_row entries/row and labels from a
-    planted sparse logistic model, generated on device."""
+                    seed: int, varied_nnz: bool = False):
+    """Random CSR with labels from a planted sparse logistic model,
+    generated on device.  ``varied_nnz=False`` (default, the shape every
+    committed trajectory was measured on): exactly nnz_per_row
+    entries/row.  ``varied_nnz=True``: long-tailed log-normal per-row
+    counts around the same mean (``device_synth.
+    planted_sparse_parts_varied``) — the documented-distribution twin
+    the scale-1.0 provenance rows use."""
+    gen = (synth.planted_sparse_parts_varied if varied_nnz
+           else synth.planted_sparse_parts)
     row_ids, col_ids, values, y = jax.jit(
-        synth.planted_sparse_parts,
-        static_argnums=(1, 2, 3))(jax.random.PRNGKey(seed), n_rows,
-                                  n_features, nnz_per_row)
+        gen, static_argnums=(1, 2, 3))(jax.random.PRNGKey(seed), n_rows,
+                                       n_features, nnz_per_row)
     # rows are sorted by construction; carry the column-sorted twin so the
     # gradient path runs sorted segment-sums on TPU (ops.sparse docstring).
     # Lazy: Gradient.prepare / shard_csr_batch materializes it at
@@ -41,14 +47,16 @@ def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
     return X, y
 
 
-def rcv1_like(scale: float = 1.0, seed: int = 0):
+def rcv1_like(scale: float = 1.0, seed: int = 0,
+              varied_nnz: bool = False):
     n = max(1024, int(697_641 * scale))
-    return _planted_sparse(n, 47_236, 74, seed)
+    return _planted_sparse(n, 47_236, 74, seed, varied_nnz)
 
 
-def url_like(scale: float = 1.0, seed: int = 1):
+def url_like(scale: float = 1.0, seed: int = 1,
+             varied_nnz: bool = False):
     n = max(1024, int(2_396_130 * scale))
-    return _planted_sparse(n, 3_231_961, 116, seed)
+    return _planted_sparse(n, 3_231_961, 116, seed, varied_nnz)
 
 
 def dense_linreg(scale: float = 1.0, seed: int = 2):

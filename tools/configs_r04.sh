@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 CPU config-artifact producer (VERDICT r3 items 5-7):
+#   - all five BASELINE configs at the r03 rehearsal scale (0.02) with
+#     the GD oracle ESCALATED past its old 8x cap so agd_vs_gd_iters is
+#     measured, not saturated (sparse configs get a deep budget; dense
+#     ones a bounded 128x — on this 1-core host a deeper dense oracle
+#     would cost hours for no extra decision value);
+#   - one scale-1.0 rcv1-twin row with full provenance fields
+#     (long-tailed nnz histogram + checksum);
+#   - wall-to-eps rows from runs with converged: true (tol=1e-4).
+# CPU-forced exactly like tools/tpu_watch.sh's seeding pattern: unset
+# the tunnel trigger so these processes can never queue a TPU claim
+# behind the watcher's.
+set -u
+cd /root/repo || exit 1
+OUT=BENCH_CONFIGS_CPU_r04.json
+RUN="env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m benchmarks.run"
+: > "$OUT"
+log() { echo "=== $(date -u +%H:%M:%S) $*"; }
+
+log "config 1+3 (sparse): deep gd escalation"
+for c in 1 3; do
+  $RUN --config $c --scale 0.02 --iters 20 --gd-cap 160 \
+       --gd-cap-max 40960 --dtype f32,bf16 --lbfgs --out "$OUT"
+done
+log "config 2,4,5 (dense): bounded gd escalation"
+for c in 2 4 5; do
+  $RUN --config $c --scale 0.02 --iters 20 --gd-cap 160 \
+       --gd-cap-max 2560 --dtype f32,bf16 --lbfgs --pallas-extra \
+       --out "$OUT"
+done
+log "scale-1.0 rcv1 provenance row"
+$RUN --config 1 --scale 1.0 --iters 10 --provenance --out "$OUT"
+log "converged wall-to-eps rows"
+$RUN --config 1 --scale 0.02 --iters 4000 --tol 1e-4 --out "$OUT"
+$RUN --config 2 --scale 0.02 --iters 2000 --tol 1e-4 --out "$OUT"
+$RUN --config 5 --scale 0.02 --iters 2000 --tol 1e-4 --out "$OUT"
+log "done"
