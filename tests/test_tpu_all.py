@@ -61,20 +61,26 @@ def test_pallas_ride_along_skips_oracle(cpu_ok, tmp_path, monkeypatch,
     assert recs[1]["agd_vs_gd_iters"] is None  # oracle skipped
 
 
-def test_bench_stage_runs_shared_ladder(cpu_ok, tmp_path, monkeypatch,
-                                        cpu_devices):
-    """The bench stage delegates to bench.run_ladder with this driver's
-    probe hooks and banks the best record into the cycle artifact."""
+@pytest.fixture()
+def small_ladder(tmp_path, monkeypatch):
+    """Shrink the bench ladder to test shapes: tiny rows/iters via the
+    BENCH_* env knobs, a fresh bench module so they take effect, and a
+    small probe RNG shape."""
     monkeypatch.chdir(tmp_path)
-    monkeypatch.setenv("BENCH_ROWS", "1024")
-    monkeypatch.setenv("BENCH_FEATURES", "16")
-    monkeypatch.setenv("BENCH_ITERS_TPU", "2")
-    monkeypatch.setenv("BENCH_ITERS_CPU", "2")
-    monkeypatch.setenv("BENCH_ITERS_HOST", "2")
-    monkeypatch.setenv("BENCH_PARITY_ITERS", "2")
+    for k, v in {"BENCH_ROWS": "1024", "BENCH_FEATURES": "16",
+                 "BENCH_ITERS_TPU": "2", "BENCH_ITERS_CPU": "2",
+                 "BENCH_ITERS_HOST": "2",
+                 "BENCH_PARITY_ITERS": "2"}.items():
+        monkeypatch.setenv(k, v)
     # drop the module-cached bench so the env shapes take effect
     monkeypatch.delitem(sys.modules, "bench", raising=False)
     monkeypatch.setattr(tpu_all, "PROBE_RNG_SHAPE", (256, 64))
+
+
+def test_bench_stage_runs_shared_ladder(cpu_ok, small_ladder,
+                                        cpu_devices):
+    """The bench stage delegates to bench.run_ladder with this driver's
+    probe hooks and banks the best record into the cycle artifact."""
     rc = tpu_all.main(["--tag", "lb", "--skip-checks", "--skip-configs"])
     assert rc == 0
     rec = json.loads(open("BENCH_MANUAL_lb.json").read())
@@ -84,6 +90,23 @@ def test_bench_stage_runs_shared_ladder(cpu_ok, tmp_path, monkeypatch,
     assert "ladder" in rec
     # rehearsal backend is the CPU mesh; a real claim writes tpu here
     assert rec["platform"] == "cpu"
+    tpu_all._WD["deadline"] = None
+
+
+def test_wedge_capable_probes_run_after_bench_banks(cpu_ok,
+                                                    small_ladder,
+                                                    capsys,
+                                                    cpu_devices):
+    """r3 lesson at the probe level: the fused-small and H2D probes can
+    themselves wedge a healthy claim, so they must run only AFTER the
+    bench ladder has banked real records."""
+    rc = tpu_all.main(["--tag", "lo", "--skip-checks", "--skip-configs"])
+    assert rc == 0
+    stages = [json.loads(ln)["stage"] for ln in
+              capsys.readouterr().out.splitlines()
+              if ln.strip().startswith("{") and "stage" in ln]
+    assert stages.index("bench done") < stages.index("fused-small-trace")
+    assert stages.index("fused-small-trace") < stages.index("h2d-1mib")
     tpu_all._WD["deadline"] = None
 
 
