@@ -69,6 +69,20 @@ class TestEvidenceModes:
         assert rec["iters"] < 600  # stopped by its own rule, not cap
         assert rec["wall_to_eps_s"] > 0
 
+    def test_lbfgs_tol_row_converges_too(self):
+        """--tol reaches the quasi-Newton ride-along as well: both
+        Optimizer-family members report converged wall-to-eps."""
+        cfg = bench_run.CONFIGS[0]
+        rec = bench_run.run_config(cfg, 2e-4, iters=600,
+                                   convergence_tol=1e-4, lbfgs=True)
+        assert rec["converged"] is True
+        assert rec["lbfgs_converged"] is True
+        assert rec["lbfgs_wall_to_eps_s"] > 0
+        assert rec["lbfgs_ls_stop_reason"] == "none"
+        # full-budget-only field omitted in tol mode (its "never
+        # matched" meaning would be conflated with early stopping)
+        assert "lbfgs_iters_to_match_agd" not in rec
+
     def test_provenance_fields_sparse(self):
         cfg = bench_run.CONFIGS[0]
         data = cfg.make_data(5e-4, varied_nnz=True)
