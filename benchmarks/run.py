@@ -194,10 +194,6 @@ def lbfgs_comparison(config: BenchConfig, data, w0, iters: int,
     run_s = time.perf_counter() - t0
     k = int(res.num_iters)
     hist = np.asarray(res.loss_history)
-    # hist[j] is the objective after j accepted iterations (j=0: at w0),
-    # directly comparable to the AGD history's f + reg accounting
-    hits = np.nonzero(hist[1:k + 1]
-                      <= agd_final_loss * (1 + 1e-6))[0]
     out = {
         "lbfgs_algorithm": fit.algorithm,
         "lbfgs_iters": k,
@@ -219,13 +215,21 @@ def lbfgs_comparison(config: BenchConfig, data, w0, iters: int,
         # meaningful only under the full iters budget: in --tol mode
         # L-BFGS stops by its own rule, so "never matched" and
         # "stopped early just above AGD's loss" would be conflated —
-        # the field is omitted there rather than silently re-defined
+        # the field is omitted there rather than silently re-defined.
+        # hist[j] is the objective after j accepted iterations (j=0:
+        # at w0), directly comparable to the AGD history's f + reg
+        # accounting.
+        hits = np.nonzero(hist[1:k + 1]
+                          <= agd_final_loss * (1 + 1e-6))[0]
         out["lbfgs_iters_to_match_agd"] = (int(hits[0]) + 1
                                            if len(hits) else None)
     if convergence_tol > 0 and k:
-        # same eps target as the AGD wall_to_eps_s in this record
-        out["lbfgs_wall_to_eps_s"] = round(
-            wall_to_eps(hist[1:k + 1], run_s / k, eps), 4)
+        # same eps target as the AGD wall_to_eps_s in this record;
+        # None (aborted non-finite run) passes through like the AGD
+        # field — round(None) would discard the divergence diagnostics
+        w2e = wall_to_eps(hist[1:k + 1], run_s / k, eps)
+        out["lbfgs_wall_to_eps_s"] = (None if w2e is None
+                                      else round(w2e, 4))
     return out
 
 

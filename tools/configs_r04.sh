@@ -24,7 +24,10 @@ RUN="env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m benchmarks.run"
 log() { echo "=== $(date -u +%H:%M:%S) $*"; }
 
 # has <config> <key> [extra-key] — true when OUT already holds a
-# healthy row for that config carrying the key(s)
+# healthy row for that config with NON-NULL value(s) for the key(s)
+# (key presence alone is wrong: e.g. agd_vs_gd_iters exists as null on
+# every row whose GD oracle didn't run, which silently skipped the
+# escalation stages on the first v2 run)
 has() {
   python - "$@" <<'EOF'
 import json, os, sys
@@ -34,7 +37,7 @@ try:
     for ln in open(os.environ["OUT"]):
         r = json.loads(ln)
         if (r.get("config") == cfg and not r.get("error")
-                and all(k in r for k in keys)):
+                and all(r.get(k) is not None for k in keys)):
             ok = True
 except OSError:
     pass
@@ -50,10 +53,14 @@ fi
 
 for spec in "1 4000" "2 2000" "5 2000"; do
   set -- $spec
-  if has "$1" convergence_tol; then log "tol row config $1 present; skip"
+  # both Optimizer-family members must report converged wall-to-eps
+  # (VERDICT r3 item 7), so the guard requires the lbfgs fields too
+  if has "$1" convergence_tol lbfgs_algorithm; then
+    log "tol row config $1 present; skip"
   else
     log "converged wall-to-eps row: config $1"
-    $RUN --config "$1" --scale 0.02 --iters "$2" --tol 1e-4 --out "$OUT"
+    $RUN --config "$1" --scale 0.02 --iters "$2" --tol 1e-4 --lbfgs \
+         --out "$OUT"
   fi
 done
 
@@ -61,9 +68,11 @@ for c in 2 4 5; do
   if has "$c" agd_vs_gd_iters; then log "config $c rows present; skip"
   else
     log "config $c (dense): bounded gd escalation"
+    # no --pallas-extra on the CPU backend: interpret-mode Pallas at
+    # these shapes is intractable (r3's CPU artifact has no pallas
+    # rows either); the fused-kernel ride-along is chip-claim work
     $RUN --config "$c" --scale 0.02 --iters 20 --gd-cap 160 \
-         --gd-cap-max 2560 --dtype f32,bf16 --lbfgs --pallas-extra \
-         --out "$OUT"
+         --gd-cap-max 2560 --dtype f32,bf16 --lbfgs --out "$OUT"
   fi
 done
 
