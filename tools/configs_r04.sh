@@ -54,8 +54,10 @@ fi
 for spec in "1 4000" "2 2000" "5 2000"; do
   set -- $spec
   # both Optimizer-family members must report converged wall-to-eps
-  # (VERDICT r3 item 7), so the guard requires the lbfgs fields too
-  if has "$1" convergence_tol lbfgs_algorithm; then
+  # (VERDICT r3 item 7), so the guard requires the lbfgs tol metric
+  # itself (lbfgs_algorithm alone would let a capped-without-metric
+  # row satisfy the guard forever — review finding)
+  if has "$1" convergence_tol lbfgs_wall_to_eps_s; then
     log "tol row config $1 present; skip"
   else
     log "converged wall-to-eps row: config $1"
