@@ -134,6 +134,12 @@ def _check_grid_fit(updater, reg_params, op_name: str):
 
 
 def _build_smooth(gradient, data, mesh, dist_mode):
+    """``(build, data_args)``: prepared/placed data as a pytree to pass
+    THROUGH ``jax.jit``, plus ``build(*traced) -> (smooth, smooth_loss)``
+    to call inside the trace.  Closing the jitted step over the concrete
+    arrays instead would embed them as program constants and make XLA
+    compile time scale with the dataset (the r4 ``compile_s: 1842.74``
+    full-scale row) — see ``core.smooth.make_smooth_staged``."""
     if mesh is None:
         if isinstance(data, mesh_lib.ShardedBatch):
             X, y, mask = data
@@ -143,16 +149,14 @@ def _build_smooth(gradient, data, mesh, dist_mode):
                 X = jnp.asarray(X)
             y = jnp.asarray(y)
             mask = None if mask is None else jnp.asarray(mask)
-        # One prepare() for BOTH factories — two separate calls would
-        # stage two full-size copies of a prepared layout (e.g. the
-        # Pallas tile padding) in HBM.
-        X, y, mask = gradient.prepare(X, y, mask)
-        return (smooth_lib.make_smooth(gradient, X, y, mask),
-                smooth_lib.make_smooth_loss(gradient, X, y, mask))
+        # One prepare() inside the staged factory — a second prepare
+        # would stage two full-size copies of a prepared layout (e.g.
+        # the Pallas tile padding) in HBM.
+        return smooth_lib.make_smooth_staged(gradient, X, y, mask)
     batch = (data if isinstance(data, mesh_lib.ShardedBatch)
              else mesh_lib.shard_batch(mesh, data[0], data[1], data[2]))
-    return dist_smooth.make_dist_smooth(gradient, batch, mesh=mesh,
-                                        mode=dist_mode)
+    return dist_smooth.make_dist_smooth_staged(gradient, batch, mesh=mesh,
+                                               mode=dist_mode)
 
 
 def make_runner(
@@ -181,21 +185,31 @@ def make_runner(
     ``jax.jit`` program; every ``fit`` after the first reuses it.
     """
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
-    sm, sl = _build_smooth(gradient, data, m, dist_mode)
+    build, dargs = _build_smooth(gradient, data, m, dist_mode)
     px, rv = smooth_lib.make_prox(updater, reg_param)
     cfg = agd.AGDConfig(
         convergence_tol=convergence_tol, num_iterations=num_iterations,
         l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
         may_restart=may_restart, loss_mode=loss_mode)
-    step = jax.jit(
-        lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl))
+
+    def _step(w, da):
+        sm, sl = build(*da)
+        return agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl)
+
+    step = jax.jit(_step)
+
+    def _place_w(initial_weights):
+        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        return w0 if m is None else mesh_lib.replicate(w0, m)
 
     def fit(initial_weights):
-        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-        if m is not None:
-            w0 = mesh_lib.replicate(w0, m)
-        return step(w0)
+        return step(_place_w(initial_weights), dargs)
 
+    # AOT hook: trace/inspect the ONE program fit() runs without
+    # executing it (phase-split compiles, HLO-level guards — e.g. the
+    # program-size-vs-nnz regression test; data rides as arguments, so
+    # the lowered text must NOT scale with the dataset)
+    fit.lower_step = lambda w0: step.lower(_place_w(w0), dargs)
     return fit
 
 
@@ -318,16 +332,18 @@ def make_sweep_runner(
 
     X, y, mask = _normalize_data(data)
     # the single-device branch of the shared builder: one prepare(), one
-    # staged copy (see _build_smooth's prepare-once invariant)
-    sm, sl = _build_smooth(gradient, (X, y, mask), None, "shard_map")
+    # staged copy (see _build_smooth's prepare-once invariant); the data
+    # rides as a lane-invariant vmap/jit argument, not a program constant
+    build, dargs = _build_smooth(gradient, (X, y, mask), None, "shard_map")
 
-    def fit_one(reg, w0, warm=None):
+    def fit_one(reg, w0, da, warm=None):
+        sm, sl = build(*da)
         px, rv = smooth_lib.make_prox(updater, reg)
         return agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl,
                            warm=warm)
 
-    step = jax.jit(jax.vmap(fit_one, in_axes=(0, None)))
-    step_warm = jax.jit(jax.vmap(fit_one, in_axes=(0, None, 0)))
+    step = jax.jit(jax.vmap(fit_one, in_axes=(0, None, None)))
+    step_warm = jax.jit(jax.vmap(fit_one, in_axes=(0, None, None, 0)))
 
     def fit(initial_weights, reg_params, warm=None):
         """``warm`` (optional): a BATCHED ``AGDWarmState`` — one carry
@@ -339,8 +355,8 @@ def make_sweep_runner(
             raise ValueError("reg_params must be 1-D")
         w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
         if warm is None:
-            return step(regs, w0)
-        return step_warm(regs, w0, warm)
+            return step(regs, w0, dargs)
+        return step_warm(regs, w0, dargs, warm)
 
     return fit
 
@@ -601,22 +617,25 @@ def _build_cv(data, gradient, updater, n_folds, convergence_tol,
                 "XLA gradients")
         fold_ids = _fold_assignment(n)
 
-        def fit_one(fold_k, reg, w0):
-            train_mask = base_mask * (fold_ids != fold_k)
-            val_mask = base_mask * (fold_ids == fold_k)
-            sm = lambda w: gradient.mean_loss_and_grad(w, X, y,
+        dargs = (X, y, base_mask, fold_ids)
+
+        def fit_one(fold_k, reg, w0, da):
+            Xa, ya, bm, fids = da
+            train_mask = bm * (fids != fold_k)
+            val_mask = bm * (fids == fold_k)
+            sm = lambda w: gradient.mean_loss_and_grad(w, Xa, ya,
                                                        train_mask)
-            sl = lambda w: _mean_loss(gradient, w, X, y, train_mask)
+            sl = lambda w: _mean_loss(gradient, w, Xa, ya, train_mask)
             px, rv = smooth_lib.make_prox(updater, reg)
             res = agd.run_agd(sm, px, rv, w0, cfg, smooth_loss=sl)
-            val = _mean_loss(gradient, res.weights, X, y, val_mask)
+            val = _mean_loss(gradient, res.weights, Xa, ya, val_mask)
             return val, res
 
-        step = jax.jit(jax.vmap(fit_one, in_axes=(0, 0, None)))
+        step = jax.jit(jax.vmap(fit_one, in_axes=(0, 0, None, None)))
 
         def run(fold_lane, reg_lane, initial_weights):
             w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-            return step(fold_lane, reg_lane, w0)
+            return step(fold_lane, reg_lane, w0, dargs)
 
     def fit(initial_weights, reg_params):
         if initial_weights is None:
@@ -951,8 +970,8 @@ def run_minibatch_sgd(
     y = jnp.asarray(y)
     mask = None if mask is None else jnp.asarray(mask)
     res = jax.jit(
-        lambda w: gd.run_minibatch_sgd(
-            gradient, updater, X, y, w, mask=mask, **kw))(w0)
+        lambda w, Xa, ya, ma: gd.run_minibatch_sgd(
+            gradient, updater, Xa, ya, w, mask=ma, **kw))(w0, X, y, mask)
     return res.weights, np.asarray(res.loss_history)
 
 
@@ -1000,28 +1019,32 @@ def make_lbfgs_runner(
             "AcceleratedGradientDescent")
     l1_coeff, extra = decomp
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
-    sm, _ = _build_smooth(gradient, data, m, dist_mode)
+    build, dargs = _build_smooth(gradient, data, m, dist_mode)
     cfg = lbfgs_lib.LBFGSConfig(
         num_corrections=num_corrections,
         convergence_tol=convergence_tol,
         num_iterations=num_iterations, grad_tol=grad_tol)
 
-    def objective(w):
-        f, g = sm(w)
-        pv, pg = extra(w)
-        return f + pv, tvec.add(g, pg)
+    def _objective(sm):
+        def objective(w):
+            f, g = sm(w)
+            pv, pg = extra(w)
+            return f + pv, tvec.add(g, pg)
+
+        return objective
 
     if l1_coeff > 0:
-        step = jax.jit(lambda w: lbfgs_lib.run_owlqn(objective, w,
-                                                     l1_coeff, cfg))
+        step = jax.jit(lambda w, da: lbfgs_lib.run_owlqn(
+            _objective(build(*da)[0]), w, l1_coeff, cfg))
     else:
-        step = jax.jit(lambda w: lbfgs_lib.run_lbfgs(objective, w, cfg))
+        step = jax.jit(lambda w, da: lbfgs_lib.run_lbfgs(
+            _objective(build(*da)[0]), w, cfg))
 
     def fit(initial_weights):
         w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
         if m is not None:
             w0 = mesh_lib.replicate(w0, m)
-        return step(w0)
+        return step(w0, dargs)
 
     # which driver the dispatch chose — reporting callers (benchmarks)
     # must label numbers with the REAL dispatch, not re-derive it
@@ -1203,9 +1226,11 @@ def make_lbfgs_sweep_runner(
         return fit
 
     X, y, mask = _normalize_data(data)
-    sm, _ = _build_smooth(gradient, (X, y, mask), None, "shard_map")
+    build, dargs = _build_smooth(gradient, (X, y, mask), None, "shard_map")
 
-    def fit_one(reg, w0):
+    def fit_one(reg, w0, da):
+        sm, _ = build(*da)
+
         def objective(w):
             f, g = sm(w)
             pv, pg = updater.smooth_penalty(w, reg)
@@ -1213,7 +1238,7 @@ def make_lbfgs_sweep_runner(
 
         return lbfgs_lib.run_lbfgs(objective, w0, cfg)
 
-    step = jax.jit(jax.vmap(fit_one, in_axes=(0, None)))
+    step = jax.jit(jax.vmap(fit_one, in_axes=(0, None, None)))
 
     def fit(initial_weights, reg_params):
         reg_params = _check_grid_fit(updater, reg_params,
@@ -1224,7 +1249,7 @@ def make_lbfgs_sweep_runner(
         if regs.ndim != 1:
             raise ValueError("reg_params must be 1-D")
         w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-        return step(regs, w0)
+        return step(regs, w0, dargs)
 
     return fit
 

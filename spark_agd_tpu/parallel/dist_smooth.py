@@ -62,6 +62,27 @@ def make_dist_smooth(
     ``smooth_loss`` is the loss-only evaluation for ``loss_mode='x'`` with
     ``beta >= 1``.
     """
+    build, args = make_dist_smooth_staged(
+        gradient, X, y, mask, mesh=mesh, mode=mode, data_axis=data_axis)
+    return build(*args)
+
+
+def make_dist_smooth_staged(
+    gradient: Gradient,
+    X,
+    y=None,
+    mask=None,
+    *,
+    mesh: Mesh,
+    mode: str = "shard_map",
+    data_axis: str = mesh_lib.DATA_AXIS,
+):
+    """``(build, data_args)`` split of :func:`make_dist_smooth` for jit
+    callers: ``data_args`` is the placed batch as a flat pytree to pass
+    through ``jax.jit``; ``build(*traced)`` runs inside the trace and
+    returns ``(smooth, smooth_loss)`` over the tracers.  Same rationale
+    as ``core.smooth.make_smooth_staged`` — data embedded as program
+    constants makes XLA compile time scale with the dataset."""
     if isinstance(X, mesh_lib.ShardedBatch):
         if y is not None or mask is not None:
             raise ValueError(
@@ -72,7 +93,11 @@ def make_dist_smooth(
     if not isinstance(X, (jax.Array, RowShardedCSR)) \
             or not isinstance(y, jax.Array):
         X, y, mask = mesh_lib.shard_batch(mesh, X, y, mask, axis=data_axis)
+    return _staged_builders(gradient, X, y, mask, mesh=mesh, mode=mode,
+                            data_axis=data_axis)
 
+
+def _staged_builders(gradient, X, y, mask, *, mesh, mode, data_axis):
     if isinstance(X, RowShardedCSR):
         if mode != "shard_map":
             raise ValueError(
@@ -95,15 +120,18 @@ def _finish(loss_sum, grad_sum, n):
 def _make_auto(gradient, X, y, mask):
     """GSPMD: global-array kernel; XLA partitions it from input shardings."""
 
-    def smooth(w):
-        ls, gs, n = gradient.batch_loss_and_grad(w, X, y, mask)
-        return _finish(ls, gs, n)
+    def build(Xa, ya, ma):
+        def smooth(w):
+            ls, gs, n = gradient.batch_loss_and_grad(w, Xa, ya, ma)
+            return _finish(ls, gs, n)
 
-    def smooth_loss(w):
-        ls, _, n = gradient.batch_loss_and_grad(w, X, y, mask)
-        return ls / jnp.asarray(n, ls.dtype)
+        def smooth_loss(w):
+            ls, _, n = gradient.batch_loss_and_grad(w, Xa, ya, ma)
+            return ls / jnp.asarray(n, ls.dtype)
 
-    return smooth, smooth_loss
+        return smooth, smooth_loss
+
+    return build, (X, y, mask)
 
 
 def _make_shard_map_pallas(gradient, X, y, mask, mesh, data_axis):
@@ -188,15 +216,18 @@ def _make_shard_map_pallas(gradient, X, y, mask, mesh, data_axis):
         n_tot = lax.psum(padded.n_valid, data_axis)
         return ls, gs, n_tot
 
-    def smooth(w):
-        ls, gs, n_tot = _eval(w, Xp, yp, mp)
-        return _finish(ls, gs, n_tot)
+    def build(Xa, ya, ma):
+        def smooth(w):
+            ls, gs, n_tot = _eval(w, Xa, ya, ma)
+            return _finish(ls, gs, n_tot)
 
-    def smooth_loss(w):
-        ls, _, n_tot = _eval(w, Xp, yp, mp)
-        return ls / jnp.asarray(n_tot, ls.dtype)
+        def smooth_loss(w):
+            ls, _, n_tot = _eval(w, Xa, ya, ma)
+            return ls / jnp.asarray(n_tot, ls.dtype)
 
-    return smooth, smooth_loss
+        return smooth, smooth_loss
+
+    return build, (Xp, yp, mp)
 
 
 def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
@@ -230,15 +261,18 @@ def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
 
     args = (X, y, mask) if has_mask else (X, y)
 
-    def smooth(w):
-        ls, gs, n = _eval(w, *args)
-        return _finish(ls, gs, n)
+    def build(*a):
+        def smooth(w):
+            ls, gs, n = _eval(w, *a)
+            return _finish(ls, gs, n)
 
-    def smooth_loss(w):
-        ls, _, n = _eval(w, *args)
-        return ls / jnp.asarray(n, ls.dtype)
+        def smooth_loss(w):
+            ls, _, n = _eval(w, *a)
+            return ls / jnp.asarray(n, ls.dtype)
 
-    return smooth, smooth_loss
+        return smooth, smooth_loss
+
+    return build, args
 
 
 def csr_shard_sums(gradient, X, y, mask, mesh, data_axis,
@@ -311,12 +345,15 @@ def _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis):
     _eval = csr_shard_sums(gradient, X, y, mask, mesh, data_axis)
     args = csr_shard_args(X, y, mask)
 
-    def smooth(w):
-        ls, gs, n = _eval(w, *args)
-        return _finish(ls, gs, n)
+    def build(*a):
+        def smooth(w):
+            ls, gs, n = _eval(w, *a)
+            return _finish(ls, gs, n)
 
-    def smooth_loss(w):
-        ls, _, n = _eval(w, *args)
-        return ls / jnp.asarray(n, ls.dtype)
+        def smooth_loss(w):
+            ls, _, n = _eval(w, *a)
+            return ls / jnp.asarray(n, ls.dtype)
 
-    return smooth, smooth_loss
+        return smooth, smooth_loss
+
+    return build, args

@@ -110,6 +110,46 @@ class TestCollectiveCount:
             f"x={n_x}")
         assert n_y <= n_x, f"y-mode must not cost more: y={n_y} x={n_x}"
 
+    def test_program_size_independent_of_nnz(self, cpu_devices):
+        """The r4 full-scale defect, pinned: closing the jitted step over
+        the data embedded it as program CONSTANTS, so the lowered module
+        — and XLA compile time — scaled with nnz (``compile_s: 1842.74``
+        on the scale-1.0 rcv1-twin row).  The staged split
+        (``make_smooth_staged``) passes data as jit arguments instead;
+        this guard lowers the PUBLIC runner's program at 4x-different
+        nnz and asserts the module text is nnz-invariant (and small)."""
+        from spark_agd_tpu import api
+        from spark_agd_tpu.ops.sparse import CSRMatrix
+
+        def csr_problem(n_rows, nnz_per_row, d=4096, seed=3):
+            rng = np.random.default_rng(seed)
+            indptr = np.arange(n_rows + 1) * nnz_per_row
+            indices = rng.integers(0, d, n_rows * nnz_per_row,
+                                   dtype=np.int32)
+            values = rng.standard_normal(
+                n_rows * nnz_per_row).astype(np.float32)
+            X = CSRMatrix.from_csr_arrays(indptr, indices, values, d,
+                                          with_csc=True)
+            y = (rng.random(n_rows) < 0.5).astype(np.float32)
+            return X, y
+
+        def lowered_len(n_rows):
+            X, y = csr_problem(n_rows, 16)
+            fit = api.make_runner(
+                (X, y, None), LogisticGradient(), L2Prox(),
+                reg_param=1e-4, num_iterations=10, convergence_tol=0.0)
+            return len(fit.lower_step(
+                jnp.zeros(X.shape[1], jnp.float32)).as_text())
+
+        small, big = lowered_len(2048), lowered_len(8192)
+        # identical up to shape-literal digits: a few % of slack, far
+        # below the ~4x growth constant embedding would cause
+        assert abs(big - small) <= 0.10 * small, (
+            f"lowered program size scaled with nnz: {small} -> {big} "
+            f"bytes — data is being embedded as program constants")
+        assert big < 4_000_000, (
+            f"lowered AGD program unexpectedly large: {big} bytes")
+
     def test_no_host_transfers_in_loop(self, dp_problem):
         """No outfeed/infeed/send/recv anywhere in the compiled loop —
         the fused program never talks to the host mid-run (the
