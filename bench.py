@@ -288,25 +288,20 @@ def make_data_host(seed=7, rows=None):
 
 
 def _make_step(gradient, Xd, yd, num_iterations, loss_mode="x"):
-    import jax
-
-    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    """The bench's fused step IS the public runner's program: built by
+    ``api.make_runner`` (data as jit ARGUMENTS — constant-embedded data
+    made XLA compile time scale with the dataset, the r4 compile_s:1843
+    row / the r3 on-chip compile wedge), re-exposed with the
+    closure-style ``step(w)`` + AOT ``lower/compile`` surface the
+    ladder's timing helpers consume."""
+    from spark_agd_tpu import api
     from spark_agd_tpu.ops.prox import L2Prox
 
-    # staged split: the data rides as jit ARGUMENTS (bound below), never
-    # as program constants — constant-embedded data made XLA compile
-    # time scale with the dataset (the r4 compile_s:1843 row / the r3
-    # on-chip compile wedge; core.smooth.make_smooth_staged docstring)
-    build, dargs = smooth_lib.make_smooth_staged(gradient, Xd, yd, None)
-    px, rv = smooth_lib.make_prox(L2Prox(), REG)
-    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=num_iterations,
-                        loss_mode=loss_mode)
-
-    def _step(w, da):
-        sm, sl = build(*da)
-        return agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl)
-
-    return _BoundStep(jax.jit(_step), dargs)
+    fit = api.make_runner((Xd, yd, None), gradient, L2Prox(),
+                          reg_param=REG, convergence_tol=0.0,
+                          num_iterations=num_iterations,
+                          loss_mode=loss_mode)
+    return _BoundStep(fit.jitted_step, fit.data_args)
 
 
 class _BoundStep:
@@ -330,9 +325,6 @@ class _BoundLowered:
     def __init__(self, lowered, dargs):
         self._lowered = lowered
         self._dargs = dargs
-
-    def as_text(self):
-        return self._lowered.as_text()
 
     def compile(self):
         return _BoundCompiled(self._lowered.compile(), self._dargs)
@@ -408,7 +400,10 @@ def bench_tpu(Xd, yd, w0, device):
     res, run_s, compile_s = _time_step(step, w0)
     iters = int(res.num_iters)
     hist = np.asarray(res.loss_history)[:iters]
-    stats = _roofline(res, run_s, device, itemsize=Xd.dtype.itemsize)
+    # rows come from the data itself, not the module default — ladder
+    # rungs pass reduced shapes (r4 advisor: no N_ROWS global swapping)
+    stats = _roofline(res, run_s, device, itemsize=Xd.dtype.itemsize,
+                      rows=Xd.shape[0])
     log(f"xla: compile={compile_s:.1f}s run={run_s * 1e3:.1f}ms "
         f"iters={iters} backtracks={int(res.num_backtracks)} "
         f"final_loss={hist[-1]:.6f} "
@@ -433,7 +428,8 @@ def bench_tpu_pallas(Xd, yd, w0, device):
         step = _make_step(PallasLogisticGradient(), Xd, yd, NUM_ITERS_TPU)
         res, run_s, compile_s = _time_step(step, w0)
         stats = _roofline(res, run_s, device, x_reads_per_pass=1,
-                          itemsize=Xd.dtype.itemsize)  # fused: one X read
+                          itemsize=Xd.dtype.itemsize,  # fused: one X read
+                          rows=Xd.shape[0])
         log(f"pallas: compile={compile_s:.1f}s run={run_s * 1e3:.1f}ms "
             f"iters={int(res.num_iters)} "
             f"hbm={stats['hbm_gbps']:.0f}GB/s "
@@ -792,55 +788,51 @@ def _ride_alongs(rec, rows, device, data_cache, mark, done):
     Xd32, yd = data_cache[rows]
     w0 = jnp.zeros(N_FEATURES, jnp.float32)
     Xd = Xd32.astype(jnp.bfloat16) if BENCH_DTYPE == "bf16" else Xd32
-    global N_ROWS
-    saved_rows = N_ROWS
-    N_ROWS = rows  # bench_tpu_pallas/_roofline default-shape callees
-    try:
-        mark("pallas-ride-along", 600)
-        pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
-        done("pallas-ride-along")
-        if pallas is not None:
-            rec["pallas_iters_per_sec"] = round(
-                pallas["iters_per_sec"], 2)
-            rec["pallas_hbm_bw_frac"] = (
-                None if pallas["hbm_bw_frac"] is None
-                else round(pallas["hbm_bw_frac"], 3))
-        else:
-            rec["pallas_iters_per_sec"] = None
-            rec["pallas_note"] = pallas_note
-        if os.environ.get("BENCH_ALT_DTYPE") == "1":
-            alt_dt = (jnp.float32 if BENCH_DTYPE == "bf16"
-                      else jnp.bfloat16)
-            alt_name = "f32" if BENCH_DTYPE == "bf16" else "bf16"
+    # callees read rows from the arrays they're handed (r4 advisor: the
+    # old N_ROWS global swap was fragile shared state)
+    mark("pallas-ride-along", 600)
+    pallas, pallas_note = bench_tpu_pallas(Xd, yd, w0, device)
+    done("pallas-ride-along")
+    if pallas is not None:
+        rec["pallas_iters_per_sec"] = round(
+            pallas["iters_per_sec"], 2)
+        rec["pallas_hbm_bw_frac"] = (
+            None if pallas["hbm_bw_frac"] is None
+            else round(pallas["hbm_bw_frac"], 3))
+    else:
+        rec["pallas_iters_per_sec"] = None
+        rec["pallas_note"] = pallas_note
+    if os.environ.get("BENCH_ALT_DTYPE") == "1":
+        alt_dt = (jnp.float32 if BENCH_DTYPE == "bf16"
+                  else jnp.bfloat16)
+        alt_name = "f32" if BENCH_DTYPE == "bf16" else "bf16"
+        try:
+            mark("alt-dtype-ride-along", 600)
+            alt, _, _ = bench_tpu(Xd32.astype(alt_dt), yd, w0, device)
+            done("alt-dtype-ride-along")
+            rec[f"{alt_name}_iters_per_sec"] = round(
+                alt["iters_per_sec"], 2)
+            rec[f"{alt_name}_hbm_bw_frac"] = (
+                None if alt["hbm_bw_frac"] is None
+                else round(alt["hbm_bw_frac"], 3))
+        except Exception as e:  # noqa: BLE001 — comparison only
+            done("alt-dtype-ride-along")
+            log(f"alt-dtype ride-along failed: "
+                f"{type(e).__name__}: {e}")
+    if os.environ.get("BENCH_LOSS_MODES") == "1":
+        from spark_agd_tpu.ops.losses import LogisticGradient
+        for lm in ("x_strict", "y"):
             try:
-                mark("alt-dtype-ride-along", 600)
-                alt, _, _ = bench_tpu(Xd32.astype(alt_dt), yd, w0, device)
-                done("alt-dtype-ride-along")
-                rec[f"{alt_name}_iters_per_sec"] = round(
-                    alt["iters_per_sec"], 2)
-                rec[f"{alt_name}_hbm_bw_frac"] = (
-                    None if alt["hbm_bw_frac"] is None
-                    else round(alt["hbm_bw_frac"], 3))
-            except Exception as e:  # noqa: BLE001 — comparison only
-                done("alt-dtype-ride-along")
-                log(f"alt-dtype ride-along failed: "
-                    f"{type(e).__name__}: {e}")
-        if os.environ.get("BENCH_LOSS_MODES") == "1":
-            from spark_agd_tpu.ops.losses import LogisticGradient
-            for lm in ("x_strict", "y"):
-                try:
-                    mark(f"loss-mode-{lm}", 600)
-                    step = _make_step(LogisticGradient(), Xd, yd,
-                                      NUM_ITERS_TPU, loss_mode=lm)
-                    res, run_s, _ = _time_step(step, w0)
-                    done(f"loss-mode-{lm}")
-                    rec[f"loss_mode_{lm}_iters_per_sec"] = round(
-                        int(res.num_iters) / run_s, 2)
-                except Exception as e:  # noqa: BLE001
-                    done(f"loss-mode-{lm}")
-                    log(f"loss_mode={lm} failed: {type(e).__name__}: {e}")
-    finally:
-        N_ROWS = saved_rows
+                mark(f"loss-mode-{lm}", 600)
+                step = _make_step(LogisticGradient(), Xd, yd,
+                                  NUM_ITERS_TPU, loss_mode=lm)
+                res, run_s, _ = _time_step(step, w0)
+                done(f"loss-mode-{lm}")
+                rec[f"loss_mode_{lm}_iters_per_sec"] = round(
+                    int(res.num_iters) / run_s, 2)
+            except Exception as e:  # noqa: BLE001
+                done(f"loss-mode-{lm}")
+                log(f"loss_mode={lm} failed: {type(e).__name__}: {e}")
 
 
 def _write_bank(path, best, records, failed):
@@ -963,16 +955,23 @@ def run_ladder(device=None, mark=None, done=None, bank_path=None):
         except Exception as e:  # noqa: BLE001
             log(f"ride-alongs failed: {type(e).__name__}: {e}")
         _rebank()
-    if best is not None and best["bench_driver"] == "host":
+    # a trajectory-divergent host rung must drop out of ranking exactly
+    # like a fused one (r4 advisor: parity_error-only records were still
+    # banked as the healthy headline); after a failure the NEXT-ranked
+    # host rung gets its own gate, hence the loop
+    parity_checked = set()
+    while best is not None and best["bench_driver"] == "host" \
+            and id(best) not in parity_checked:
+        parity_checked.add(id(best))
         try:
             host_parity(best["bench_rows"],
                         oracle_cache[best["bench_rows"]][1],
                         data_cache, mark, done)
             best["parity"] = "ok"
         except AssertionError as e:
-            best["parity_error"] = str(e)[:300]
-            log(f"host parity FAILED (record kept, flagged): "
-                f"{best['parity_error']}")
+            best["error"] = f"host parity failed: {e}"[:300]
+            failed[f"host-{best['bench_rows']}-parity"] = best["error"]
+            log("host parity FAILED — rung discarded from ranking")
         except Exception as e:  # noqa: BLE001
             best["parity"] = f"gate errored: {type(e).__name__}: {e}"[:200]
         _rebank()
@@ -1271,6 +1270,8 @@ def _find_replay():
             continue
         ts = rec.get("measured_at_unix")
         if (rec.get("platform") == "tpu" and not rec.get("error")
+                and not rec.get("parity_error")  # legacy pre-r5 bank
+                # files flagged divergence without setting error
                 and isinstance(ts, (int, float))
                 and 0 <= time.time() - ts <= max_age):
             key = (*_record_rank(rec), ts)

@@ -210,6 +210,11 @@ def make_runner(
     # program-size-vs-nnz regression test; data rides as arguments, so
     # the lowered text must NOT scale with the dataset)
     fit.lower_step = lambda w0: step.lower(_place_w(w0), dargs)
+    # the raw (jitted step, staged data) pair — the bench ladder binds
+    # these so its AOT phase-split timing measures EXACTLY the public
+    # runner's program, not a parallel reimplementation
+    fit.jitted_step = step
+    fit.data_args = dargs
     return fit
 
 

@@ -56,9 +56,44 @@ class TestEvidenceModes:
         cfg = bench_run.CONFIGS[0]
         data = cfg.make_data(2e-4)
         w0 = cfg.make_w0(data[0])
-        gd_iters, matched = bench_run.gd_iters_to_match(
+        gd_iters, matched, gd_hist = bench_run.gd_iters_to_match(
             cfg, data, w0, target_loss=1e-12, cap=3)
         assert (gd_iters, matched) == (3, False)
+        assert len(gd_hist) == 3
+        # the companion-target resolver reads the same history
+        easy_iters, easy_matched = bench_run.gd_hits_target(
+            gd_hist, float(gd_hist[-1]), len(gd_hist))
+        assert easy_matched and easy_iters <= 3
+
+    def test_capped_run_moves_wall_to_eps_to_capped_field(self):
+        """r4 weak #3: an iteration-capped run's wall-to-eps is a cap
+        artifact — the headline column must read null and the value
+        moves to the explicit capped field."""
+        cfg = bench_run.CONFIGS[0]
+        rec = bench_run.run_config(cfg, 2e-4, iters=4)
+        assert rec["converged"] is False
+        assert rec["wall_to_eps_s"] is None
+        assert rec["wall_to_eps_capped"] > 0
+
+    def test_gd_cap_row_carries_ref_budget_companion(self):
+        """r4 weak #5: the deep-cap ratio travels with the
+        reference-suite matched-budget companion and the oracle's
+        named schedule."""
+        cfg = bench_run.CONFIGS[0]
+        data = cfg.make_data(2e-4)
+        rec = bench_run.run_config(cfg, 2e-4, iters=4, gd_cap=2,
+                                   gd_cap_max=4096, data=data)
+        assert rec["agd_vs_gd_iters_ref_budget"] is not None
+        assert rec["agd_vs_gd_ref_budget_iters"] == 4  # min(10, iters)
+        assert "sqrt(iter)" in rec["gd_oracle_schedule"]
+
+    def test_cpu_bf16_row_carries_emulation_note(self):
+        """r4 weak #6: CPU bf16 is emulated; the row must say the dtype
+        comparison is only meaningful on TPU."""
+        cfg = bench_run.CONFIGS[0]
+        rec = bench_run.run_config(cfg, 2e-4, iters=4, dtype="bf16")
+        assert rec["platform"] == "cpu"
+        assert "emulated on cpu" in rec["dtype_note"]
 
     def test_converged_record_carries_flag_and_eps(self):
         cfg = bench_run.CONFIGS[0]

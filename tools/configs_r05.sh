@@ -1,0 +1,130 @@
+#!/bin/bash
+# Round-5 CPU config-artifact producer (VERDICT r4 items 3, 5, 8) —
+# unique evidence first so an interruption costs the least-valuable
+# rows:
+#   1. FULL-SCALE (scale 1.0) rows for ALL FIVE configs (r4 weak #4:
+#      only config 1 had one) with provenance and recorded compile_s —
+#      the compile-blowup regression evidence now that data rides as
+#      jit arguments (r4's scale-1.0 row compiled in 1842.74 s; r5
+#      target < 120 s).  Honest convergence semantics: these 10-iter
+#      runs report wall_to_eps_capped, never wall_to_eps_s (weak #3).
+#   2. converged wall-to-eps rows (tol=1e-4) for every config whose
+#      members can converge, both Optimizer-family members.
+#   3. escalating GD-oracle rows carrying BOTH ratios: the deep-cap
+#      number and the reference-suite matched-budget companion
+#      (agd_vs_gd_iters_ref_budget, weak #5), f32 + bf16 (CPU bf16
+#      rows carry dtype_note per weak #6).
+# Restart guards (r4 advisor #4): the escalation stages require
+# agd_vs_gd_is_lower_bound == false — a saturated lower-bound row no
+# longer satisfies the guard, EXCEPT config 3 (hinge+L1), whose oracle
+# never matches within any tractable cap on this 1-core host; its
+# documented lower bound is accepted explicitly via the presence
+# guard.  CPU-forced exactly like tools/tpu_watch.sh's seeding pattern
+# so these processes can never queue a TPU claim behind the watcher's.
+set -u
+cd /root/repo || exit 1
+OUT=BENCH_CONFIGS_CPU_r05.json
+export OUT
+RUN="env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m benchmarks.run"
+log() { echo "=== $(date -u +%H:%M:%S) $*"; }
+
+# has <config> <key> [more-keys] — true when OUT already holds a
+# healthy row for that config with NON-NULL value(s) for the key(s).
+has() {
+  python - "$@" <<'EOF'
+import json, os, sys
+cfg, keys = int(sys.argv[1]), sys.argv[2:]
+ok = False
+try:
+    for ln in open(os.environ["OUT"]):
+        r = json.loads(ln)
+        if (r.get("config") == cfg and not r.get("error")
+                and all(r.get(k) is not None for k in keys)):
+            ok = True
+except OSError:
+    pass
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# has_matched <config> — true when OUT holds a healthy escalation row
+# whose deep-cap ratio actually MATCHED (is_lower_bound false); a
+# saturated row must NOT satisfy the escalation guard (r4 advisor #4).
+has_matched() {
+  python - "$1" <<'EOF'
+import json, os, sys
+cfg = int(sys.argv[1])
+ok = False
+try:
+    for ln in open(os.environ["OUT"]):
+        r = json.loads(ln)
+        if (r.get("config") == cfg and not r.get("error")
+                and r.get("agd_vs_gd_iters") is not None
+                and r.get("agd_vs_gd_is_lower_bound") is False):
+            ok = True
+except OSError:
+    pass
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# ---- stage 1: full-scale rows, all five configs (f32, provenance) ----
+# scale-1.0 sizes on this 125 GB host: c1 rcv1 51.6M nnz CSR ~1.2 GB;
+# c2 dense 10M x 1k = 40 GB; c3 url-like ~278M nnz (padded ~3x mean
+# under the documented-distribution twin) ~20 GB; c4 8.1M x 784 = 25
+# GB; c5 1M x 1k = 4 GB.
+for c in 1 5 3 4 2; do  # cheapest first; the 40 GB dense config last
+  if has "$c" dataset_provenance; then log "full-scale row c$c present; skip"
+  else
+    log "full-scale (1.0) provenance row: config $c"
+    $RUN --config "$c" --scale 1.0 --iters 10 --provenance --out "$OUT"
+  fi
+done
+
+# ---- stage 2: converged wall-to-eps rows (both members) -------------
+for spec in "1 4000" "2 2000" "4 2000" "5 2000"; do
+  set -- $spec
+  # guard requires the lbfgs tol metric itself (non-null only when
+  # lbfgs_converged, post r5 honest split)
+  if has "$1" convergence_tol lbfgs_wall_to_eps_s; then
+    log "tol row config $1 present; skip"
+  else
+    log "converged wall-to-eps row: config $1"
+    $RUN --config "$1" --scale 0.02 --iters "$2" --tol 1e-4 --lbfgs \
+         --out "$OUT"
+  fi
+done
+# config 3 (hinge+L1): AGD runs OWL-QN-comparable subgradient steps;
+# its tol row converges on the AGD side only — guard on the AGD field.
+if has 3 convergence_tol wall_to_eps_s; then log "tol row config 3 present; skip"
+else
+  log "converged wall-to-eps row: config 3 (AGD member)"
+  $RUN --config 3 --scale 0.02 --iters 4000 --tol 1e-4 --lbfgs --out "$OUT"
+fi
+
+# ---- stage 3: escalating GD oracle, both ratios, f32+bf16 -----------
+for c in 2 4 5; do
+  if has_matched "$c"; then log "config $c matched escalation present; skip"
+  else
+    log "config $c (dense): bounded gd escalation"
+    $RUN --config "$c" --scale 0.02 --iters 20 --gd-cap 160 \
+         --gd-cap-max 2560 --dtype f32,bf16 --lbfgs --out "$OUT"
+  fi
+done
+if has_matched 1; then log "config 1 matched escalation present; skip"
+else
+  log "config 1 (sparse): deep gd escalation (cap 40960)"
+  $RUN --config 1 --scale 0.02 --iters 20 --gd-cap 160 \
+       --gd-cap-max 40960 --dtype f32,bf16 --lbfgs --out "$OUT"
+fi
+# config 3: hinge+L1 GD oracle cannot match within a tractable cap on
+# this host (r4 measured: still unmatched at 10240) — the saturated
+# ratio is an ACCEPTED, documented lower bound; presence guard only.
+if has 3 agd_vs_gd_iters; then
+  log "config 3 lower-bound escalation present; skip (accepted bound)"
+else
+  log "config 3 (sparse): bounded gd escalation (accepted lower bound)"
+  $RUN --config 3 --scale 0.02 --iters 20 --gd-cap 160 \
+       --gd-cap-max 10240 --dtype f32,bf16 --lbfgs --out "$OUT"
+fi
+log "done"
