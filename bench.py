@@ -287,6 +287,21 @@ def make_data_host(seed=7, rows=None):
     return np.asarray(Xh), np.asarray(yh)
 
 
+def _staged_smooth_jit(Xd, yd):
+    """The host driver's smooth as one jitted program with the data
+    staged as ARGUMENTS: returns ``(sm, dargs)`` where
+    ``sm(w, dargs) -> (mean_loss, mean_grad)``.  Shared by bench_host
+    and host_parity so the two can't drift (r5 review)."""
+    import jax
+
+    from spark_agd_tpu.core import smooth as smooth_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), Xd, yd, None)
+    return jax.jit(lambda w, da: build(*da)[0](w)), dargs
+
+
 def _make_step(gradient, Xd, yd, num_iterations, loss_mode="x"):
     """The bench's fused step IS the public runner's program: built by
     ``api.make_runner`` (data as jit ARGUMENTS — constant-embedded data
@@ -666,9 +681,7 @@ def bench_host(rows, device, cpu_ips, cpu_hist, mark, done, data_cache):
     # window; the prepared arrays then ride as jit ARGUMENTS (not
     # program constants — same staged split as _make_step)
     mark(f"{tag}-stage", 180)
-    build, dargs = smooth_lib.make_smooth_staged(
-        LogisticGradient(), Xd, yd, None)
-    sm = jax.jit(lambda w, da: build(*da)[0](w))
+    sm, dargs = _staged_smooth_jit(Xd, yd)
     done(f"{tag}-stage")
     # AOT-compile the one nontrivial program (the smooth kernel) with
     # split phase markers; prox/axpby are trivial elementwise kernels
@@ -730,9 +743,7 @@ def host_parity(rows, cpu_hist, data_cache, mark, done):
     px, rv = smooth_lib.make_prox(L2Prox(), REG)
     mark(f"host-{rows}r-parity", 420)
     with jax.default_matmul_precision("highest"):
-        build, dargs = smooth_lib.make_smooth_staged(
-            LogisticGradient(), Xd, yd, None)
-        smj = jax.jit(lambda w, da: build(*da)[0](w))
+        smj, dargs = _staged_smooth_jit(Xd, yd)
         res = host_agd.run_agd_host(
             lambda w: smj(w, dargs), jax.jit(px), jax.jit(rv), w0,
             agd_lib.AGDConfig(convergence_tol=0.0, num_iterations=k))

@@ -117,21 +117,35 @@ def _finish(loss_sum, grad_sum, n):
     return loss_sum / nf, tvec.scale(1.0 / nf, grad_sum)
 
 
-def _make_auto(gradient, X, y, mask):
-    """GSPMD: global-array kernel; XLA partitions it from input shardings."""
+def _pair_builder(eval_fn, args):
+    """The ONE ``(build, data_args)`` shape every mode returns:
+    ``build(*traced)`` closes the ``(smooth, smooth_loss)`` contract —
+    mean over valid rows, division after the reduction — over the
+    traced data, with ``eval_fn(w, *data) -> (Σloss, Σgrad, n)`` as the
+    only per-mode ingredient.  One definition so the contract cannot
+    drift between the four modes (r5 review)."""
 
-    def build(Xa, ya, ma):
+    def build(*a):
         def smooth(w):
-            ls, gs, n = gradient.batch_loss_and_grad(w, Xa, ya, ma)
+            ls, gs, n = eval_fn(w, *a)
             return _finish(ls, gs, n)
 
         def smooth_loss(w):
-            ls, _, n = gradient.batch_loss_and_grad(w, Xa, ya, ma)
+            ls, _, n = eval_fn(w, *a)
             return ls / jnp.asarray(n, ls.dtype)
 
         return smooth, smooth_loss
 
-    return build, (X, y, mask)
+    return build, args
+
+
+def _make_auto(gradient, X, y, mask):
+    """GSPMD: global-array kernel; XLA partitions it from input shardings."""
+
+    def _eval(w, Xa, ya, ma):
+        return gradient.batch_loss_and_grad(w, Xa, ya, ma)
+
+    return _pair_builder(_eval, (X, y, mask))
 
 
 def _make_shard_map_pallas(gradient, X, y, mask, mesh, data_axis):
@@ -216,18 +230,7 @@ def _make_shard_map_pallas(gradient, X, y, mask, mesh, data_axis):
         n_tot = lax.psum(padded.n_valid, data_axis)
         return ls, gs, n_tot
 
-    def build(Xa, ya, ma):
-        def smooth(w):
-            ls, gs, n_tot = _eval(w, Xa, ya, ma)
-            return _finish(ls, gs, n_tot)
-
-        def smooth_loss(w):
-            ls, _, n_tot = _eval(w, Xa, ya, ma)
-            return ls / jnp.asarray(n_tot, ls.dtype)
-
-        return smooth, smooth_loss
-
-    return build, (Xp, yp, mp)
+    return _pair_builder(_eval, (Xp, yp, mp))
 
 
 def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
@@ -259,20 +262,7 @@ def _make_shard_map(gradient, X, y, mask, mesh, data_axis):
         n = lax.psum(n, data_axis)
         return ls, gs, n
 
-    args = (X, y, mask) if has_mask else (X, y)
-
-    def build(*a):
-        def smooth(w):
-            ls, gs, n = _eval(w, *a)
-            return _finish(ls, gs, n)
-
-        def smooth_loss(w):
-            ls, _, n = _eval(w, *a)
-            return ls / jnp.asarray(n, ls.dtype)
-
-        return smooth, smooth_loss
-
-    return build, args
+    return _pair_builder(_eval, (X, y, mask) if has_mask else (X, y))
 
 
 def csr_shard_sums(gradient, X, y, mask, mesh, data_axis,
@@ -343,17 +333,4 @@ def _make_shard_map_csr(gradient, X, y, mask, mesh, data_axis):
     the (loss, grad, count) sums.
     """
     _eval = csr_shard_sums(gradient, X, y, mask, mesh, data_axis)
-    args = csr_shard_args(X, y, mask)
-
-    def build(*a):
-        def smooth(w):
-            ls, gs, n = _eval(w, *a)
-            return _finish(ls, gs, n)
-
-        def smooth_loss(w):
-            ls, _, n = _eval(w, *a)
-            return ls / jnp.asarray(n, ls.dtype)
-
-        return smooth, smooth_loss
-
-    return build, args
+    return _pair_builder(_eval, csr_shard_args(X, y, mask))

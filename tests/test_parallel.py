@@ -232,3 +232,110 @@ class TestDenseFeatureSharding:
         assert w_rec.shape == (d,)
         np.testing.assert_allclose(w_rec, np.asarray(rr.weights),
                                    rtol=1e-4, atol=1e-6)
+
+
+class TestNegativeControls:
+    """Mutation-style controls (VERDICT r4 item 6): the parity suites
+    above only ever see correct code, so nothing proves they CAN fail.
+    Each test injects a deliberate distributed bug through the real
+    code path and asserts the suite's own comparison trips — earning
+    the trust the reference's local-cluster test earns by running real
+    executors (``AcceleratedGradientDescentSuite.scala:242-260``)."""
+
+    class _DropShardZero(losses.LogisticGradient):
+        """A gradient that silently zeroes shard 0's (loss, grad)
+        contribution — visible only INSIDE the shard_map body, so the
+        single-device reference stays correct.  The count n is left
+        intact: the bug is a lost partial sum, not a lost shard."""
+
+        def batch_loss_and_grad(self, w, X, y, mask=None):
+            ls, gs, n = super().batch_loss_and_grad(w, X, y, mask)
+            try:
+                keep = (jax.lax.axis_index(mesh_lib.DATA_AXIS)
+                        != 0).astype(ls.dtype)
+            except Exception:  # no data axis bound: unmutated
+                keep = jnp.asarray(1.0, ls.dtype)
+            return (ls * keep,
+                    jax.tree_util.tree_map(lambda g: g * keep, gs), n)
+
+    def test_dropped_shard_psum_trips_smooth_parity(self, problem):
+        """The TestDistSmoothParity comparison must fail loudly when one
+        shard's psum contribution is dropped."""
+        X, y, w0 = problem
+        ref = smooth_lib.make_smooth(losses.LogisticGradient(),
+                                     jnp.asarray(X), jnp.asarray(y))
+        f_ref, g_ref = ref(jnp.asarray(w0))
+        m = mesh_lib.make_mesh({"data": 8})
+        sm, _ = dist_smooth.make_dist_smooth(
+            self._DropShardZero(), X, y, mesh=m, mode="shard_map")
+        f, g = jax.jit(sm)(jnp.asarray(w0))
+        with pytest.raises(AssertionError):
+            np.testing.assert_allclose(float(f), float(f_ref), rtol=1e-13)
+        # sanity: the same harness code path passes with the bug absent
+        sm_ok, _ = dist_smooth.make_dist_smooth(
+            losses.LogisticGradient(), X, y, mesh=m, mode="shard_map")
+        f_ok, _ = jax.jit(sm_ok)(jnp.asarray(w0))
+        np.testing.assert_allclose(float(f_ok), float(f_ref), rtol=1e-13)
+
+    def test_dropped_shard_psum_trips_fused_agd_parity(self, problem):
+        """The full fused-AGD mesh parity (TestFusedAGDOnMesh) must also
+        catch the dropped shard — the bug rides inside the compiled
+        while_loop, exactly where r2's finiteness-only checks would
+        have passed it."""
+        X, y, w0 = problem
+        px, rv = smooth_lib.make_prox(prox.MLlibSquaredL2Updater(), 0.1)
+        cfg = agd.AGDConfig(num_iterations=6, convergence_tol=0.0)
+        ref_sm = smooth_lib.make_smooth(losses.LogisticGradient(),
+                                        jnp.asarray(X), jnp.asarray(y))
+        r_ref = jax.jit(lambda w: agd.run_agd(ref_sm, px, rv, w, cfg))(
+            jnp.asarray(w0))
+        m = mesh_lib.make_mesh({"data": 8})
+        sm, sl = dist_smooth.make_dist_smooth(
+            self._DropShardZero(), X, y, mesh=m, mode="shard_map")
+        r = jax.jit(lambda w: agd.run_agd(sm, px, rv, w, cfg,
+                                          smooth_loss=sl))(
+            mesh_lib.replicate(jnp.asarray(w0), m))
+        n_it = min(int(r.num_iters), int(r_ref.num_iters))
+        with pytest.raises(AssertionError):
+            np.testing.assert_allclose(
+                np.asarray(r.loss_history)[:n_it],
+                np.asarray(r_ref.loss_history)[:n_it], rtol=1e-11)
+
+    def test_skewed_lane_reg_trips_sweep_parity(self, problem):
+        """A single-lane penalty skew inside the mesh sweep must trip
+        exactly that lane's parity check and no other."""
+        from spark_agd_tpu import api
+
+        X, y, w0 = problem
+        X32 = X.astype(np.float32)
+        y32 = y.astype(np.float32)
+        w032 = np.zeros_like(w0, dtype=np.float32)
+        regs = [0.01, 0.5]
+
+        class _SkewLaneReg(prox.L2Prox):
+            """Perturbs the prox output only where reg == regs[1] —
+            lane 1's trajectory diverges, lane 0's must not."""
+
+            def prox(self, w, g, step, reg):
+                out = super().prox(w, g, step, reg)
+                skew = jnp.where(jnp.asarray(reg) == regs[1], 1e-2, 0.0)
+                return jax.tree_util.tree_map(lambda o: o + skew, out)
+
+        m = mesh_lib.make_mesh({"data": 8})
+        mutated = api.sweep((X32, y32), losses.LogisticGradient(),
+                            _SkewLaneReg(), regs, num_iterations=4,
+                            convergence_tol=0.0, initial_weights=w032,
+                            mesh=m)
+        clean = api.sweep((X32, y32), losses.LogisticGradient(),
+                          prox.L2Prox(), regs, num_iterations=4,
+                          convergence_tol=0.0, initial_weights=w032,
+                          mesh=False)
+        n0 = int(mutated.num_iters[0])
+        np.testing.assert_allclose(
+            np.asarray(mutated.loss_history)[0][:n0],
+            np.asarray(clean.loss_history)[0][:n0], rtol=1e-5)
+        n1 = int(mutated.num_iters[1])
+        with pytest.raises(AssertionError):
+            np.testing.assert_allclose(
+                np.asarray(mutated.loss_history)[1][:n1],
+                np.asarray(clean.loss_history)[1][:n1], rtol=1e-5)
