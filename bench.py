@@ -755,6 +755,67 @@ def host_parity(rows, cpu_hist, data_cache, mark, done):
     log(f"host-driver loss-trajectory parity ok over {k} iterations")
 
 
+def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
+                 done):
+    """Minimal hardware probe of the fused Pallas kernel (VERDICT r4
+    item 4): small shape, AOT phase markers, own budgets, failure
+    isolated.  Fills ``pallas_iters_per_sec``/``pallas_probe_rows`` on
+    success; on any failure the record names the phase
+    (``pallas_failure_phase`` ∈ stage/trace/compile/execute/run) and
+    carries the error — so after ONE healthy claim we know whether the
+    mosaic lowering and the VMEM-budgeted block choice survive a real
+    chip, and if not, exactly where they die."""
+    if device.platform != "tpu" and os.environ.get(
+            "BENCH_PALLAS_INTERPRET") != "1":
+        rec["pallas_probe"] = "skipped (not a TPU backend)"
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.ops.pallas_kernels import PallasLogisticGradient
+
+    tag = f"pallas-probe-{rows}r"
+    # _time_step_aot owns the AOT phase split and its budgets (shared
+    # with the fused rungs — r5 review: no second copy of that timing);
+    # the probe only tracks which marker was last armed so a failure
+    # names its phase.
+    last = [f"{tag}-stage"]
+
+    def _mark(s, b=None, **kv):
+        last[0] = s
+        return mark(s, b, **kv)
+
+    try:
+        mark(f"{tag}-stage", 240)
+        Xd, yd = _device_data(rows, data_cache, mark, done)
+        w0 = jnp.zeros(N_FEATURES, jnp.float32)
+        interpret = device.platform != "tpu"
+        step = _make_step(
+            PallasLogisticGradient(interpret=interpret), Xd, yd,
+            NUM_ITERS_TPU)
+        done(f"{tag}-stage")
+        res, run_s, compile_s, _, _ = _time_step_aot(
+            step, w0, tag, _mark, done)
+        rec["pallas_compile_s"] = round(compile_s, 2)
+        iters = int(res.num_iters)
+        rec["pallas_iters_per_sec"] = round(iters / run_s, 2)
+        rec["pallas_probe_rows"] = rows
+        cpu_hist = oracle_cache.get(rows, (None, None))[1]
+        if cpu_hist is not None:
+            rec["pallas_drift_rel"] = round(_drift(
+                np.asarray(res.loss_history)[:iters], cpu_hist), 6)
+        log(f"pallas probe {rows}r: compile={rec['pallas_compile_s']}s "
+            f"ips={rec['pallas_iters_per_sec']} "
+            f"drift={rec.get('pallas_drift_rel')}")
+    except Exception as e:  # noqa: BLE001 — the probe must never kill
+        # the banked record it annotates
+        done(last[0])
+        phase = last[0].rsplit("-", 1)[-1]
+        rec["pallas_failure_phase"] = phase
+        rec["pallas_probe_error"] = f"{type(e).__name__}: {e}"[:250]
+        log(f"pallas probe died in {phase}: {rec['pallas_probe_error']}")
+
+
 def bench_fused_rung(rows, device, cpu_ips, cpu_hist, mark, done,
                      data_cache):
     """One fused-program rung at ``rows``, AOT-split and roofline'd."""
@@ -989,6 +1050,16 @@ def run_ladder(device=None, mark=None, done=None, bank_path=None):
     if best is None:
         raise BackendError(
             f"no ladder rung produced a healthy record: {failed}")
+    # minimal Pallas compile+parity probe at the LEAN shape — runs on
+    # every healthy claim whatever rung banked, so the claim either
+    # fills pallas_iters_per_sec or names the exact wedge phase
+    # (VERDICT r4 item 4; the 515-line kernel file had never touched
+    # hardware).  The full-scale Pallas ride-along (fused best only)
+    # may already have filled the field — don't repeat device work.
+    if best.get("pallas_iters_per_sec") is None:
+        pallas_probe(best, min(shapes), device,
+                     oracle_cache, data_cache, mark, done)
+        _write_bank(bank_path, best, records, failed)
     # the fused/host delta at matched shape (VERDICT r4 item 3)
     for rows, frec in fused_recs.items():
         hrec = next((r for r in healthy

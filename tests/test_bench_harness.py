@@ -716,3 +716,63 @@ class TestClaimLadder:
         ts, path, rec = bench._find_replay()
         assert path == "BENCH_MANUAL_watch.json"
         assert rec["value"] == 100.0
+
+
+class TestPallasProbe:
+    """VERDICT r4 item 4: every healthy claim must either fill
+    pallas_iters_per_sec or name the exact wedge phase — the fused
+    kernel file must stop being hardware-untouched silently."""
+
+    @pytest.fixture()
+    def tiny(self, bench, monkeypatch, cpu_devices):
+        monkeypatch.setattr(bench, "N_FEATURES", 16)
+        monkeypatch.setattr(bench, "NUM_ITERS_TPU", 2)
+        return bench
+
+    @staticmethod
+    def _noop(s, b=None, **kv):
+        return None
+
+    def test_skip_note_off_tpu(self, tiny, cpu_devices, monkeypatch):
+        monkeypatch.delenv("BENCH_PALLAS_INTERPRET", raising=False)
+        rec = {}
+        tiny.pallas_probe(rec, 256, cpu_devices[0], {}, {},
+                          self._noop, self._noop)
+        assert rec["pallas_probe"].startswith("skipped")
+
+    def test_interpret_mode_fills_field_with_aot_phases(
+            self, tiny, cpu_devices, monkeypatch):
+        monkeypatch.setenv("BENCH_PALLAS_INTERPRET", "1")
+        marks = []
+        rec = {}
+        tiny.pallas_probe(rec, 256, cpu_devices[0], {}, {},
+                          lambda s, b=None, **kv: marks.append(s),
+                          self._noop)
+        assert rec.get("pallas_probe_error") is None
+        assert rec["pallas_iters_per_sec"] > 0
+        assert rec["pallas_probe_rows"] == 256
+        assert rec["pallas_compile_s"] >= 0
+        # every device phase ran under its own budget marker
+        for ph in ("stage", "trace", "compile", "execute", "run"):
+            assert f"pallas-probe-256r-{ph}" in marks
+
+    def test_failure_names_the_phase(self, tiny, cpu_devices,
+                                     monkeypatch):
+        monkeypatch.setenv("BENCH_PALLAS_INTERPRET", "1")
+
+        class _Lowered:
+            def compile(self):
+                raise RuntimeError("mosaic died")
+
+        class _Step:
+            def lower(self, w):
+                return _Lowered()
+
+        monkeypatch.setattr(tiny, "_make_step",
+                            lambda *a, **k: _Step())
+        rec = {}
+        tiny.pallas_probe(rec, 256, cpu_devices[0], {}, {},
+                          self._noop, self._noop)
+        assert rec["pallas_failure_phase"] == "compile"
+        assert "mosaic died" in rec["pallas_probe_error"]
+        assert "pallas_iters_per_sec" not in rec

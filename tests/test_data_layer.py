@@ -72,6 +72,33 @@ class TestLibsvmParsers:
         with pytest.raises(ValueError):
             libsvm.load_libsvm(str(p), force_python=True)
 
+    @pytest.mark.parametrize("force_python", [True, False],
+                             ids=["python", "native"])
+    def test_truncated_final_line_clean_error(self, tmp_path,
+                                              force_python):
+        """A file cut mid-token (interrupted copy — the common way a
+        multi-GB LIBSVM file goes bad, VERDICT r4 item 7) must raise a
+        clean ValueError from BOTH parsers: no crash, no silently
+        shortened dataset."""
+        good = "1 1:0.5 3:1.25\n-1 2:2.0 4:0.75\n"
+        p = tmp_path / "trunc.libsvm"
+        # cut inside the final token, leaving a bare index with no value
+        p.write_text(good[: good.rfind(":")])
+        with pytest.raises(ValueError):
+            libsvm.load_libsvm(str(p), force_python=force_python)
+
+    @pytest.mark.parametrize("force_python", [True, False],
+                             ids=["python", "native"])
+    def test_missing_trailing_newline_ok(self, tmp_path, force_python):
+        """A COMPLETE final line without '\\n' is valid LIBSVM and must
+        parse (only mid-token truncation is an error)."""
+        p = tmp_path / "no_nl.libsvm"
+        p.write_text("1 1:0.5 3:1.25\n-1 2:2.0 4:0.75")
+        d = libsvm.load_libsvm(str(p), force_python=force_python)
+        assert d.n_rows == 2
+        np.testing.assert_array_equal(d.indptr, [0, 2, 4])
+        np.testing.assert_allclose(d.values, [0.5, 1.25, 2.0, 0.75])
+
 
 class TestCSRKernels:
     @pytest.fixture
