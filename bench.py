@@ -779,15 +779,19 @@ def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
     # with the fused rungs — r5 review: no second copy of that timing);
     # the probe only tracks which marker was last armed so a failure
     # names its phase.
-    last = [f"{tag}-stage"]
+    last = [None]
 
     def _mark(s, b=None, **kv):
         last[0] = s
         return mark(s, b, **kv)
 
     try:
-        mark(f"{tag}-stage", 240)
-        Xd, yd = _device_data(rows, data_cache, mark, done)
+        # _device_data also goes through _mark: its own data-NNNr marker
+        # must be the one the except arm closes if generation dies (r5
+        # review: a mismatched done() left a wedged-looking inflight
+        # entry in the probe file)
+        Xd, yd = _device_data(rows, data_cache, _mark, done)
+        _mark(f"{tag}-stage", 240)
         w0 = jnp.zeros(N_FEATURES, jnp.float32)
         interpret = device.platform != "tpu"
         step = _make_step(
@@ -800,6 +804,11 @@ def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
         iters = int(res.num_iters)
         rec["pallas_iters_per_sec"] = round(iters / run_s, 2)
         rec["pallas_probe_rows"] = rows
+        if rec.get("pallas_note") is not None:
+            # the full-scale ride-along failed earlier but the lean
+            # probe succeeded — keep the full-scale story under its own
+            # key so the record can't read as failed-and-healthy at once
+            rec["pallas_full_scale_note"] = rec.pop("pallas_note")
         cpu_hist = oracle_cache.get(rows, (None, None))[1]
         if cpu_hist is not None:
             rec["pallas_drift_rel"] = round(_drift(
@@ -809,8 +818,11 @@ def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
             f"drift={rec.get('pallas_drift_rel')}")
     except Exception as e:  # noqa: BLE001 — the probe must never kill
         # the banked record it annotates
-        done(last[0])
-        phase = last[0].rsplit("-", 1)[-1]
+        if last[0] is not None:
+            done(last[0])
+        phase = "pre-stage" if last[0] is None else (
+            last[0][len(tag) + 1:] if last[0].startswith(tag)
+            else last[0])
         rec["pallas_failure_phase"] = phase
         rec["pallas_probe_error"] = f"{type(e).__name__}: {e}"[:250]
         log(f"pallas probe died in {phase}: {rec['pallas_probe_error']}")

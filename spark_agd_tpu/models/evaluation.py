@@ -209,11 +209,18 @@ def cv_validation_scores(cv, X, y, *, score_fn, predict_fn=None,
         lambda a: a.reshape((F * R,) + a.shape[2:]), W)
     fold_lane = jnp.repeat(jnp.arange(F, dtype=jnp.int32), R)
 
-    def one(w, fold_k):
-        val_mask = base * (cv.fold_ids == fold_k)
-        return score_fn(predict_fn(w), y, val_mask)
+    def one(w, fold_k, da):
+        ya, basea, fids = da
+        val_mask = basea * (fids == fold_k)
+        return score_fn(predict_fn(w), ya, val_mask)
 
-    per_lane = jax.jit(jax.vmap(one))(flat_w, fold_lane).reshape(F, R)
+    # labels/masks/fold ids ride as jit arguments (lane-invariant), not
+    # closure constants — constant-embedded data scales compile time
+    # with the dataset (core.smooth.make_smooth_staged).  predict_fn
+    # still closes over X by API contract; the default matvec dominates
+    # neither lowering nor compile for a one-pass scoring program.
+    per_lane = jax.jit(jax.vmap(one, in_axes=(0, 0, None)))(
+        flat_w, fold_lane, (y, base, cv.fold_ids)).reshape(F, R)
     return per_lane, jnp.nanmean(per_lane, axis=0)
 
 

@@ -190,6 +190,7 @@ def run_agd_checkpointed(
     segment_iters: int = 10,
     smooth_loss=None,
     driver: str = "fused",
+    staged=None,
 ) -> CheckpointedResult:
     """AGD with periodic checkpoints: run ``segment_iters`` outer
     iterations per launch, persist the carry after each.  Kill the
@@ -200,11 +201,24 @@ def run_agd_checkpointed(
     segment shape — for device-resident smooths.  ``driver="host"``
     drives ``core.host_agd.run_agd_host`` — REQUIRED for host-level
     smooths (the streamed macro-batch fold, ``data.streaming``), whose
-    Python loop cannot live inside a traced program."""
+    Python loop cannot live inside a traced program.
+
+    ``staged`` (fused driver only): the ``(build, data_args)`` pair
+    from ``core.smooth.make_smooth_staged`` / ``parallel.dist_smooth.
+    make_dist_smooth_staged``.  When given, each segment's jitted
+    program takes the data as ARGUMENTS and ``smooth``/``smooth_loss``
+    are ignored — a closure-captured smooth embeds the dataset as
+    program constants and makes each segment's XLA compile scale with
+    nnz (the r4 ``compile_s: 1842.74`` defect class).  Closure smooths
+    remain supported for small problems and custom objectives."""
     if segment_iters <= 0:
         raise ValueError("segment_iters must be positive")
     if driver not in ("fused", "host"):
         raise ValueError(f"unknown driver {driver!r}: 'fused' | 'host'")
+    if staged is not None and driver != "fused":
+        raise ValueError(
+            "staged=(build, data_args) applies to the fused driver "
+            "only; the host driver never embeds data in a program")
     fp = problem_fingerprint(w0, config)
     loaded = load_checkpoint(path, w0, expect_fingerprint=fp)
     if loaded is not None:
@@ -235,6 +249,16 @@ def run_agd_checkpointed(
             return host_agd.run_agd_host(
                 smooth, prox, reg_value, warm_state.x, cfg_k,
                 smooth_loss=smooth_loss, warm=warm_state)
+        if staged is not None:
+            build, dargs = staged
+            if k not in seg_fns:
+                def _seg(ws, da, c=cfg_k):
+                    sm, sl = build(*da)
+                    return agd.run_agd(sm, prox, reg_value, ws.x, c,
+                                       smooth_loss=sl, warm=ws)
+
+                seg_fns[k] = jax.jit(_seg)
+            return seg_fns[k](warm_state, dargs)
         if k not in seg_fns:
             seg_fns[k] = jax.jit(
                 lambda ws, c=cfg_k: agd.run_agd(

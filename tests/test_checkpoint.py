@@ -161,6 +161,39 @@ class TestCheckpointedDriver:
         np.testing.assert_array_equal(np.asarray(again.weights),
                                       np.asarray(out.weights))
 
+    def test_staged_data_split_matches_closure_run(self, tmp_path,
+                                                   problem):
+        """``staged=(build, data_args)`` must bit-match the closure
+        path (same program, data as jit arguments — the r4 compile
+        defect's fix applied to segmented runs) and resume across
+        launches like any checkpoint."""
+        _, _, px, rv, w0 = problem
+        X, y = synthetic.generate_gd_input(2.0, -1.5, 500, 42)
+        X = synthetic.with_intercept_column(X).astype(np.float64)
+        staged = smooth_lib.make_smooth_staged(
+            LogisticGradient(), jnp.asarray(X),
+            jnp.asarray(y.astype(np.float64)))
+        closure = _run(problem, 12)
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=12)
+        p = str(tmp_path / "staged.npz")
+        out = ckpt.run_agd_checkpointed(
+            None, px, rv, w0, cfg, path=p, segment_iters=5,
+            staged=staged)
+        assert out.num_iters == 12
+        np.testing.assert_allclose(np.asarray(closure.weights),
+                                   np.asarray(out.weights), rtol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(closure.loss_history)[:12], out.loss_history,
+            rtol=1e-12)
+        again = ckpt.run_agd_checkpointed(
+            None, px, rv, w0, cfg, path=p, segment_iters=5,
+            staged=staged)
+        assert again.resumed_from == 12
+        with pytest.raises(ValueError, match="fused driver only"):
+            ckpt.run_agd_checkpointed(
+                None, px, rv, w0, cfg, path=p, segment_iters=5,
+                staged=staged, driver="host")
+
     def test_kill_and_resume(self, tmp_path, problem):
         sm, sl, px, rv, w0 = problem
         p = str(tmp_path / "killed.npz")

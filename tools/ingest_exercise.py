@@ -100,6 +100,25 @@ def main():
            "host_note": "1-core container; throughput is a floor, and "
                         "concurrent benchmark jobs may depress it"}
 
+    # a resume may only trust existing partitions generated under the
+    # SAME parameters — a rerun with different --gb/--parts must not
+    # silently reuse wrong-sized files and misreport rows_total (r5
+    # review); the manifest pins the generation parameters
+    manifest_path = os.path.join(args.workdir, "manifest.json")
+    manifest = {"rows_part": rows_part, "parts": args.parts,
+                "n_features": d, "nnz_per_row": nnz_row}
+    try:
+        with open(manifest_path) as f:
+            stale = json.load(f) != manifest
+    except (OSError, json.JSONDecodeError):
+        stale = True
+    if stale:
+        for name in os.listdir(args.workdir):
+            if name.startswith("part-"):
+                os.remove(os.path.join(args.workdir, name))
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f)
+
     print(f"generating {args.parts} partitions x {rows_part} rows ...",
           flush=True)
     t0 = time.perf_counter()
@@ -109,7 +128,7 @@ def main():
         paths.append(path)
         if os.path.exists(path) and os.path.getsize(path) > 0:
             total_bytes += os.path.getsize(path)
-            continue  # resumable across reruns
+            continue  # resumable: only whole files exist (atomic rename)
         total_bytes += write_partition(path, rows_part, d, nnz_row,
                                        seed=100 + i)
     gen_s = time.perf_counter() - t0
@@ -124,12 +143,19 @@ def main():
 
     assert native.load_parser() is not None, "native parser must build"
     t0 = time.perf_counter()
-    parsed = [libsvm.load_libsvm(p) for p in paths]
+    nnz_total = 0
+    nt = None
+    for i, p in enumerate(paths):
+        part = libsvm.load_libsvm(p)
+        nnz_total += len(part.values)
+        if i == 0:
+            nt = part  # kept for the bit-identity check; the rest are
+            # dropped immediately — holding all parts would double peak
+            # memory against the mesh assembly below (r5 review)
     native_s = time.perf_counter() - t0
-    nnz_total = int(sum(len(pt.values) for pt in parsed))
     rec["native_parse_s"] = round(native_s, 2)
     rec["native_mb_per_s"] = round(total_bytes / 1e6 / native_s, 1)
-    rec["nnz_total"] = nnz_total
+    rec["nnz_total"] = int(nnz_total)
     print(f"native: {rec['native_mb_per_s']} MB/s "
           f"({nnz_total / 1e6:.0f}M nnz)", flush=True)
 
@@ -142,7 +168,6 @@ def main():
     rec["python_mb_per_s"] = round(part_bytes / 1e6 / python_s, 1)
     rec["native_speedup"] = round(
         rec["native_mb_per_s"] / rec["python_mb_per_s"], 1)
-    nt = parsed[0]
     assert np.array_equal(py.labels, nt.labels)
     assert np.array_equal(py.indptr, nt.indptr)
     assert np.array_equal(py.indices, nt.indices)
@@ -152,6 +177,7 @@ def main():
     print(f"python fallback: {rec['python_mb_per_s']} MB/s "
           f"(native {rec['native_speedup']}x), outputs bit-identical",
           flush=True)
+    del py, nt  # release before the mesh assembly's own full parse
 
     # --- 4. malformed + truncated-final-line handling -----------------
     bad = os.path.join(args.workdir, "malformed.libsvm")
