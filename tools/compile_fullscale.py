@@ -1,0 +1,91 @@
+"""Uncontended AOT compile-time evidence at scale 1.0 (round 5).
+
+The config rows' ``compile_s`` is derived by subtraction (first fit
+wall minus second fit wall), which is only valid when host throughput
+is stationary — on the shared 1-core container a concurrent job during
+the first fit inflates it arbitrarily (the r5 config-3 row recorded
+2927 s that way while the ingest exercise shared the core).  This
+probe measures the phases DIRECTLY via the runner's AOT hook
+(``fit.lower_step``): trace/lower wall, XLA compile wall, and the
+lowered module size, one config at a time, nothing else running.
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/compile_fullscale.py [--configs 1,3] [--scale 1.0]
+
+Appends one JSON line per config to ``COMPILE_FULLSCALE_r05.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--configs", default="1,3")
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--out", default=os.path.join(
+        REPO, "COMPILE_FULLSCALE_r05.json"))
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from benchmarks import run as bench_run
+    from spark_agd_tpu import api
+
+    for idx in (int(c) for c in args.configs.split(",")):
+        cfg = bench_run.CONFIGS[idx - 1]
+        assert cfg.idx == idx
+        t0 = time.perf_counter()
+        varied = cfg.varied_nnz_ok
+        X, y = (cfg.make_data(args.scale, varied_nnz=True) if varied
+                else cfg.make_data(args.scale))
+        gen_s = time.perf_counter() - t0
+        w0 = cfg.make_w0(X)
+        t0 = time.perf_counter()
+        fit = api.make_runner((X, y, None), cfg.gradient(),
+                              cfg.updater(), reg_param=cfg.reg_param,
+                              num_iterations=10, convergence_tol=0.0)
+        stage_s = time.perf_counter() - t0  # prepare()/CSC twin build
+        t0 = time.perf_counter()
+        lowered = fit.lower_step(w0)
+        lower_s = time.perf_counter() - t0
+        hlo_bytes = len(lowered.as_text())
+        t0 = time.perf_counter()
+        lowered.compile()
+        compile_s = time.perf_counter() - t0
+        rec = {
+            "config": idx, "name": cfg.name, "scale": args.scale,
+            "rows": int(X.shape[0]),
+            "nnz_padded": getattr(X, "nnz", None),
+            "varied_nnz": bool(varied),
+            "platform": jax.devices()[0].platform,
+            "measured_at_unix": round(time.time(), 1),
+            "gen_s": round(gen_s, 1),
+            "stage_s": round(stage_s, 1),
+            "lower_s": round(lower_s, 2),
+            "hlo_bytes": hlo_bytes,
+            "compile_s": round(compile_s, 2),
+            "note": "direct AOT phase timing via fit.lower_step; "
+                    "supersedes the subtraction-derived compile_s of "
+                    "the corresponding BENCH_CONFIGS_CPU row when the "
+                    "two disagree (contention during a first fit "
+                    "inflates the subtraction)",
+        }
+        print(json.dumps(rec), flush=True)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        del X, y, fit, lowered
+
+
+if __name__ == "__main__":
+    main()

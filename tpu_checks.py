@@ -119,15 +119,19 @@ def main(argv=None):
     # the multi-GiB device arrays would embed them as program constants
     # and pay nnz/size-scaled compile time ON THE CLAIM (the r4/r5
     # compile-wedge class; core.smooth.make_smooth_staged)
+    ref_fns, fused_fns = {}, {}  # kept: the timing baselines below
+    # reuse these executables instead of re-compiling byte-identical
+    # programs on the live claim (r5 review)
     for g in (LogisticGradient(), LeastSquaresGradient(), HingeGradient()):
         name = type(g).__name__
-        ref_l, ref_g, _ = jax.jit(
-            lambda wv, X, y, gg=g: gg.batch_loss_and_grad(wv, X, y))(
-                wd, Xd, yd)
+        ref_fns[name] = jax.jit(
+            lambda wv, X, y, gg=g: gg.batch_loss_and_grad(wv, X, y))
+        ref_l, ref_g, _ = ref_fns[name](wd, Xd, yd)
         t0 = time.perf_counter()
-        fl, fg = jax.jit(
+        fused_fns[name] = jax.jit(
             lambda wv, pp, gg=g: fused_margin_loss_grad(
-                gg, wv, pp, interpret=interp))(wd, padded)
+                gg, wv, pp, interpret=interp))
+        fl, fg = fused_fns[name](wd, padded)
         jax.block_until_ready(fg)
         compile_s = time.perf_counter() - t0
         rel_l = abs(float(fl) - float(ref_l)) / max(abs(float(ref_l)), 1e-30)
@@ -151,11 +155,9 @@ def main(argv=None):
         jax.block_until_ready(r)
         return (time.perf_counter() - t0) / reps
 
-    g = LogisticGradient()
-    _xla_f = jax.jit(lambda wv, X, y: g.batch_loss_and_grad(wv, X, y))
+    _xla_f = ref_fns["LogisticGradient"]
     xla_s = timed(lambda wv: _xla_f(wv, Xd, yd), wd, args.reps)
-    _pal_f = jax.jit(lambda wv, pp: fused_margin_loss_grad(
-        g, wv, pp, interpret=interp))
+    _pal_f = fused_fns["LogisticGradient"]
     pal_s = timed(lambda wv: _pal_f(wv, padded), wd, args.reps)
     print(json.dumps({
         "check": "pallas_vs_xla_smooth_eval",
@@ -223,9 +225,9 @@ def main(argv=None):
 
     Xs_d, ys_d, Ws_d = jax.jit(_gen_smx)(jax.random.PRNGKey(2))
     g_smx = SoftmaxGradient(smx_k)
-    ref_l, ref_g, _ = jax.jit(
-        lambda wv, X, y: g_smx.batch_loss_and_grad(wv, X, y))(
-            Ws_d, Xs_d, ys_d)
+    _smx_ref = jax.jit(
+        lambda wv, X, y: g_smx.batch_loss_and_grad(wv, X, y))
+    ref_l, ref_g, _ = _smx_ref(Ws_d, Xs_d, ys_d)
     gp = PallasSoftmaxGradient(g_smx, interpret=interp)
     Xp_s, yp_s, mp_s = gp.prepare(Xs_d, ys_d)
     t0 = time.perf_counter()
@@ -237,9 +239,10 @@ def main(argv=None):
                    / (jnp.linalg.norm(ref_g) + 1e-30))
     smx_ok = rel_l < 1e-3 and rel_gr < 1e-3
     failures += not smx_ok
-    _smx_f = jax.jit(
-        lambda wv, X, y: g_smx.batch_loss_and_grad(wv, X, y)[1])
-    xla_smx = timed(lambda wv: _smx_f(wv, Xs_d, ys_d), Ws_d, args.reps)
+    # reuse the parity reference's executable; indexing [1] outside the
+    # jit skips a near-duplicate full-scale compile on the claim
+    xla_smx = timed(lambda wv: _smx_ref(wv, Xs_d, ys_d)[1], Ws_d,
+                    args.reps)
     pal_smx = timed(
         lambda wv: gp.batch_loss_and_grad(wv, Xp_s, yp_s, mp_s)[1],
         Ws_d, args.reps)
