@@ -8,20 +8,70 @@ dense features).  Labels are drawn from a planted linear/MLP model so the
 optimization problem is non-degenerate and the loss trajectories are
 meaningful, not noise-fitting.
 
-All generators run ON DEVICE (``jax.random`` on the default backend) —
-the data is produced in the HBM that will consume it, and the host↔device
-link carries only PRNG keys.  See ``spark_agd_tpu.data.device_synth`` for
-why this matters on the tunneled bench environment (multi-GiB
-``device_put`` is the least reliable primitive there) and why it is also
-the TPU-native design.
+Generators run ON DEVICE (``jax.random`` on the default backend) — the
+data is produced in the HBM that will consume it, and the host↔device
+link carries only PRNG keys.  See ``spark_agd_tpu.data.device_synth``
+for why this matters on the tunneled bench environment (multi-GiB
+``device_put`` is the least reliable primitive there) and why it is
+also the TPU-native design.  ONE exception: dense shapes past the
+one-device-HBM scale (``_BLOCK_ELEMS``) generate blockwise on the host
+CPU backend — see ``_blockwise_planted``.
 """
 
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 from spark_agd_tpu.data import device_synth as synth
 from spark_agd_tpu.ops.sparse import CSRMatrix
+
+# Above this many f32 feature elements the dense generators switch to
+# row-block generation: a monolithic jax.random.normal materializes a
+# ~4x transient (counter iota + raw bits + converted floats in one
+# fusion), so the 40 GB config-2 X transiently asks for 160 GB and
+# OOMs the 125 GB CPU host (r5, BENCH_CONFIGS_CPU_r05 config-2 error
+# row).  Blockwise: planted params drawn once, per-block folded keys,
+# peak transient ~4x ONE block.
+_BLOCK_ELEMS = 1 << 31  # ~8 GiB f32
+_BLOCK_ROWS = 1 << 20
+
+
+def _blockwise_planted(n: int, d: int, seed: int, param_maker,
+                       block_fn):
+    """Deterministic blockwise dense generation, host-assembled on the
+    CPU backend.
+
+    ``param_maker(key) -> params`` draws the planted model ONCE (the
+    SAME model functions the monolithic generators use —
+    ``device_synth.linreg_params``/``softmax_params``);
+    ``block_fn(key, params, rows) -> (Xb, yb)`` generates one row
+    block.  Bits differ from the monolithic single-key path (the block
+    layout is part of the stream), so trajectories are comparable only
+    within one generator path — the provenance digest records which
+    bits a row was measured on.
+
+    Pinned to the HOST CPU backend: this path only triggers past the
+    one-device-HBM scale, where the result is host-assembled anyway —
+    generating blocks on a tunneled accelerator would round-trip every
+    multi-GiB block over the link the module docstring forbids (r5
+    review)."""
+    key = jax.random.PRNGKey(seed)
+    kparams, kblocks = jax.random.split(key)
+    cpu = synth.cpu_device()
+    with jax.default_device(cpu):
+        params = param_maker(kparams)
+        jit_block = jax.jit(block_fn, static_argnums=(2,))
+        X = np.empty((n, d), np.float32)
+        ys = []
+        for i, start in enumerate(range(0, n, _BLOCK_ROWS)):
+            rows = min(_BLOCK_ROWS, n - start)
+            Xb, yb = jit_block(jax.random.fold_in(kblocks, i), params,
+                               rows)
+            X[start:start + rows] = np.asarray(Xb)
+            ys.append(np.asarray(yb))
+            del Xb, yb
+    return X, np.concatenate(ys)
 
 
 def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,
@@ -61,16 +111,26 @@ def url_like(scale: float = 1.0, seed: int = 1,
 
 def dense_linreg(scale: float = 1.0, seed: int = 2):
     """BASELINE config 2: synthetic dense 10M x 1K least squares."""
-    n = max(1024, int(10_000_000 * scale))
-    return jax.jit(synth.planted_dense_linreg, static_argnums=(1, 2))(
-        jax.random.PRNGKey(seed), n, 1000)
+    n, d = max(1024, int(10_000_000 * scale)), 1000
+    if n * d <= _BLOCK_ELEMS:
+        return jax.jit(synth.planted_dense_linreg, static_argnums=(1, 2))(
+            jax.random.PRNGKey(seed), n, d)
+
+    return _blockwise_planted(
+        n, d, seed, lambda k: synth.linreg_params(k, d),
+        lambda k, w, rows: synth.linreg_block(k, w, rows, d))
 
 
 def mnist8m_like(scale: float = 1.0, seed: int = 3):
     """BASELINE config 4 geometry: 8.1M x 784, 10 classes."""
-    n = max(1024, int(8_100_000 * scale))
-    return jax.jit(synth.planted_softmax, static_argnums=(1, 2, 3))(
-        jax.random.PRNGKey(seed), n, 784, 10)
+    n, d, k_cls = max(1024, int(8_100_000 * scale)), 784, 10
+    if n * d <= _BLOCK_ELEMS:
+        return jax.jit(synth.planted_softmax, static_argnums=(1, 2, 3))(
+            jax.random.PRNGKey(seed), n, d, k_cls)
+
+    return _blockwise_planted(
+        n, d, seed, lambda k: synth.softmax_params(k, d, k_cls),
+        lambda k, W, rows: synth.softmax_block(k, W, rows, d, k_cls))
 
 
 def criteo_like(scale: float = 1.0, seed: int = 4):

@@ -95,14 +95,45 @@ def host_gen(fn, *args):
 # Benchmark-geometry generators (device-side twins of benchmarks.datasets)
 # ---------------------------------------------------------------------------
 
+def linreg_params(key, d: int):
+    """The planted linreg weight — ONE definition shared by the
+    monolithic generator and the blockwise twin, so the model cannot
+    silently diverge between the two paths (r5 review)."""
+    return jax.random.normal(key, (d,), jnp.float32) / math.sqrt(d)
+
+
+def _linreg_body(kx, ke, w, rows: int, d: int, noise: float):
+    X = jax.random.normal(kx, (rows, d), jnp.float32)
+    return X, X @ w + noise * jax.random.normal(ke, (rows,), jnp.float32)
+
+
 def planted_dense_linreg(key, n: int, d: int,
                          noise: float = 0.1) -> Tuple[jax.Array, jax.Array]:
-    """Dense least-squares with a planted weight vector."""
+    """Dense least-squares with a planted weight vector.  (Key split
+    order is frozen — committed trajectories were measured on exactly
+    these bits.)"""
     kx, kw, ke = jax.random.split(key, 3)
-    X = jax.random.normal(kx, (n, d), jnp.float32)
-    w = jax.random.normal(kw, (d,), jnp.float32) / math.sqrt(d)
-    y = X @ w + noise * jax.random.normal(ke, (n,), jnp.float32)
-    return X, y
+    return _linreg_body(kx, ke, linreg_params(kw, d), n, d, noise)
+
+
+def linreg_block(key, w, rows: int, d: int, noise: float = 0.1):
+    """One row block of the SAME planted linreg model (weights from
+    :func:`linreg_params`), for bounded-transient blockwise generation
+    (``benchmarks.datasets``).  Bits differ from the monolithic path —
+    the block layout is part of the stream."""
+    kx, ke = jax.random.split(key)
+    return _linreg_body(kx, ke, w, rows, d, noise)
+
+
+def softmax_params(key, d: int, k: int):
+    """The planted softmax weight matrix — shared like linreg_params."""
+    return jax.random.normal(key, (d, k), jnp.float32) / math.sqrt(d)
+
+
+def _softmax_body(kx, kg, W, rows: int, d: int, k: int):
+    X = jax.random.normal(kx, (rows, d), jnp.float32)
+    logits = X @ W + jax.random.gumbel(kg, (rows, k), jnp.float32)
+    return X, jnp.argmax(logits, axis=1).astype(jnp.int32)
 
 
 def planted_softmax(key, n: int, d: int,
@@ -110,10 +141,14 @@ def planted_softmax(key, n: int, d: int,
     """Dense multiclass data: labels drawn from the planted softmax model
     via the Gumbel-max trick (exactly a categorical sample)."""
     kx, kw, kg = jax.random.split(key, 3)
-    X = jax.random.normal(kx, (n, d), jnp.float32)
-    W = jax.random.normal(kw, (d, k), jnp.float32) / math.sqrt(d)
-    logits = X @ W + jax.random.gumbel(kg, (n, k), jnp.float32)
-    return X, jnp.argmax(logits, axis=1).astype(jnp.int32)
+    return _softmax_body(kx, kg, softmax_params(kw, d, k), n, d, k)
+
+
+def softmax_block(key, W, rows: int, d: int, k: int):
+    """One row block of the SAME planted softmax model (see
+    :func:`linreg_block`)."""
+    kx, kg = jax.random.split(key)
+    return _softmax_body(kx, kg, W, rows, d, k)
 
 
 def planted_mlp(key, n: int, d: int, h: int,

@@ -31,6 +31,12 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--configs", default="1,3")
     p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--varied-nnz", choices=["true", "false"],
+                   default="true",
+                   help="sparse twin flavor; must MATCH the config row "
+                        "being cross-checked (the r5 stage-1 rows are "
+                        "--provenance rows, i.e. varied) — comparing "
+                        "across flavors compares different programs")
     p.add_argument("--out", default=os.path.join(
         REPO, "COMPILE_FULLSCALE_r05.json"))
     args = p.parse_args()
@@ -46,7 +52,7 @@ def main():
         cfg = bench_run.CONFIGS[idx - 1]
         assert cfg.idx == idx
         t0 = time.perf_counter()
-        varied = cfg.varied_nnz_ok
+        varied = cfg.varied_nnz_ok and args.varied_nnz == "true"
         X, y = (cfg.make_data(args.scale, varied_nnz=True) if varied
                 else cfg.make_data(args.scale))
         gen_s = time.perf_counter() - t0
