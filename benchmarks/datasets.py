@@ -56,22 +56,34 @@ def _blockwise_planted(n: int, d: int, seed: int, param_maker,
     generating blocks on a tunneled accelerator would round-trip every
     multi-GiB block over the link the module docstring forbids (r5
     review)."""
+    import jax.numpy as jnp
+
     key = jax.random.PRNGKey(seed)
     kparams, kblocks = jax.random.split(key)
     cpu = synth.cpu_device()
     with jax.default_device(cpu):
         params = param_maker(kparams)
         jit_block = jax.jit(block_fn, static_argnums=(2,))
-        X = np.empty((n, d), np.float32)
-        ys = []
+        xbs, ybs = [], []
         for i, start in enumerate(range(0, n, _BLOCK_ROWS)):
             rows = min(_BLOCK_ROWS, n - start)
             Xb, yb = jit_block(jax.random.fold_in(kblocks, i), params,
                                rows)
-            X[start:start + rows] = np.asarray(Xb)
-            ys.append(np.asarray(yb))
-            del Xb, yb
-    return X, np.concatenate(ys)
+            xbs.append(Xb)
+            ybs.append(yb)
+            del Xb, yb  # loop vars must not pin the last block extra
+        # assemble as DEVICE arrays: returning host numpy would make
+        # the consumer's jnp.asarray duplicate the full X later — at
+        # the 40 GB config-2 shape that numpy+device twin pushed the
+        # harness to ~115 GB on the 125 GB host and into kernel reclaim
+        # thrash (r5).  NOTE the concat transient is ~2x the full X
+        # (all blocks + the output are alive until `del xbs`); size
+        # _BLOCK_ELEMS-triggered shapes against host RAM accordingly.
+        X = jnp.concatenate(xbs)
+        del xbs
+        y = jnp.concatenate(ybs)
+        del ybs
+    return X, y
 
 
 def _planted_sparse(n_rows: int, n_features: int, nnz_per_row: int,

@@ -337,13 +337,17 @@ def _provenance_block(config: BenchConfig, X, varied_nnz: bool) -> dict:
                              f"pairs hashed",
         })
     else:
-        arr = np.asarray(X)
+        n_rows = int(X.shape[0])
+        # slice BEFORE converting: np.asarray on the full dense X would
+        # materialize a host twin of a (possibly 40 GB) device array
+        # just to hash a 65k-row prefix (r5 review)
+        head = np.asarray(X[: min(n_rows, 1 << 16)])
         prov.update({
-            "rows": int(arr.shape[0]), "cols": int(arr.shape[1]),
-            "values_sha256": hashlib.sha256(
-                arr[: min(len(arr), 1 << 16)].tobytes()).hexdigest(),
-            "checksum_note": ("first 65,536 rows hashed" if len(arr)
-                              > (1 << 16) else "full matrix hashed"),
+            "rows": n_rows, "cols": int(X.shape[1]),
+            "values_sha256": hashlib.sha256(head.tobytes()).hexdigest(),
+            "checksum_note": ("first 65,536 rows hashed"
+                              if n_rows > (1 << 16)
+                              else "full matrix hashed"),
         })
     return prov
 
