@@ -126,7 +126,7 @@ else
 fi
 
 # ---- stage 3: escalating GD oracle, both ratios, f32+bf16 -----------
-for c in 2 4 5; do
+for c in 2 4; do
   if has_matched "$c"; then log "config $c matched escalation present; skip"
   else
     log "config $c (dense): bounded gd escalation"
@@ -134,6 +134,17 @@ for c in 2 4 5; do
          --gd-cap-max 2560 --dtype f32,bf16 --lbfgs --out "$OUT"
   fi
 done
+# config 5 (MLP): nonconvex landscape — the step/sqrt(iter) GD oracle
+# saturates every tractable cap (r5 measured: still unmatched at 2560,
+# both dtypes, ratio >= 128x).  The saturated ratio is an ACCEPTED,
+# documented lower bound; presence guard only (like config 3).
+if has 5 agd_vs_gd_iters; then
+  log "config 5 lower-bound escalation present; skip (accepted bound)"
+else
+  log "config 5 (mlp): bounded gd escalation (accepted lower bound)"
+  $RUN --config 5 --scale 0.02 --iters 20 --gd-cap 160 \
+       --gd-cap-max 2560 --dtype f32,bf16 --lbfgs --out "$OUT"
+fi
 if has_matched 1; then log "config 1 matched escalation present; skip"
 else
   log "config 1 (sparse): deep gd escalation (cap 40960)"
