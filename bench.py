@@ -922,6 +922,13 @@ def _ride_alongs(rec, rows, device, data_cache, mark, done):
 def _write_bank(path, best, records, failed):
     """Atomically persist the current best record (+ ladder summary) —
     the artifact a dead worker leaves behind for the replay path."""
+    # canonical-schema stamp, written INTO best (setdefault semantics)
+    # so every rebank of the same rung keeps one stable run_id
+    stamped = _stamp_schema(dict(best))
+    for k in ("schema_version", "kind", "run_id", "tool",
+              "timestamp_unix"):
+        if k in stamped:
+            best.setdefault(k, stamped[k])
     rec = dict(best)
     rec["ladder"] = {k: dict(v) for k, v in records.items()}
     if failed:
@@ -1233,10 +1240,10 @@ def worker_main():
     except Exception as e:  # noqa: BLE001 — always emit parseable JSON
         import traceback
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps(_error_json(f"{type(e).__name__}: {e}")),
-              flush=True)
+        print(json.dumps(_stamp_schema(
+            _error_json(f"{type(e).__name__}: {e}"))), flush=True)
         sys.exit(1)
-    print(json.dumps(out), flush=True)
+    print(json.dumps(_stamp_schema(out)), flush=True)
 
 
 def _run_worker(tag, extra_env=None, timeout=None):
@@ -1318,6 +1325,22 @@ def cpu_fallback(reason):
 # process.
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
+
+
+def _stamp_schema(rec):
+    """Stamp the one-line record as a canonical ``obs.schema`` run
+    record (schema_version/kind/run_id/tool added, nothing overwritten —
+    the replay path and every existing BENCH_* reader see a superset).
+    Failure-isolated: the one-parseable-line contract survives a broken
+    import."""
+    try:
+        from spark_agd_tpu.obs import schema
+
+        return schema.stamp(rec, tool="bench")
+    except Exception as e:  # noqa: BLE001 — stamping is metadata, never
+        # a gate on the emission contract
+        log(f"schema stamp unavailable: {type(e).__name__}: {e}")
+        return rec
 
 
 def _emit_once(rec):
@@ -1420,7 +1443,7 @@ def main():
             rec["replay_reason"] = ("banked record outranks the live "
                                     "attempt's best rung")
             log(f"replaying higher-ranked banked record {path}")
-            _emit_once(rec)
+            _emit_once(_stamp_schema(rec))
             sys.exit(0)
     if out is None or out.get("error"):
         rep = _find_replay()
@@ -1433,7 +1456,7 @@ def main():
                 if out is None else out.get("error"))[:300]
             log(f"replaying same-session TPU record {path} "
                 f"(age {rec['replayed_age_s']:.0f}s)")
-            _emit_once(rec)
+            _emit_once(_stamp_schema(rec))
             sys.exit(0)
     if out is None:
         # The fallback runs in-process (the config-route CPU switch) and
@@ -1443,9 +1466,9 @@ def main():
         def _fallback_watchdog():
             if not done.wait(float(os.environ.get(
                     "BENCH_FALLBACK_BUDGET_S", 300))):
-                if _emit_once(_error_json(
+                if _emit_once(_stamp_schema(_error_json(
                         "tpu unavailable and cpu fallback exceeded its "
-                        "budget")):
+                        "budget"))):
                     os._exit(1)
 
         done = threading.Event()
@@ -1455,13 +1478,13 @@ def main():
         except Exception as e:  # noqa: BLE001
             import traceback
             traceback.print_exc(file=sys.stderr)
-            _emit_once(_error_json(
+            _emit_once(_stamp_schema(_error_json(
                 f"tpu unavailable and cpu fallback failed: "
-                f"{type(e).__name__}: {e}"))
+                f"{type(e).__name__}: {e}")))
             sys.exit(1)
         finally:
             done.set()
-    _emit_once(out)
+    _emit_once(_stamp_schema(out))
     sys.exit(0 if not out.get("error") else 1)
 
 

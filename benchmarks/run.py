@@ -31,6 +31,7 @@ import numpy as np
 from spark_agd_tpu import api
 from spark_agd_tpu.core import lbfgs as lbfgs_core
 from spark_agd_tpu.models import mlp as mlp_lib
+from spark_agd_tpu.obs import schema
 from spark_agd_tpu.ops import losses, prox
 
 from . import datasets
@@ -578,6 +579,12 @@ def main(argv=None):
         scale = args.scale if args.scale is not None else (
             cfg.tpu_scale if on_tpu else 0.002)
         def emit(rec):
+            # every artifact row is a canonical ``obs.schema`` run
+            # record (schema_version/kind/run_id/tool added, existing
+            # keys untouched), so BENCH_* files from different rounds
+            # are machine-comparable; stdout and --out carry the SAME
+            # stamped dict
+            rec = schema.stamp(rec, tool="benchmarks.run")
             print(json.dumps(rec), flush=True)
             if out_f:
                 out_f.write(json.dumps(rec) + "\n")

@@ -102,6 +102,8 @@ from .ops.prox import (  # noqa: F401
     L1Updater,
 )
 from .ops.sparse import CSRMatrix  # noqa: F401
+from . import obs  # noqa: F401
+from .obs import Telemetry  # noqa: F401
 from .data.streaming import (  # noqa: F401
     StreamingDataset,
     make_streaming_eval_multi,

@@ -162,6 +162,7 @@ def run_agd(
     *,
     smooth_loss: LossFn | None = None,
     warm: AGDWarmState | None = None,
+    telemetry_cb: Callable | None = None,
 ) -> AGDResult:
     """Pure, trace-compatible AGD.  Wrap in ``jax.jit`` (the API layer does).
 
@@ -176,6 +177,16 @@ def run_agd(
     except as the structure template): the run continues bit-exactly where
     the checkpointed one stopped, executing up to ``config.num_iterations``
     *further* iterations.
+
+    ``telemetry_cb`` (opt-in live streaming, ``obs.Telemetry.
+    iteration_callback``): a host function invoked via
+    ``jax.debug.callback`` from inside the compiled loop, once per
+    executed iteration with the per-iteration scalars (it, loss, big_l,
+    theta, step, restarted) — the values ``diag_*`` only surface after
+    the program returns.  COSTS a host round-trip per iteration (an
+    outfeed on TPU), which is exactly the traffic the fused design
+    removed; ``None`` (default) traces the identical program as before
+    (no callback in the HLO).
     """
     cfg = config
     if cfg.loss_mode not in ("x", "x_strict", "y"):
@@ -310,6 +321,14 @@ def run_agd(
             lambda zi, xi: jnp.where(restart, xi, zi), t.z, t.x)
         theta_new = jnp.where(restart, s(jnp.inf), t.theta)
         bts_new = jnp.logical_or(restart, t.bts)
+
+        if telemetry_cb is not None:
+            # live stream: the same scalars the diag_* arrays record,
+            # emitted to the host WHILE the compiled loop runs
+            jax.debug.callback(
+                telemetry_cb, it=it_new, loss=loss, big_l=t.big_l,
+                theta=t.theta, step=1.0 / (t.theta * t.big_l),
+                restarted=restart)
 
         return _Outer(
             x=t.x, z=z_new, theta=theta_new, big_l=t.big_l, bts=bts_new,

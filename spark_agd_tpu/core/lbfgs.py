@@ -397,9 +397,17 @@ class _Outer(NamedTuple):
 
 
 def run_lbfgs(objective: ObjectiveFn, w0: Any,
-              config: LBFGSConfig = LBFGSConfig()) -> LBFGSResult:
+              config: LBFGSConfig = LBFGSConfig(), *,
+              telemetry_cb: Callable | None = None) -> LBFGSResult:
     """Minimize ``objective(w) -> (f, g)`` from ``w0`` — one compiled
-    program; jit the call (the api layer does)."""
+    program; jit the call (the api layer does).
+
+    ``telemetry_cb`` (opt-in live streaming, same contract as
+    ``core.agd.run_agd``): called via ``jax.debug.callback`` once per
+    outer iteration with ``(it, loss, accepted)`` — ``accepted=False``
+    marks a failed line search's terminal pass (not an executed
+    iteration; the host side filters it).  Default ``None`` traces the
+    identical program as before."""
     cfg = config
     m = int(cfg.num_corrections)
     if m < 1:
@@ -469,6 +477,9 @@ def run_lbfgs(objective: ObjectiveFn, w0: Any,
         f_out = jnp.where(keep, f_n, st.f)
         hist = st.hist.at[it_n].set(jnp.where(keep, f_n,
                                               st.hist[it_n]))
+        if telemetry_cb is not None:
+            jax.debug.callback(telemetry_cb, it=it_n, loss=f_out,
+                               accepted=keep)
         return _Outer(w=w_out, f=f_out, g=g_out, ring=ring, it=it_n,
                       done=done,
                       converged=st.converged | converged,
@@ -547,7 +558,8 @@ class _OWL(NamedTuple):
 
 
 def run_owlqn(objective_smooth: ObjectiveFn, w0: Any, l1_reg: float,
-              config: LBFGSConfig = LBFGSConfig()) -> LBFGSResult:
+              config: LBFGSConfig = LBFGSConfig(), *,
+              telemetry_cb: Callable | None = None) -> LBFGSResult:
     """Minimize ``objective_smooth(w) -> (f, g)`` plus
     ``l1_reg·‖w‖₁`` from ``w0`` — one compiled program.  The smooth
     callable may already fold in a differentiable (L2) penalty, so an
@@ -658,6 +670,10 @@ def run_owlqn(objective_smooth: ObjectiveFn, w0: Any, l1_reg: float,
             lambda x, yv: jnp.where(keep, x, yv), a, b)
         hist = st.hist.at[it_n].set(jnp.where(keep, big_f_n,
                                               st.hist[it_n]))
+        if telemetry_cb is not None:
+            jax.debug.callback(telemetry_cb, it=it_n,
+                               loss=jnp.where(keep, big_f_n, st.big_f),
+                               accepted=keep)
         return _OWL(w=pick(w_n, st.w),
                     big_f=jnp.where(keep, big_f_n, st.big_f),
                     g=pick(g_n, st.g), ring=ring, it=it_n, done=done,

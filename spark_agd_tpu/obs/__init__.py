@@ -1,0 +1,51 @@
+"""``spark_agd_tpu.obs`` — the unified telemetry subsystem.
+
+Three layers (see ``docs/OBSERVABILITY.md`` for the guide):
+
+1. **Metrics registry** (``obs.registry``): counters, gauges, span
+   timers — cheap in-process instruments, snapshotted on demand.
+2. **Event bus + sinks** (``obs.events`` / ``obs.sinks``): records
+   stream to in-memory, JSONL, CSV, stdlib-logging, or (optional)
+   TensorBoard sinks; multihost-aware (rank-0-only or per-host files).
+3. **Canonical run-record schema** (``obs.schema``): the ONE JSONL
+   record family every producer stamps (``benchmarks/run.py``,
+   ``bench.py``, ``utils/logging.py``) and ``tools/agd_report.py``
+   consumes.  ``python -m spark_agd_tpu.obs --selfcheck`` validates it.
+
+The headline consumer is **live in-loop streaming**: pass
+``telemetry=Telemetry(...)`` to ``api.run`` / ``api.make_runner`` (or
+the L-BFGS runners) and the fused ``lax.while_loop`` emits one record
+per iteration *while the compiled program runs*, via
+``jax.debug.callback``.  Off by default — the callback costs a host
+round-trip per iteration, so the untelemetered program is bit-identical
+to before (no callback in the HLO) and timings are unaffected.
+"""
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    SpanTimer,
+    default_registry,
+)
+from .events import EventBus  # noqa: F401
+from .sinks import (  # noqa: F401
+    CSVSink,
+    InMemorySink,
+    JSONLSink,
+    LoggingSink,
+    Sink,
+    TensorBoardSink,
+)
+from .telemetry import Telemetry  # noqa: F401
+from . import schema  # noqa: F401
+from .schema import (  # noqa: F401
+    SCHEMA_VERSION,
+    iteration_record,
+    new_run_id,
+    read_jsonl,
+    run_record,
+    span_record,
+    stamp,
+    validate_record,
+)

@@ -1,0 +1,86 @@
+"""Event bus: fan records out to sinks, multihost-aware.
+
+One ``EventBus`` owns an ordered list of sinks (``obs.sinks``) and a
+host-gating mode.  On a multi-host SPMD job every process executes the
+same program — including its ``jax.debug.callback`` host callbacks — so
+an ungated bus would write N copies of every record.  Modes:
+
+- ``"all"`` (default): every host emits.  On a single host this is the
+  no-op gate; on multihost pair it with per-host-suffixed sink paths
+  (``parallel.multihost.host_suffixed``) so hosts never write the same
+  file.
+- ``"primary"``: only process 0 emits (rank-0-only logging, the common
+  production choice for replicated scalars).
+
+The gate resolves lazily on first emit (``jax.process_index`` touches
+the backend, which telemetry construction must not force) and is a
+no-op on a single host by construction.  Sink failures are counted and
+logged once, never raised — telemetry must not kill the run it
+observes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, List
+
+from .sinks import Sink
+
+logger = logging.getLogger("spark_agd_tpu")
+
+
+class EventBus:
+    def __init__(self, sinks: Iterable[Sink] = (),
+                 host_mode: str = "all"):
+        if host_mode not in ("all", "primary"):
+            raise ValueError(
+                f"host_mode must be 'all' or 'primary', got {host_mode!r}")
+        self.sinks: List[Sink] = list(sinks)
+        self.host_mode = host_mode
+        self._emit_here = None  # lazily resolved host gate
+        self.sink_errors = 0
+        self._warned = False
+
+    def _host_ok(self) -> bool:
+        if self.host_mode == "all":
+            return True
+        if self._emit_here is None:
+            try:
+                from ..parallel import multihost
+
+                self._emit_here = multihost.is_primary_host()
+            except Exception:  # noqa: BLE001 — no backend yet / no jax:
+                # gating open is the single-host-correct default
+                self._emit_here = True
+        return self._emit_here
+
+    def emit(self, record: dict) -> None:
+        if not self._host_ok():
+            return
+        for sink in self.sinks:
+            try:
+                sink.emit(record)
+            except Exception as e:  # noqa: BLE001 — observability must
+                # never kill the observed run
+                self.sink_errors += 1
+                if not self._warned:
+                    self._warned = True
+                    logger.warning(
+                        "telemetry sink %s failed (%s: %s); further "
+                        "sink errors are counted silently "
+                        "(bus.sink_errors)",
+                        type(sink).__name__, type(e).__name__, e)
+
+    def flush(self) -> None:
+        for sink in self.sinks:
+            try:
+                sink.flush()
+            except Exception:  # noqa: BLE001
+                self.sink_errors += 1
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                self.sink_errors += 1

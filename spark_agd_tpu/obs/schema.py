@@ -1,0 +1,237 @@
+"""The canonical run-record JSONL schema.
+
+Before this module every producer serialized its own incompatible JSON:
+``benchmarks/run.py`` emitted bare config records, ``bench.py`` emitted
+its ladder/bank shapes, and ``utils/logging.py`` emitted ad-hoc
+per-iteration dicts — three artifact families no one tool could read.
+This module defines ONE record family every producer stamps and every
+consumer (``tools/agd_report.py``, future round comparisons of
+``BENCH_*`` artifacts) can parse:
+
+- every record carries ``schema_version``, ``kind``, ``run_id``;
+- ``kind`` is one of ``run`` (one completed fit/benchmark), ``iteration``
+  (one optimizer iteration, live-streamed or post-hoc), ``span`` (one
+  timed phase: trace/compile/execute/h2d), ``metrics`` (a registry
+  snapshot);
+- required and known-optional fields are typed (validated by
+  :func:`validate_record`); unknown extra fields are ALLOWED — producers
+  keep their tool-specific columns, consumers ignore what they don't
+  know.  Existing artifact readers (e.g. ``bench.py``'s replay path)
+  keep working because stamping only ADDS keys.
+
+Deliberately dependency-free (stdlib only): ``bench.py`` stamps its
+one-line contract through here and must never grow a heavy import, and
+``python -m spark_agd_tpu.obs --selfcheck`` validates an example record
+in CI without touching a backend.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+KINDS = ("run", "iteration", "span", "metrics")
+
+_NUM = (int, float)
+
+# kind -> {field: allowed types}; None in a tuple permits JSON null
+_REQUIRED: Dict[str, dict] = {
+    "run": {"run_id": str, "tool": str, "timestamp_unix": _NUM},
+    "iteration": {"run_id": str, "algorithm": str, "iter": int,
+                  "loss": _NUM},
+    "span": {"run_id": str, "name": str, "seconds": _NUM},
+    "metrics": {"run_id": str, "metrics": dict},
+}
+
+_OPTIONAL: Dict[str, dict] = {
+    "run": {
+        "algorithm": str, "name": str, "platform": str,
+        "device_kind": str, "n_devices": int, "iters": int,
+        "final_loss": _NUM + (type(None),), "converged": bool,
+        "iters_per_sec": _NUM + (type(None),),
+        "wall_s": _NUM, "compile_s": _NUM,
+        "error": (str, type(None)), "metrics": dict,
+    },
+    "iteration": {"L": _NUM, "theta": _NUM, "step": _NUM,
+                  "restarted": bool, "accepted": bool,
+                  "timestamp_unix": _NUM},
+    "span": {"timestamp_unix": _NUM},
+    "metrics": {"timestamp_unix": _NUM, "tool": str},
+}
+
+_run_counter = itertools.count()
+
+
+def new_run_id() -> str:
+    """Process-unique, time-sortable id: ms timestamp + pid + counter."""
+    return (f"r{int(time.time() * 1000):x}"
+            f"-{os.getpid():x}-{next(_run_counter):x}")
+
+
+def _type_ok(value, types) -> bool:
+    if not isinstance(types, tuple):
+        types = (types,)
+    # bool is an int subclass in Python; an int-typed field (e.g.
+    # ``iter``) must not silently accept True
+    if isinstance(value, bool):
+        return bool in types
+    # a float-typed field accepts ints (JSON has one number type)
+    return isinstance(value, types)
+
+
+def validate_record(rec) -> List[str]:
+    """Errors for one record against the schema; ``[]`` means valid.
+
+    Checks the canonical keys and the typed known-optional keys; extra
+    unknown keys are allowed by design (see module docstring).
+    """
+    errors: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record must be a dict, got {type(rec).__name__}"]
+    sv = rec.get("schema_version")
+    if sv != SCHEMA_VERSION:
+        errors.append(f"schema_version must be {SCHEMA_VERSION}, "
+                      f"got {sv!r}")
+    kind = rec.get("kind")
+    if kind not in KINDS:
+        errors.append(f"kind must be one of {KINDS}, got {kind!r}")
+        return errors
+    for field, types in _REQUIRED[kind].items():
+        if field not in rec:
+            errors.append(f"{kind} record missing required field "
+                          f"{field!r}")
+        elif not _type_ok(rec[field], types):
+            errors.append(
+                f"{field!r} must be "
+                f"{getattr(types, '__name__', types)}, got "
+                f"{type(rec[field]).__name__}")
+    for field, types in _OPTIONAL[kind].items():
+        if field in rec and not _type_ok(rec[field], types):
+            errors.append(
+                f"{field!r} must be "
+                f"{getattr(types, '__name__', types)}, got "
+                f"{type(rec[field]).__name__}")
+    if kind == "iteration" and isinstance(rec.get("iter"), int) \
+            and rec["iter"] < 1:
+        errors.append("iter is 1-based (the reference's nIter); got "
+                      f"{rec['iter']}")
+    return errors
+
+
+def stamp(rec: dict, *, tool: str, kind: str = "run",
+          run_id: Optional[str] = None) -> dict:
+    """A COPY of ``rec`` with the canonical fields added (existing keys
+    are never overwritten, so re-stamping and legacy producers with
+    their own ``run_id`` are both safe)."""
+    out = dict(rec)
+    out.setdefault("schema_version", SCHEMA_VERSION)
+    out.setdefault("kind", kind)
+    out.setdefault("run_id", run_id or new_run_id())
+    out.setdefault("tool", tool)
+    out.setdefault("timestamp_unix", round(time.time(), 3))
+    return out
+
+
+def run_record(*, tool: str, run_id: Optional[str] = None,
+               **fields) -> dict:
+    return stamp(fields, tool=tool, kind="run", run_id=run_id)
+
+
+def iteration_record(run_id: str, algorithm: str, it: int,
+                     **fields) -> dict:
+    return {"schema_version": SCHEMA_VERSION, "kind": "iteration",
+            "run_id": run_id, "algorithm": algorithm, "iter": int(it),
+            **fields}
+
+
+def span_record(run_id: str, name: str, seconds: float) -> dict:
+    return {"schema_version": SCHEMA_VERSION, "kind": "span",
+            "run_id": run_id, "name": name,
+            "seconds": float(seconds)}
+
+
+def metrics_record(run_id: str, metrics: dict, *,
+                   tool: Optional[str] = None) -> dict:
+    rec = {"schema_version": SCHEMA_VERSION, "kind": "metrics",
+           "run_id": run_id, "metrics": dict(metrics)}
+    if tool is not None:
+        rec["tool"] = tool
+    return rec
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse one record per non-blank line; raises ``ValueError`` naming
+    the line on malformed JSON (consumers wanting tolerance — the report
+    CLI — catch per line themselves)."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not valid JSON: {e}")
+    return out
+
+
+EXAMPLE_RUN_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "run",
+    "run_id": "r18c2d3e4-1a2b-0", "tool": "benchmarks.run",
+    "timestamp_unix": 1754000000.0, "algorithm": "agd",
+    "name": "logistic_l2_rcv1like", "platform": "cpu", "n_devices": 1,
+    "iters": 20, "final_loss": 0.3217, "converged": False,
+    "iters_per_sec": 412.5, "error": None,
+}
+
+EXAMPLE_ITERATION_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "iteration",
+    "run_id": "r18c2d3e4-1a2b-0", "algorithm": "agd", "iter": 1,
+    "loss": 0.6931, "L": 1.0, "theta": 1.0, "step": 1.0,
+    "restarted": False,
+}
+
+EXAMPLE_SPAN_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "span",
+    "run_id": "r18c2d3e4-1a2b-0", "name": "compile", "seconds": 1.25,
+}
+
+
+def selfcheck() -> Tuple[bool, List[str]]:
+    """Validate the example records, a JSON round-trip, and a negative
+    control (a broken record MUST fail).  Returns ``(ok, messages)`` —
+    the ``python -m spark_agd_tpu.obs --selfcheck`` body."""
+    msgs: List[str] = []
+    ok = True
+    for name, rec in (("run", EXAMPLE_RUN_RECORD),
+                      ("iteration", EXAMPLE_ITERATION_RECORD),
+                      ("span", EXAMPLE_SPAN_RECORD)):
+        errs = validate_record(json.loads(json.dumps(rec)))
+        if errs:
+            ok = False
+            msgs.append(f"FAIL example {name} record: {errs}")
+        else:
+            msgs.append(f"ok: example {name} record validates "
+                        f"(round-tripped through JSON)")
+    bad = dict(EXAMPLE_RUN_RECORD)
+    del bad["run_id"]
+    if validate_record(bad):
+        msgs.append("ok: negative control (missing run_id) rejected")
+    else:
+        ok = False
+        msgs.append("FAIL: record missing run_id passed validation")
+    stamped = stamp({"value": 1.0}, tool="selfcheck")
+    errs = validate_record(stamped)
+    if errs:
+        ok = False
+        msgs.append(f"FAIL: stamp() output invalid: {errs}")
+    else:
+        msgs.append("ok: stamp() emits a valid run record")
+    msgs.append("selfcheck " + ("PASSED" if ok else "FAILED"))
+    return ok, msgs

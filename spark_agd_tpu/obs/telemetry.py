@@ -1,0 +1,166 @@
+"""``Telemetry``: the one object threaded through ``api.run`` /
+``api.make_runner`` (and the L-BFGS runners) as ``telemetry=``.
+
+Bundles the three telemetry primitives:
+
+- a :class:`~spark_agd_tpu.obs.registry.MetricsRegistry` (counters,
+  gauges, span timers) — the passive accumulator;
+- an :class:`~spark_agd_tpu.obs.events.EventBus` over pluggable sinks —
+  the active stream.  Spans emit one ``span`` record as they close;
+- the **live in-loop iteration stream**: :meth:`iteration_callback`
+  returns the host function ``core.agd`` / ``core.lbfgs`` invoke via
+  ``jax.debug.callback`` from INSIDE the compiled ``lax.while_loop`` —
+  per-iteration records (iter, loss, L, theta, step, restarted) arrive
+  while the program runs, not after ``block_until_ready``.
+
+**Overhead caveat**: the callback adds a host round-trip per iteration
+(an outfeed on TPU), which is exactly the traffic the fused design
+removed — so telemetry is strictly opt-in (``telemetry=None`` compiles
+the identical program as before, no callback in the HLO) and tier-1 /
+benchmark timings are unaffected by this subsystem existing.  Enable it
+for debugging convergence, watching long production fits, or feeding
+dashboards; disable it when timing.  ``every=N`` thins the emitted
+stream N:1 host-side (the callback still fires per iteration — thinning
+bounds sink I/O, not the round-trip).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from . import schema
+from .events import EventBus
+from .registry import MetricsRegistry
+from .sinks import InMemorySink, Sink
+
+
+# callback kwarg -> canonical record field (the cores pass their
+# internal names; records use the schema's)
+_FIELD_NAMES = {"big_l": "L"}
+
+
+def _scalar(v):
+    """Host-side normalize one callback value (np scalar -> python)."""
+    try:
+        v = v.item()
+    except AttributeError:
+        pass
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int):
+        return v
+    return float(v)
+
+
+class Telemetry:
+    """See module docstring.  With no ``sinks`` argument an in-memory
+    sink is created so :attr:`records` / :meth:`iterations` work out of
+    the box; pass explicit sinks (``JSONLSink``, ``CSVSink``,
+    ``LoggingSink``, ``TensorBoardSink``) to stream elsewhere.
+
+    ``host_mode``: ``"all"`` (default; single-host no-op) or
+    ``"primary"`` (rank-0-only emission on multihost jobs) — see
+    ``obs.events.EventBus``.
+    """
+
+    def __init__(self, sinks: Optional[Iterable[Sink]] = None, *,
+                 registry: Optional[MetricsRegistry] = None,
+                 every: int = 1, host_mode: str = "all",
+                 run_id: Optional[str] = None):
+        self.run_id = run_id or schema.new_run_id()
+        self.registry = registry or MetricsRegistry()
+        self._mem: Optional[InMemorySink] = None
+        if sinks is None:
+            self._mem = InMemorySink()
+            sinks = [self._mem]
+        else:
+            sinks = list(sinks)
+            for s in sinks:
+                if isinstance(s, InMemorySink):
+                    self._mem = s
+                    break
+        self.bus = EventBus(sinks, host_mode=host_mode)
+        self.every = max(1, int(every))
+        self.registry.set_span_hook(self._on_span)
+
+    # -- spans ------------------------------------------------------------
+    def _on_span(self, name: str, seconds: float) -> None:
+        self.bus.emit(schema.span_record(self.run_id, name, seconds))
+
+    def span(self, name: str):
+        """Context manager timing a phase; the duration lands in the
+        registry AND streams one ``span`` record as it closes."""
+        return self.registry.span(name)
+
+    # -- the live in-loop stream ------------------------------------------
+    def iteration_callback(self, algorithm: str = "agd"):
+        """The host function the fused loops call via
+        ``jax.debug.callback`` — one call per executed iteration, kwargs
+        are the per-iteration scalars.  ``accepted=False`` calls (an
+        L-BFGS iteration whose line search failed — not an executed
+        iteration) are counted but not emitted, preserving the
+        one-record-per-iteration contract."""
+        emitted = self.registry.counter(f"{algorithm}.iterations")
+        rejected = self.registry.counter(f"{algorithm}.rejected_steps")
+        every = self.every
+        run_id = self.run_id
+        bus = self.bus
+
+        def on_iteration(**fields):
+            accepted = fields.pop("accepted", None)
+            if accepted is not None and not bool(accepted):
+                rejected.inc()
+                return
+            it = int(fields.pop("it"))
+            emitted.inc()
+            if every > 1 and it % every:
+                return
+            bus.emit(schema.iteration_record(
+                run_id, algorithm, it,
+                **{_FIELD_NAMES.get(k, k): _scalar(v)
+                   for k, v in fields.items()}))
+
+        return on_iteration
+
+    # -- records ----------------------------------------------------------
+    def emit(self, record: dict) -> None:
+        self.bus.emit(record)
+
+    def run_summary(self, *, tool: str, **fields) -> dict:
+        """Emit (and return) the end-of-run ``run`` record, with the
+        registry snapshot attached under ``metrics``."""
+        rec = schema.run_record(tool=tool, run_id=self.run_id,
+                                metrics=self.registry.snapshot(),
+                                **fields)
+        self.bus.emit(rec)
+        return rec
+
+    @property
+    def records(self) -> List[dict]:
+        """Everything the in-memory sink collected (empty when explicit
+        sinks were passed without one)."""
+        return list(self._mem.records) if self._mem is not None else []
+
+    def iterations(self, algorithm: Optional[str] = None) -> List[dict]:
+        """The in-memory iteration records, in iter order."""
+        recs = [r for r in self.records if r.get("kind") == "iteration"
+                and (algorithm is None or r.get("algorithm") == algorithm)]
+        return sorted(recs, key=lambda r: r["iter"])
+
+    def spans(self, name: Optional[str] = None) -> List[dict]:
+        return [r for r in self.records if r.get("kind") == "span"
+                and (name is None or r.get("name") == name)]
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        self.bus.flush()
+
+    def close(self) -> None:
+        self.bus.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .shmap import shard_map
 
 from ..core import agd, smooth as smooth_lib, tvec
 from ..ops.losses import Gradient

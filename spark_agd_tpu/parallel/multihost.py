@@ -161,6 +161,35 @@ def make_hybrid_mesh(ici_axes: Dict[str, int],
     return Mesh(devs, tuple(names))
 
 
+def is_primary_host() -> bool:
+    """True on the one process that should own singleton side effects
+    (rank-0 telemetry sinks, checkpoint writes, artifact emission).
+    Trivially True in a single-process run, so gated code needs no
+    single-host special case."""
+    return jax.process_index() == 0
+
+
+def process_tag() -> str:
+    """A short per-host tag for file names — ``""`` on a single host
+    (so single-host paths are untouched), ``"h003"``-style on a
+    multi-process job."""
+    if jax.process_count() <= 1:
+        return ""
+    return f"h{jax.process_index():03d}"
+
+
+def host_suffixed(path: str) -> str:
+    """``path`` with this host's tag spliced in before the extension
+    (``run.jsonl`` → ``run.h003.jsonl``) — the per-host-sink convention
+    of ``obs``: every host streams, no two hosts share a file.  Identity
+    on a single host."""
+    tag = process_tag()
+    if not tag:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.{tag}{ext}"
+
+
 def process_local_rows(n_rows: int) -> slice:
     """The row range this host should load — the data-loading side of
     multi-host DP (each host feeds only its local shard; ``jax.make_array_

@@ -1,0 +1,31 @@
+"""shard_map compatibility shim.
+
+The distributed layer targets the stable ``jax.shard_map`` API
+(``check_vma=`` keyword).  Older jaxlib toolchains (such as the 0.4.x
+pin this container bakes in) only ship the experimental spelling
+(``jax.experimental.shard_map.shard_map`` with ``check_rep=``).  Every
+in-tree use routes through this one wrapper so the version split lives
+in exactly one place.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: stable top-level API
+    from jax import shard_map as _shard_map
+
+    _NEW_API = True
+except ImportError:  # the 0.4.x experimental spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _NEW_API = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` with the stable keyword surface on every
+    supported jax version (``check_vma`` maps onto the old
+    ``check_rep``; both toggle the same replication check)."""
+    if _NEW_API:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

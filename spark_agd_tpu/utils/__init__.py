@@ -14,5 +14,11 @@ from .checkpoint import (  # noqa: F401
     save_lbfgs_checkpoint,
     warm_from_result,
 )
-from .logging import iteration_records, log_result, make_host_logger  # noqa: F401
-from .profiling import annotate, timed, trace  # noqa: F401
+from .logging import (  # noqa: F401
+    iteration_records,
+    log_result,
+    make_host_logger,
+    result_run_record,
+    write_result_jsonl,
+)
+from .profiling import TimedStats, annotate, timed, timed_stats, trace  # noqa: F401

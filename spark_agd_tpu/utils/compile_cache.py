@@ -16,11 +16,35 @@ without executable serialization just log a JAX warning and skip).
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import os
 from typing import Optional
 
+logger = logging.getLogger("spark_agd_tpu")
+
 DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "spark_agd_tpu", "xla")
+
+# census taken by enable(); observe_compile deltas against the most
+# recent snapshot so consecutive observed compiles attribute correctly
+_LAST_CENSUS: Optional[dict] = None
+_LOGGED_ONCE = False
+
+
+def stats(path: Optional[str] = None) -> dict:
+    """Census of the cache dir: ``{"dir", "files", "bytes"}`` (recursive;
+    zeros when the dir does not exist yet)."""
+    path = path or os.environ.get("SPARK_AGD_COMPILE_CACHE", DEFAULT_DIR)
+    files = size = 0
+    for root, _, names in os.walk(path):
+        for n in names:
+            try:
+                size += os.path.getsize(os.path.join(root, n))
+                files += 1
+            except OSError:  # racing eviction — census stays best-effort
+                continue
+    return {"dir": path, "files": files, "bytes": size}
 
 
 def enable(path: Optional[str] = None, *,
@@ -30,7 +54,13 @@ def enable(path: Optional[str] = None, *,
     Call before the first compile (later calls still help later
     compiles).  ``min_compile_time_secs`` skips caching trivial programs
     (set 0 to cache everything, as tests do).  Returns the cache dir.
+
+    Also snapshots the dir census (files, bytes) into the process
+    metrics registry (gauges ``compile_cache.*``) so the cache's state
+    is observable before the first compile; pair with
+    :func:`observe_compile` to count hits/misses.
     """
+    global _LAST_CENSUS
     import jax
 
     path = path or os.environ.get("SPARK_AGD_COMPILE_CACHE", DEFAULT_DIR)
@@ -44,4 +74,58 @@ def enable(path: Optional[str] = None, *,
     # would silently never take effect.  Reset so it re-initializes.
     from jax.experimental.compilation_cache import compilation_cache
     compilation_cache.reset_cache()
+    _LAST_CENSUS = _record_census(stats(path))
     return path
+
+
+def _record_census(census: dict, registry=None) -> dict:
+    from ..obs.registry import default_registry
+
+    reg = registry or default_registry()
+    reg.gauge("compile_cache.files").set(census["files"])
+    reg.gauge("compile_cache.bytes").set(census["bytes"])
+    return census
+
+
+@contextlib.contextmanager
+def observe_compile(path: Optional[str] = None, registry=None):
+    """Attribute ONE compile to the persistent cache by file census:
+    wrap the call that triggers it (the first ``fit()``, an AOT
+    ``.compile()``) and the dir is censused before/after — a new cache
+    entry means the executable was built here (**miss**), no new entry
+    with a populated cache means it was deserialized (**hit**).
+    Counters ``compile_cache.hits`` / ``.misses`` and the dir gauges
+    land in the metrics registry (default: the process registry), and
+    the first observation logs the cache state once per process::
+
+        compile_cache.enable(dir)
+        with compile_cache.observe_compile():
+            fit(w0)   # first call -> compile or cache load
+    """
+    global _LAST_CENSUS, _LOGGED_ONCE
+    from ..obs.registry import default_registry
+
+    reg = registry or default_registry()
+    resolved = path or os.environ.get("SPARK_AGD_COMPILE_CACHE",
+                                      DEFAULT_DIR)
+    before = (_LAST_CENSUS
+              if _LAST_CENSUS and _LAST_CENSUS["dir"] == resolved
+              else stats(resolved))
+    try:
+        yield
+    finally:
+        after = stats(resolved)
+        new_files = after["files"] - before["files"]
+        if new_files > 0:
+            reg.counter("compile_cache.misses").inc(new_files)
+        else:
+            reg.counter("compile_cache.hits").inc()
+        _record_census(after, reg)
+        _LAST_CENSUS = after
+        if not _LOGGED_ONCE:
+            _LOGGED_ONCE = True
+            logger.info(
+                "compile cache %s: %d file(s), %.1f MiB; first observed "
+                "compile was a %s",
+                after["dir"], after["files"], after["bytes"] / 2**20,
+                "miss" if new_files > 0 else "hit")

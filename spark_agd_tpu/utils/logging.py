@@ -17,24 +17,69 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+from ..obs import schema
+
 logger = logging.getLogger("spark_agd_tpu")
 
 
-def iteration_records(result) -> List[dict]:
+def iteration_records(result, *, run_id: Optional[str] = None,
+                      algorithm: str = "agd") -> List[dict]:
     """One dict per executed iteration from an ``AGDResult``: iter (1-based,
-    like the reference's nIter), loss, L, theta, step, restarted."""
+    like the reference's nIter), loss, L, theta, step, restarted.
+
+    With ``run_id`` set, each dict is a canonical ``obs.schema``
+    iteration record (``schema_version``/``kind``/``run_id``/
+    ``algorithm`` added) — the post-hoc twin of the live
+    ``telemetry=`` stream, byte-compatible with its JSONL."""
     n = int(result.num_iters)
     hist = np.asarray(result.loss_history)[:n]
     ls = np.asarray(result.diag_l)[:n]
     thetas = np.asarray(result.diag_theta)[:n]
     steps = np.asarray(result.diag_step)[:n]
     restarted = np.asarray(result.diag_restarted)[:n]
-    return [
+    recs = [
         dict(iter=i + 1, loss=float(hist[i]), L=float(ls[i]),
              theta=float(thetas[i]), step=float(steps[i]),
              restarted=bool(restarted[i]))
         for i in range(n)
     ]
+    if run_id is not None:
+        recs = [schema.iteration_record(run_id, algorithm,
+                                        r.pop("iter"), **r)
+                for r in recs]
+    return recs
+
+
+def result_run_record(result, *, tool: str = "api.run",
+                      algorithm: str = "agd",
+                      run_id: Optional[str] = None, **extra) -> dict:
+    """The canonical end-of-run ``run`` record for an ``AGDResult``."""
+    n = int(result.num_iters)
+    hist = np.asarray(result.loss_history)[:n]
+    return schema.run_record(
+        tool=tool, run_id=run_id, algorithm=algorithm, iters=n,
+        final_loss=float(hist[-1]) if n else None,
+        converged=bool(result.converged),
+        error=("aborted: non-finite loss"
+               if bool(result.aborted_non_finite) else None),
+        **extra)
+
+
+def write_result_jsonl(result, path: str, *, tool: str = "api.run",
+                       algorithm: str = "agd",
+                       run_id: Optional[str] = None) -> str:
+    """Persist one completed run as canonical JSONL (the ``run`` record
+    followed by its iteration records) — what ``tools/agd_report.py``
+    consumes.  Returns the ``run_id``."""
+    run_id = run_id or schema.new_run_id()
+    with open(path, "a") as f:
+        f.write(json.dumps(result_run_record(
+            result, tool=tool, algorithm=algorithm,
+            run_id=run_id)) + "\n")
+        for rec in iteration_records(result, run_id=run_id,
+                                     algorithm=algorithm):
+            f.write(json.dumps(rec) + "\n")
+    return run_id
 
 
 def log_result(result, *, log: Optional[logging.Logger] = None,

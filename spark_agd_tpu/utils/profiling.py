@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Callable, Tuple
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import jax
 
@@ -31,18 +31,51 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def timed(fn: Callable, *args, warmup: int = 1,
-          repeats: int = 3) -> Tuple[float, object]:
-    """Wall-clock a jitted callable honestly: ``warmup`` calls absorb
-    compilation, then the median of ``repeats`` block-until-ready timings.
-    Returns ``(seconds, last_result)``."""
+class TimedStats(NamedTuple):
+    """Full repeat statistics from :func:`timed_stats` (seconds)."""
+
+    min_s: float
+    median_s: float
+    max_s: float
+    times: List[float]  # per-repeat, in execution order
+
+
+def timed_stats(fn: Callable, *args, warmup: int = 1, repeats: int = 3,
+                registry=None,
+                name: Optional[str] = None) -> Tuple[TimedStats, object]:
+    """Wall-clock a jitted callable honestly — ``warmup`` calls absorb
+    compilation, then ``repeats`` block-until-ready timings — and return
+    the FULL statistics ``(TimedStats(min, median, max, times),
+    last_result)`` instead of :func:`timed`'s median-only view.
+
+    ``registry`` (an ``obs.MetricsRegistry``; defaults to the process
+    registry) records each repeat under the span ``name`` (default
+    ``timed.<fn name>``) — one span event per repeat streams out live
+    when the registry is attached to a ``Telemetry`` bus.
+    """
+    if registry is None:
+        from ..obs.registry import default_registry
+
+        registry = default_registry()
+    span = registry.span(name or f"timed.{getattr(fn, '__name__', 'fn')}")
     out = None
     for _ in range(max(0, warmup)):
         out = jax.block_until_ready(fn(*args))
     times = []
     for _ in range(max(1, repeats)):
-        t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(*args))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2], out
+        with span:
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+    ordered = sorted(times)
+    return TimedStats(min_s=ordered[0],
+                      median_s=ordered[len(ordered) // 2],
+                      max_s=ordered[-1], times=times), out
+
+
+def timed(fn: Callable, *args, warmup: int = 1,
+          repeats: int = 3) -> Tuple[float, object]:
+    """Median-only wrapper over :func:`timed_stats` — the original
+    surface, kept signature-compatible: ``(seconds, last_result)``."""
+    stats, out = timed_stats(fn, *args, warmup=warmup, repeats=repeats)
+    return stats.median_s, out

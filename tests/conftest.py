@@ -33,7 +33,14 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jaxlib (< 0.4.38) predates the config option; the XLA flag
+    # it replaced still works and is read at backend instantiation, which
+    # has not happened yet at conftest time
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -9,6 +9,7 @@ coordinator handshake, real cross-process collective.
 Usage: python multihost_child.py <coordinator_addr> <n_proc> <proc_id>
 """
 
+import os
 import sys
 
 import jax
@@ -16,7 +17,21 @@ import jax
 # Order matters: platform config BEFORE distributed init BEFORE any
 # backend use (see parallel/multihost.initialize's ordering guard).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    # older jaxlib (< 0.4.38): the XLA flag it replaced, still read at
+    # backend instantiation (same fallback as tests/conftest.py)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+try:
+    # cross-process CPU collectives need an explicit transport on older
+    # jaxlib (newer ones default it); without this the psum below dies
+    # with "Multiprocess computations aren't implemented on the CPU
+    # backend"
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # noqa: BLE001 — newer jax: flag gone, default works
+    pass
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -42,7 +57,9 @@ def main():
     arr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("data")), local, (n_global,))
 
-    from jax import lax, shard_map
+    from jax import lax
+
+    from spark_agd_tpu.parallel.shmap import shard_map
 
     total = shard_map(
         lambda x: lax.psum(jnp.sum(x), "data"),

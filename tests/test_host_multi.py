@@ -69,14 +69,18 @@ def _assert_lane_parity(multi, solos):
         # f64 tolerances: the vmapped (N,D)@(D,K) lane contraction
         # reassociates vs the solo matvec, so last-ulp drift (~1e-11
         # rel) is physical; the DISCRETE path equality above is exact
+        # rtol 1e-7 (not last-ulp): older jaxlib toolchains fuse the
+        # multi-lane contraction into a different reduction order than
+        # the solo matvec (observed 1.5e-8 rel on 0.4.x CPU), which is
+        # the same physical reassociation drift, just larger
         np.testing.assert_allclose(
             multi.loss_history[:nk, k], solo.loss_history,
-            rtol=1e-9, atol=1e-12, err_msg=f"lane {k}")
+            rtol=1e-7, atol=1e-12, err_msg=f"lane {k}")
         np.testing.assert_allclose(
             np.asarray(multi.weights)[k], np.asarray(solo.weights),
             rtol=1e-7, atol=1e-10, err_msg=f"lane {k}")
         np.testing.assert_allclose(
-            float(multi.final_l[k]), solo.final_l, rtol=1e-9,
+            float(multi.final_l[k]), solo.final_l, rtol=1e-7,
             err_msg=f"lane {k}")
 
 

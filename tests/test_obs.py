@@ -1,0 +1,418 @@
+"""Telemetry subsystem tests (`spark_agd_tpu.obs`): registry, sinks,
+schema, live in-loop streaming, multihost gating, and the report CLI —
+all CPU, all fast (tier-1).
+
+The load-bearing one is TestLiveStreaming: with ``telemetry=`` an
+``api.run`` on the synthetic GLM fixture must emit exactly ``num_iters``
+per-iteration records whose losses match ``result.loss_history``
+bitwise WHILE the compiled program runs; with telemetry off (default)
+the traced program must contain no callback at all (the overhead-free
+default the docs promise).
+"""
+
+import importlib.util
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.obs import (
+    CSVSink,
+    EventBus,
+    InMemorySink,
+    JSONLSink,
+    LoggingSink,
+    MetricsRegistry,
+    Telemetry,
+    schema,
+    validate_record,
+)
+from spark_agd_tpu.obs.__main__ import main as obs_main
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import SquaredL2Updater
+from spark_agd_tpu.parallel import multihost
+from spark_agd_tpu.utils import compile_cache, logging as ulog, profiling
+
+
+@pytest.fixture(scope="module")
+def glm_problem():
+    """The synthetic GLM fixture: small logistic + L2, single device."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(96, 12)).astype(np.float32)
+    w_true = rng.normal(size=12).astype(np.float32)
+    y = (1.0 / (1.0 + np.exp(-X @ w_true))
+         > rng.random(96)).astype(np.float32)
+    w0 = np.zeros(12, np.float32)
+    return (X, y), w0
+
+
+class TestRegistry:
+    def test_counter_gauge_span(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(5)
+        with reg.span("s"):
+            pass
+        with reg.span("s"):
+            pass
+        assert reg.counter("c").value == 3
+        assert reg.gauge("g").value == 5
+        assert reg.span("s").count == 2
+        snap = reg.snapshot()
+        assert snap["c"] == 3 and snap["g"] == 5
+        assert snap["s.count"] == 2 and snap["s.total_s"] >= 0
+
+    def test_span_hook_emits(self):
+        reg = MetricsRegistry()
+        got = []
+        reg.set_span_hook(lambda name, s: got.append((name, s)))
+        with reg.span("phase"):
+            pass
+        assert len(got) == 1 and got[0][0] == "phase"
+
+
+class TestSinksAndSchema:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sink = JSONLSink(path)
+        run_id = schema.new_run_id()
+        rec_run = schema.run_record(tool="test", run_id=run_id,
+                                    algorithm="agd", iters=3,
+                                    final_loss=0.5, converged=True)
+        rec_it = schema.iteration_record(run_id, "agd", 1, loss=0.69,
+                                         L=1.0, theta=1.0, step=1.0,
+                                         restarted=False)
+        sink.emit(rec_run)
+        sink.emit(rec_it)
+        sink.close()
+        back = schema.read_jsonl(path)
+        assert back == [rec_run, rec_it]
+        for rec in back:
+            assert validate_record(rec) == []
+
+    def test_csv_sink_header_projection_and_kind_filter(self, tmp_path):
+        path = str(tmp_path / "it.csv")
+        sink = CSVSink(path)  # default: iteration rows only
+        sink.emit({"kind": "span", "name": "compile", "seconds": 1.0})
+        sink.emit({"kind": "iteration", "iter": 1, "loss": 0.5})
+        sink.emit({"kind": "iteration", "iter": 2, "loss": 0.4,
+                   "extra": "dropped"})
+        sink.close()
+        lines = open(path).read().strip().splitlines()
+        assert lines[0] == "kind,iter,loss"  # span never set the header
+        assert len(lines) == 3
+        path2 = str(tmp_path / "all.csv")
+        sink2 = CSVSink(path2, kinds=None)
+        sink2.emit({"kind": "span", "name": "compile", "seconds": 1.0})
+        sink2.emit({"kind": "iteration", "iter": 1, "loss": 0.5})
+        sink2.close()
+        assert open(path2).read().startswith("kind,name,seconds")
+
+    def test_logging_sink(self, caplog):
+        sink = LoggingSink()
+        with caplog.at_level(logging.INFO, logger="spark_agd_tpu"):
+            sink.emit({"kind": "iteration", "iter": 3, "loss": 0.25})
+        assert "iter=3" in caplog.text and "loss=0.25" in caplog.text
+
+    def test_validator_rejects_bad_records(self):
+        assert validate_record("nope")
+        assert validate_record({"schema_version": 1, "kind": "wat"})
+        missing = dict(schema.EXAMPLE_RUN_RECORD)
+        del missing["run_id"]
+        assert any("run_id" in e for e in validate_record(missing))
+        bad_iter = dict(schema.EXAMPLE_ITERATION_RECORD, iter=0)
+        assert any("1-based" in e for e in validate_record(bad_iter))
+        # bool must not satisfy an int-typed field
+        bad_bool = dict(schema.EXAMPLE_RUN_RECORD, n_devices=True)
+        assert validate_record(bad_bool)
+
+    def test_stamp_never_overwrites(self):
+        rec = schema.stamp({"run_id": "mine", "value": 1.0},
+                           tool="test")
+        assert rec["run_id"] == "mine" and rec["tool"] == "test"
+        assert validate_record(rec) == []
+
+    def test_selfcheck_cli(self, capsys):
+        assert obs_main(["--selfcheck"]) == 0
+        assert "PASSED" in capsys.readouterr().out
+
+    def test_sink_failure_is_isolated(self):
+        class Boom(InMemorySink):
+            def emit(self, record):
+                raise RuntimeError("boom")
+
+        mem = InMemorySink()
+        bus = EventBus([Boom(), mem])
+        bus.emit({"kind": "span"})
+        assert bus.sink_errors == 1
+        assert len(mem.records) == 1  # later sinks still fed
+
+
+class TestLiveStreaming:
+    def test_streams_one_record_per_iteration_bitwise(self, glm_problem):
+        data, w0 = glm_problem
+        tel = Telemetry()
+        _, hist, res = api.run(
+            data, LogisticGradient(), SquaredL2Updater(),
+            reg_param=0.1, convergence_tol=0.0, num_iterations=7,
+            initial_weights=w0, mesh=False, return_result=True,
+            telemetry=tel)
+        recs = tel.iterations("agd")
+        assert len(recs) == int(res.num_iters) == len(hist)
+        for i, rec in enumerate(recs):
+            assert rec["iter"] == i + 1
+            # bitwise: the callback carries the SAME traced value the
+            # loss history stores
+            assert np.float64(rec["loss"]) == np.float64(hist[i])
+            assert validate_record(rec) == []
+            assert rec["L"] > 0 and rec["step"] >= 0
+        # spans: transfer + AOT phase split + execute all recorded
+        snap = tel.registry.snapshot()
+        for phase in ("h2d_transfer", "compile", "execute"):
+            assert snap.get(f"{phase}.count", 0) >= 1, (phase, snap)
+        # the end-of-run summary record exists and validates
+        runs = [r for r in tel.records if r.get("kind") == "run"]
+        assert len(runs) == 1 and validate_record(runs[0]) == []
+        assert runs[0]["iters"] == int(res.num_iters)
+
+    def test_off_by_default_no_callback_in_hlo(self, glm_problem):
+        data, w0 = glm_problem
+        fit = api.make_runner(data, LogisticGradient(),
+                              SquaredL2Updater(), reg_param=0.1,
+                              num_iterations=7, mesh=False)
+        assert "callback" not in fit.lower_step(w0).as_text()
+
+    def test_telemetry_adds_callback_to_hlo(self, glm_problem):
+        data, w0 = glm_problem
+        fit = api.make_runner(data, LogisticGradient(),
+                              SquaredL2Updater(), reg_param=0.1,
+                              num_iterations=7, mesh=False,
+                              telemetry=Telemetry())
+        assert "callback" in fit.lower_step(w0).as_text()
+
+    def test_every_thins_stream(self, glm_problem):
+        data, w0 = glm_problem
+        tel = Telemetry(every=2)
+        _, hist = api.run(
+            data, LogisticGradient(), SquaredL2Updater(),
+            reg_param=0.1, convergence_tol=0.0, num_iterations=6,
+            initial_weights=w0, mesh=False, telemetry=tel)
+        recs = tel.iterations("agd")
+        assert [r["iter"] for r in recs] == [2, 4, 6]
+        # thinning bounds sink I/O, not the count of executed iterations
+        assert tel.registry.counter("agd.iterations").value == len(hist)
+
+    def test_lbfgs_stream_matches_history(self, glm_problem):
+        data, w0 = glm_problem
+        tel = Telemetry()
+        res = api.run_lbfgs(data, LogisticGradient(),
+                            SquaredL2Updater(), reg_param=0.1,
+                            num_iterations=10, initial_weights=w0,
+                            mesh=False, telemetry=tel)
+        k = int(res.num_iters)
+        hist = np.asarray(res.loss_history)
+        recs = tel.iterations("lbfgs")
+        assert len(recs) == k
+        for rec in recs:
+            # loss_history[i] is the objective after iteration i
+            assert np.float64(rec["loss"]) == np.float64(hist[rec["iter"]])
+
+    def test_verbose_logs_post_hoc(self, glm_problem, caplog):
+        data, w0 = glm_problem
+        with caplog.at_level(logging.INFO, logger="spark_agd_tpu"):
+            api.run(data, LogisticGradient(), SquaredL2Updater(),
+                    reg_param=0.1, num_iterations=4,
+                    convergence_tol=0.0, initial_weights=w0,
+                    mesh=False, verbose=True)
+        assert "iter=1 " in caplog.text
+        assert "Last 10 losses" in caplog.text
+
+    def test_jsonl_sink_end_to_end(self, glm_problem, tmp_path):
+        data, w0 = glm_problem
+        path = str(tmp_path / "stream.jsonl")
+        with Telemetry([JSONLSink(path)]) as tel:
+            api.run(data, LogisticGradient(), SquaredL2Updater(),
+                    reg_param=0.1, num_iterations=5,
+                    convergence_tol=0.0, initial_weights=w0,
+                    mesh=False, telemetry=tel)
+        recs = schema.read_jsonl(path)
+        kinds = {r["kind"] for r in recs}
+        assert {"iteration", "span", "run"} <= kinds
+        assert all(validate_record(r) == [] for r in recs)
+
+
+class TestMultihostGating:
+    def test_single_host_no_ops(self):
+        # gating must be the identity on one host: primary gate open,
+        # no tag, paths untouched
+        assert multihost.is_primary_host()
+        assert multihost.process_tag() == ""
+        assert multihost.host_suffixed("/tmp/run.jsonl") == "/tmp/run.jsonl"
+
+    def test_primary_mode_emits_on_single_host(self):
+        mem = InMemorySink()
+        bus = EventBus([mem], host_mode="primary")
+        bus.emit({"kind": "span"})
+        assert len(mem.records) == 1
+
+    def test_bad_host_mode_rejected(self):
+        with pytest.raises(ValueError):
+            EventBus([], host_mode="rank0")
+
+    def test_host_suffixed_on_multihost(self, monkeypatch):
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 2)
+        assert multihost.process_tag() == "h002"
+        assert multihost.host_suffixed("a/b.jsonl") == "a/b.h002.jsonl"
+
+
+class TestCompileCacheObservability:
+    def test_census_and_hit_miss_counters(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "cache")
+        monkeypatch.setenv("SPARK_AGD_COMPILE_CACHE", d)
+        import jax as jax_mod
+
+        orig_dir = jax_mod.config.jax_compilation_cache_dir
+        try:
+            reg = MetricsRegistry()
+            assert compile_cache.enable(d, min_compile_time_secs=0) == d
+            # a compile that lands a new cache entry counts as a miss
+            # (the census delta is the observable, not XLA internals —
+            # CPU backends may skip executable serialization, so the
+            # test writes the entry itself)
+            with compile_cache.observe_compile(d, registry=reg):
+                with open(os.path.join(d, "entry0"), "wb") as f:
+                    f.write(b"x" * 128)
+            assert reg.counter("compile_cache.misses").value == 1
+            # a compile that adds nothing is a hit
+            with compile_cache.observe_compile(d, registry=reg):
+                pass
+            assert reg.counter("compile_cache.hits").value == 1
+            assert reg.gauge("compile_cache.files").value == 1
+            assert reg.gauge("compile_cache.bytes").value == 128
+        finally:
+            jax_mod.config.update("jax_compilation_cache_dir", orig_dir)
+
+    def test_stats_empty_dir(self, tmp_path):
+        s = compile_cache.stats(str(tmp_path / "nope"))
+        assert s["files"] == 0 and s["bytes"] == 0
+
+
+class TestTimedStats:
+    def test_full_stats_and_back_compat(self):
+        f = jax.jit(lambda x: x * 2.0)
+        stats, out = profiling.timed_stats(f, jnp.float32(3.0),
+                                           warmup=1, repeats=3)
+        assert len(stats.times) == 3
+        assert stats.min_s <= stats.median_s <= stats.max_s
+        assert float(out) == 6.0
+        sec, out2 = profiling.timed(f, jnp.float32(3.0), repeats=3)
+        assert isinstance(sec, float) and float(out2) == 6.0
+
+    def test_span_event_per_repeat(self):
+        reg = MetricsRegistry()
+        got = []
+        reg.set_span_hook(lambda name, s: got.append(name))
+        f = jax.jit(lambda x: x + 1.0)
+        profiling.timed_stats(f, jnp.float32(0.0), warmup=0, repeats=4,
+                              registry=reg, name="bench.step")
+        assert got == ["bench.step"] * 4
+        assert reg.span("bench.step").count == 4
+
+
+class TestLoggingSchemaMigration:
+    def test_iteration_records_schema_mode(self, glm_problem):
+        data, w0 = glm_problem
+        _, hist, res = api.run(
+            data, LogisticGradient(), SquaredL2Updater(),
+            reg_param=0.1, num_iterations=4, convergence_tol=0.0,
+            initial_weights=w0, mesh=False, return_result=True)
+        legacy = ulog.iteration_records(res)
+        assert "kind" not in legacy[0]  # pre-schema shape preserved
+        recs = ulog.iteration_records(res, run_id="rX")
+        assert len(recs) == len(legacy)
+        assert all(validate_record(r) == [] for r in recs)
+        run_rec = ulog.result_run_record(res, run_id="rX")
+        assert validate_record(run_rec) == []
+        assert run_rec["iters"] == int(res.num_iters)
+
+    def test_write_result_jsonl(self, glm_problem, tmp_path):
+        data, w0 = glm_problem
+        _, _, res = api.run(
+            data, LogisticGradient(), SquaredL2Updater(),
+            reg_param=0.1, num_iterations=3, convergence_tol=0.0,
+            initial_weights=w0, mesh=False, return_result=True)
+        path = str(tmp_path / "run.jsonl")
+        run_id = ulog.write_result_jsonl(res, path)
+        recs = schema.read_jsonl(path)
+        assert recs[0]["kind"] == "run"
+        assert len(recs) == 1 + int(res.num_iters)
+        assert all(r["run_id"] == run_id for r in recs)
+
+
+def _load_agd_report():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "agd_report.py")
+    spec = importlib.util.spec_from_file_location("agd_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestAgdReport:
+    def test_smoke_on_generated_stream(self, glm_problem, tmp_path,
+                                       capsys):
+        data, w0 = glm_problem
+        path = str(tmp_path / "run.jsonl")
+        with Telemetry([JSONLSink(path)]) as tel:
+            api.run(data, LogisticGradient(), SquaredL2Updater(),
+                    reg_param=0.1, num_iterations=5,
+                    convergence_tol=0.0, initial_weights=w0,
+                    mesh=False, telemetry=tel)
+        report = _load_agd_report()
+        assert report.main([path, "--validate"]) == 0
+        out = capsys.readouterr().out
+        assert "runs (1)" in out
+        assert "iteration streams" in out
+        assert "spans" in out
+        assert "0 invalid" in out
+
+    def test_legacy_rows_and_bad_lines(self, tmp_path, capsys):
+        path = tmp_path / "legacy.jsonl"
+        path.write_text(
+            json.dumps({"iter": 1, "loss": 0.5}) + "\n"
+            + json.dumps({"iter": 2, "loss": 0.25}) + "\n"
+            + "not json\n"
+            + json.dumps({"final_loss": 0.25, "name": "cfg1"}) + "\n")
+        report = _load_agd_report()
+        assert report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "iteration streams" in out and "runs (1)" in out
+
+    def test_iters_to_eps(self):
+        report = _load_agd_report()
+        assert report.iters_to_eps([1.0, 0.5, 0.1, 0.1], 1e-3) == 3
+        assert report.iters_to_eps([float("nan")], 1e-3) is None
+
+
+class TestBenchmarksCanonicalSchema:
+    def test_out_records_validate(self, tmp_path, capsys):
+        from benchmarks import run as bench_run
+
+        out = tmp_path / "rec.json"
+        with pytest.raises(SystemExit) as exc:
+            bench_run.main(["--config", "1", "--scale", "0.0003",
+                            "--iters", "2", "--out", str(out)])
+        assert exc.value.code == 0
+        capsys.readouterr()
+        recs = schema.read_jsonl(str(out))
+        assert len(recs) == 1
+        assert recs[0]["kind"] == "run"
+        assert recs[0]["tool"] == "benchmarks.run"
+        assert validate_record(recs[0]) == []

@@ -41,9 +41,13 @@ class TestSweep:
                 prox.SquaredL2Updater(), reg_param=reg,
                 num_iterations=6, convergence_tol=0.0,
                 initial_weights=w0, mesh=False)
+            # atol 2e-5: near-zero weight components pick up absolute
+            # f32 drift from the vmapped (N,D)@(D,K) contraction's
+            # different reduction order vs the solo matvec (observed
+            # 1.0e-5 abs on the 0.4.x CPU toolchain)
             np.testing.assert_allclose(np.asarray(res.weights)[k],
                                        np.asarray(w_ref), rtol=2e-4,
-                                       atol=2e-6)
+                                       atol=2e-5)
             np.testing.assert_allclose(
                 np.asarray(res.loss_history)[k][:len(hist_ref)],
                 hist_ref, rtol=2e-4)

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Turn any run-record JSONL into a convergence / timing summary table.
+
+Consumes the canonical ``spark_agd_tpu.obs.schema`` record family —
+``run`` records (one per completed fit/benchmark: ``benchmarks/run.py
+--out``, ``bench.py``'s one-line contract, ``Telemetry.run_summary``),
+``iteration`` records (the live ``telemetry=`` stream or
+``utils.logging.write_result_jsonl``'s post-hoc twin), and ``span``
+records (phase timings) — plus legacy pre-schema rows (best-effort:
+anything with a ``final_loss``/``value`` is treated as a run row,
+anything with ``iter``+``loss`` as an iteration row).
+
+Usage::
+
+    python tools/agd_report.py RUN.jsonl [MORE.jsonl ...] [--eps 1e-3]
+
+Prints one table of run rows, one convergence summary per iteration
+stream (grouped by run_id), and a span-phase rollup.  Exit code 0 when
+every line parsed, 1 when nothing could be read.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def _load(paths: List[str]):
+    """(records, n_bad_lines): tolerant line-by-line JSONL parse."""
+    records, bad = [], 0
+    for path in paths:
+        try:
+            f = open(path)
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            bad += 1
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records, bad
+
+
+def _kind(rec: dict) -> Optional[str]:
+    k = rec.get("kind")
+    if k in ("run", "iteration", "span", "metrics"):
+        return k
+    # legacy pre-schema rows
+    if "iter" in rec and "loss" in rec:
+        return "iteration"
+    if "final_loss" in rec or "value" in rec or "error" in rec:
+        return "run"
+    return None
+
+
+def _fmt(v, nd=6) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "nan"
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def iters_to_eps(losses: List[float], eps: float) -> Optional[int]:
+    """First 1-based iteration within ``eps`` (relative) of the best
+    loss — the convergence summary's headline column (same target
+    definition as ``benchmarks/run.py``'s ``wall_to_eps``)."""
+    finite = [v for v in losses if v == v]  # drop NaN
+    if not finite:
+        return None
+    best = min(finite)
+    target = best + eps * abs(best)
+    for i, v in enumerate(losses):
+        if v == v and v <= target:
+            return i + 1
+    return None
+
+
+def summarize_runs(runs: List[dict]) -> str:
+    headers = ["run_id", "tool", "name", "algo", "platform", "iters",
+               "final_loss", "iters/s", "conv", "error"]
+    rows = []
+    for r in runs:
+        rows.append([
+            _fmt(r.get("run_id", "-"))[:18],
+            _fmt(r.get("tool")),
+            _fmt(r.get("name") or r.get("metric")),
+            _fmt(r.get("algorithm")),
+            _fmt(r.get("platform")),
+            _fmt(r.get("iters")),
+            _fmt(r.get("final_loss", r.get("value"))),
+            _fmt(r.get("iters_per_sec")),
+            _fmt(r.get("converged")),
+            _fmt(r.get("error"))[:40],
+        ])
+    return _table(headers, rows)
+
+
+def summarize_iterations(by_run: Dict[str, List[dict]],
+                         eps: float) -> str:
+    headers = ["run_id", "algo", "iters", "first_loss", "best_loss",
+               "final_loss", f"iters_to_eps({eps:g})", "restarts"]
+    rows = []
+    for run_id, recs in by_run.items():
+        recs = sorted(recs, key=lambda r: r.get("iter", 0))
+        losses = [float(r["loss"]) for r in recs]
+        restarts = sum(1 for r in recs if r.get("restarted"))
+        rows.append([
+            _fmt(run_id)[:18],
+            _fmt(recs[0].get("algorithm")),
+            str(len(recs)),
+            _fmt(losses[0]), _fmt(min(losses)), _fmt(losses[-1]),
+            _fmt(iters_to_eps(losses, eps)),
+            str(restarts),
+        ])
+    return _table(headers, rows)
+
+
+def summarize_spans(spans: List[dict]) -> str:
+    agg = defaultdict(list)
+    for s in spans:
+        agg[(s.get("run_id", "-"), s.get("name", "?"))].append(
+            float(s.get("seconds", 0.0)))
+    headers = ["run_id", "phase", "count", "total_s", "mean_s"]
+    rows = []
+    for (run_id, name), times in sorted(agg.items()):
+        rows.append([
+            _fmt(run_id)[:18], name, str(len(times)),
+            _fmt(sum(times), 4), _fmt(sum(times) / len(times), 4),
+        ])
+    return _table(headers, rows)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+", metavar="FILE.jsonl")
+    p.add_argument("--eps", type=float, default=1e-3,
+                   help="relative tolerance for the iters-to-eps "
+                        "convergence column (default 1e-3)")
+    p.add_argument("--validate", action="store_true",
+                   help="also validate each record against the "
+                        "canonical schema and report violations")
+    args = p.parse_args(argv)
+
+    records, bad = _load(args.paths)
+    if not records:
+        print("no records found", file=sys.stderr)
+        return 1
+    if bad:
+        print(f"note: {bad} unparsable line(s)/file(s) skipped",
+              file=sys.stderr)
+
+    runs, spans = [], []
+    iters_by_run: Dict[str, List[dict]] = defaultdict(list)
+    unknown = 0
+    for rec in records:
+        k = _kind(rec)
+        if k == "run":
+            runs.append(rec)
+        elif k == "iteration":
+            iters_by_run[rec.get("run_id", "-")].append(rec)
+        elif k == "span":
+            spans.append(rec)
+        elif k is None:
+            unknown += 1
+
+    if runs:
+        print(f"== runs ({len(runs)}) ==")
+        print(summarize_runs(runs))
+    if iters_by_run:
+        n = sum(len(v) for v in iters_by_run.values())
+        print(f"\n== iteration streams ({len(iters_by_run)} run(s), "
+              f"{n} records) ==")
+        print(summarize_iterations(iters_by_run, args.eps))
+    if spans:
+        print(f"\n== spans ({len(spans)}) ==")
+        print(summarize_spans(spans))
+    if unknown:
+        print(f"\nnote: {unknown} record(s) of unknown shape ignored")
+
+    if args.validate:
+        try:
+            from spark_agd_tpu.obs import schema as obs_schema
+        except ImportError as e:
+            print(f"--validate unavailable: {e}", file=sys.stderr)
+            return 1
+        n_bad = 0
+        for i, rec in enumerate(records, 1):
+            errs = obs_schema.validate_record(rec)
+            if errs:
+                n_bad += 1
+                print(f"record {i}: {'; '.join(errs)}")
+        print(f"\nvalidation: {len(records)} records, {n_bad} invalid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
