@@ -1330,17 +1330,30 @@ _EMITTED = False
 def _stamp_schema(rec):
     """Stamp the one-line record as a canonical ``obs.schema`` run
     record (schema_version/kind/run_id/tool added, nothing overwritten —
-    the replay path and every existing BENCH_* reader see a superset).
-    Failure-isolated: the one-parseable-line contract survives a broken
-    import."""
+    the replay path and every existing BENCH_* reader see a superset),
+    plus environment provenance (jax/jaxlib versions, backend, device
+    kind/count — what ``tools/perf_gate.py`` refuses cross-environment
+    comparisons on).  Failure-isolated: the one-parseable-line contract
+    survives a broken import, and the provenance block survives a dead
+    backend (it only ever ADDS keys, setdefault semantics)."""
     try:
         from spark_agd_tpu.obs import schema
 
-        return schema.stamp(rec, tool="bench")
+        rec = schema.stamp(rec, tool="bench")
     except Exception as e:  # noqa: BLE001 — stamping is metadata, never
         # a gate on the emission contract
         log(f"schema stamp unavailable: {type(e).__name__}: {e}")
         return rec
+    try:
+        from spark_agd_tpu.obs import introspect
+
+        fp = introspect.environment_fingerprint(only_if_initialized=True)
+        for k, v in fp.items():
+            rec.setdefault(k, v)
+    except Exception as e:  # noqa: BLE001 — a wedged backend must not
+        # cost the record its measured fields
+        log(f"env fingerprint unavailable: {type(e).__name__}: {e}")
+    return rec
 
 
 def _emit_once(rec):

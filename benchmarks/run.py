@@ -31,7 +31,7 @@ import numpy as np
 from spark_agd_tpu import api
 from spark_agd_tpu.core import lbfgs as lbfgs_core
 from spark_agd_tpu.models import mlp as mlp_lib
-from spark_agd_tpu.obs import schema
+from spark_agd_tpu.obs import introspect, schema
 from spark_agd_tpu.ops import losses, prox
 
 from . import datasets
@@ -583,8 +583,14 @@ def main(argv=None):
             # record (schema_version/kind/run_id/tool added, existing
             # keys untouched), so BENCH_* files from different rounds
             # are machine-comparable; stdout and --out carry the SAME
-            # stamped dict
+            # stamped dict.  Environment provenance (jax/jaxlib
+            # versions, backend, device kind/count) rides every record
+            # so tools/perf_gate.py can refuse cross-environment
+            # comparisons — setdefault semantics keep the measured
+            # platform/n_devices fields authoritative.
             rec = schema.stamp(rec, tool="benchmarks.run")
+            for k, v in introspect.environment_fingerprint().items():
+                rec.setdefault(k, v)
             print(json.dumps(rec), flush=True)
             if out_f:
                 out_f.write(json.dumps(rec) + "\n")

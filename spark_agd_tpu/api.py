@@ -170,12 +170,23 @@ def _make_instrumented_fit(step, place_w, dargs, telemetry):
     metric instead of being smeared into the first execute (the r3/r4
     compile wedges were exactly this opacity); if this backend cannot
     AOT-compile the program the fit falls back to the plain jit call and
-    ``execute`` absorbs the compile."""
+    ``execute`` absorbs the compile.
+
+    Every phase additionally runs under a matching profiler
+    ``TraceAnnotation``, and when the telemetry carries a
+    ``profile_dir`` the first ``execute`` is captured as a device-
+    timeline trace (``utils.profiling.OneShotTrace``) — so the span
+    timers and the profiler timeline line up by name."""
+    from .utils import profiling
+
     _AOT_FAILED = object()
     cache = {}
+    capture = profiling.OneShotTrace(
+        getattr(telemetry, "profile_dir", None))
 
     def fit(initial_weights):
-        with telemetry.span("h2d_transfer"):
+        with telemetry.span("h2d_transfer"), \
+                profiling.annotate("h2d_transfer"):
             w = place_w(initial_weights)
         leaves = jax.tree_util.tree_leaves(w)
         key = (jax.tree_util.tree_structure(w),
@@ -183,15 +194,18 @@ def _make_instrumented_fit(step, place_w, dargs, telemetry):
         exe = cache.get(key)
         if exe is None:
             try:
-                with telemetry.span("trace"):
+                with telemetry.span("trace"), \
+                        profiling.annotate("trace"):
                     lowered = step.lower(w, dargs)
-                with telemetry.span("compile"):
+                with telemetry.span("compile"), \
+                        profiling.annotate("compile"):
                     exe = lowered.compile()
             except Exception:  # noqa: BLE001 — AOT unsupported here;
                 # the jit path below still runs (and compiles) fine
                 exe = _AOT_FAILED
             cache[key] = exe
-        with telemetry.span("execute"):
+        with capture(), telemetry.span("execute"), \
+                profiling.annotate("execute"):
             if exe is _AOT_FAILED:
                 res = step(w, dargs)
             else:
@@ -1154,6 +1168,12 @@ def make_lbfgs_runner(
     # which driver the dispatch chose — reporting callers (benchmarks)
     # must label numbers with the REAL dispatch, not re-derive it
     fit.algorithm = algorithm
+    # the same AOT introspection surface as make_runner, so
+    # obs.introspect.analyze_runner censuses the quasi-Newton member's
+    # ONE program too (FLOPs / HBM / collectives of the fused loop)
+    fit.lower_step = lambda w0: step.lower(_place_w(w0), dargs)
+    fit.jitted_step = step
+    fit.data_args = dargs
     return fit
 
 

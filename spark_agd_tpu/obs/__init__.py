@@ -11,6 +11,12 @@ Three layers (see ``docs/OBSERVABILITY.md`` for the guide):
    record family every producer stamps (``benchmarks/run.py``,
    ``bench.py``, ``utils/logging.py``) and ``tools/agd_report.py``
    consumes.  ``python -m spark_agd_tpu.obs --selfcheck`` validates it.
+4. **Compiled-program introspection + perf gate** (``obs.introspect`` /
+   ``obs.perfgate``): ``ProgramCost`` census of any runner's compiled
+   program (FLOPs, HBM footprint, per-collective counts) emitted as
+   ``program_cost`` records, and the regression gate
+   (``tools/perf_gate.py``) that compares candidate run-record JSONLs
+   against a baseline on wall clock AND compiled-program facts.
 
 The headline consumer is **live in-loop streaming**: pass
 ``telemetry=Telemetry(...)`` to ``api.run`` / ``api.make_runner`` (or
@@ -38,11 +44,22 @@ from .sinks import (  # noqa: F401
     TensorBoardSink,
 )
 from .telemetry import Telemetry  # noqa: F401
-from . import schema  # noqa: F401
+from . import introspect, perfgate, schema  # noqa: F401
+from .introspect import (  # noqa: F401
+    ProgramCost,
+    analyze,
+    analyze_compiled,
+    analyze_runner,
+    collective_census,
+    count_ops,
+    environment_fingerprint,
+)
 from .schema import (  # noqa: F401
     SCHEMA_VERSION,
     iteration_record,
     new_run_id,
+    numerics_failure_record,
+    program_cost_record,
     read_jsonl,
     run_record,
     span_record,

@@ -1,0 +1,266 @@
+"""Compiled-program introspection: what did XLA actually build?
+
+PR 1 made *runtime* behaviour observable (live iteration streams, span
+timers, one record schema).  This module makes the *compiled program
+itself* first-class observability data — the facts every perf PR is
+judged by, which until now lived only as ad-hoc assertions in
+``tests/test_hlo_cost_shape.py``:
+
+- **FLOP / bytes-accessed estimates** from XLA's cost model
+  (``jax.stages.Compiled.cost_analysis()``);
+- **HBM footprint** — argument / output / temp / generated-code sizes
+  from ``memory_analysis()``, plus a derived peak;
+- **collective census** — all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all counts straight from the optimized
+  HLO text (the public home of the op-counting helper the HLO guard
+  tests pioneered).
+
+Everything lands in one :class:`ProgramCost` record, serializable as
+the ``program_cost`` kind of the canonical ``obs.schema`` — so a
+run-record JSONL can carry the compiled program's cost model next to
+its wall-clock numbers, and ``obs.perfgate`` can gate on *both* (the
+MLPerf-on-TPU-pod lesson: regression tracking must be tied to the
+compiled program, not just wall clock).
+
+Entry points, by what you hold:
+
+- ``analyze_runner(fit, w0)`` — an ``api.make_runner`` /
+  ``api.make_lbfgs_runner`` fit (uses its ``lower_step`` AOT hook);
+- ``analyze(fn, *args)`` — any jittable callable (a ``dist_smooth``
+  smooth, a ``feature_sharded`` eval, …): jits, lowers, compiles,
+  without executing;
+- ``analyze_lowered(lowered)`` / ``analyze_compiled(compiled)`` — the
+  ``jax.stages`` objects themselves (e.g. ``parallel.grid``'s
+  ``fit.lower`` hook).
+
+CPU-deterministic: the XLA CPU backend reports the same cost-analysis
+families, so all of this unit-tests without hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+# The ops the census counts — every cross-device collective XLA emits
+# for the programs in this repo (the HLO guard tests' union, made the
+# one public source of truth).
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+# host round-trip ops — the fused design's forbidden list
+# (tests/test_hlo_cost_shape.py::test_no_host_transfers_in_loop)
+HOST_TRANSFER_OPS = ("outfeed", "infeed", "send", "recv")
+
+
+def count_ops(hlo: str, name: str) -> int:
+    """Occurrences of HLO op ``name`` in optimized-HLO text (async
+    ``-start`` forms counted once, ``-done`` ignored)."""
+    return sum(1 for line in hlo.splitlines()
+               if f" {name}(" in line or f" {name}-start(" in line)
+
+
+def collective_census(hlo: str) -> Dict[str, int]:
+    """Per-collective op counts for one program's HLO text."""
+    return {op: count_ops(hlo, op) for op in COLLECTIVE_OPS}
+
+
+def hlo_text(fn: Callable, *args) -> str:
+    """Optimized HLO of ``fn(*args)`` — lowered and compiled, never
+    executed.  ``fn`` may already be jitted (anything with ``.lower``)."""
+    import jax
+
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    return fn.lower(*args).compile().as_text()
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramCost:
+    """One compiled program's cost/memory/collective accounting.
+
+    ``None`` fields mean the backend did not report that family (e.g. a
+    backend without a cost model); the collective census always exists
+    because it comes from the HLO text itself.  ``peak_hbm_bytes`` is
+    the backend's peak when reported, else the argument+output+temp
+    sum — an upper bound on live HBM, the quantity the one-chip-scale
+    decisions in ``benchmarks/run.py`` are sized against."""
+
+    label: str
+    backend: str
+    flops: Optional[float]
+    transcendentals: Optional[float]
+    bytes_accessed: Optional[float]
+    argument_bytes: Optional[int]
+    output_bytes: Optional[int]
+    temp_bytes: Optional[int]
+    alias_bytes: Optional[int]
+    generated_code_bytes: Optional[int]
+    peak_hbm_bytes: Optional[int]
+    collectives: Dict[str, int]
+    hlo_bytes: int
+
+    @property
+    def n_collectives(self) -> int:
+        return sum(self.collectives.values())
+
+    def record(self, run_id: str, **fields) -> dict:
+        """This cost as a canonical ``program_cost`` record."""
+        from . import schema
+
+        return schema.program_cost_record(
+            run_id, self.label, self.collectives,
+            backend=self.backend, flops=self.flops,
+            transcendentals=self.transcendentals,
+            bytes_accessed=self.bytes_accessed,
+            argument_bytes=self.argument_bytes,
+            output_bytes=self.output_bytes,
+            temp_bytes=self.temp_bytes,
+            alias_bytes=self.alias_bytes,
+            generated_code_bytes=self.generated_code_bytes,
+            peak_hbm_bytes=self.peak_hbm_bytes,
+            hlo_bytes=self.hlo_bytes, **fields)
+
+
+def _cost_dict(compiled) -> dict:
+    """Flatten ``cost_analysis()``'s version-dependent shapes (dict,
+    list-of-dict, or None/raise on cost-model-less backends) to one
+    dict."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — no cost model on this backend
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def _opt_int(v) -> Optional[int]:
+    return None if v is None else int(v)
+
+
+def analyze_compiled(compiled, label: str = "program") -> ProgramCost:
+    """:class:`ProgramCost` of a ``jax.stages.Compiled``."""
+    hlo = compiled.as_text()
+    cost = _cost_dict(compiled)
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — analysis is optional per backend
+        mem = None
+
+    def ga(name):
+        return _opt_int(getattr(mem, name, None)) if mem is not None \
+            else None
+
+    arg_b = ga("argument_size_in_bytes")
+    out_b = ga("output_size_in_bytes")
+    tmp_b = ga("temp_size_in_bytes")
+    gen_b = ga("generated_code_size_in_bytes")
+    peak = ga("peak_memory_in_bytes")
+    if peak is None and None not in (arg_b, out_b, tmp_b):
+        peak = arg_b + out_b + tmp_b
+    try:
+        backend = compiled.runtime_executable().platform
+    except Exception:  # noqa: BLE001
+        import jax
+
+        backend = jax.default_backend()
+    flops = cost.get("flops")
+    return ProgramCost(
+        label=label, backend=str(backend),
+        flops=None if flops is None else float(flops),
+        transcendentals=(None if cost.get("transcendentals") is None
+                         else float(cost["transcendentals"])),
+        bytes_accessed=(None if cost.get("bytes accessed") is None
+                        else float(cost["bytes accessed"])),
+        argument_bytes=arg_b, output_bytes=out_b, temp_bytes=tmp_b,
+        alias_bytes=ga("alias_size_in_bytes"),
+        generated_code_bytes=gen_b, peak_hbm_bytes=peak,
+        collectives=collective_census(hlo), hlo_bytes=len(hlo))
+
+
+def analyze_lowered(lowered, label: str = "program") -> ProgramCost:
+    """Compile a ``jax.stages.Lowered`` and analyze it."""
+    return analyze_compiled(lowered.compile(), label=label)
+
+
+def analyze(fn: Callable, *args, label: Optional[str] = None
+            ) -> ProgramCost:
+    """Lower+compile ``fn(*args)`` (never executed) and analyze the
+    program.  ``fn`` may already be jitted."""
+    import jax
+
+    if label is None:
+        label = getattr(fn, "__name__", "program")
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    return analyze_lowered(fn.lower(*args), label=label)
+
+
+def analyze_runner(fit: Any, w0, label: Optional[str] = None
+                   ) -> ProgramCost:
+    """Census of the ONE program an ``api.make_runner`` /
+    ``api.make_lbfgs_runner`` fit executes, via its ``lower_step`` AOT
+    hook — the same program ``fit(w0)`` runs, so the numbers are the
+    runner's, not a parallel reimplementation's."""
+    lower = getattr(fit, "lower_step", None)
+    if lower is None:
+        raise TypeError(
+            "fit has no lower_step AOT hook; pass an api.make_runner / "
+            "api.make_lbfgs_runner fit, or use introspect.analyze(fn, "
+            "*args) on the callable directly")
+    if label is None:
+        label = getattr(fit, "algorithm", "agd")
+    return analyze_lowered(lower(w0), label=label)
+
+
+def _backend_initialized() -> bool:
+    """Whether a jax backend already exists (so ``jax.devices()`` is a
+    cache read, not an instantiation that could hang on a wedged
+    accelerator tunnel — the AVAILABILITY.md failure mode the bench
+    watchdog exists for)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # noqa: BLE001 — private surface moved; can't
+        # tell, so let the caller proceed normally
+        return True
+
+
+def environment_fingerprint(mesh=None, *,
+                            only_if_initialized: bool = False) -> dict:
+    """The run-record environment-provenance fields: jax/jaxlib
+    versions, backend, device kind/count, process count, and (given a
+    ``Mesh``) the mesh shape — what ``obs.perfgate`` refuses to compare
+    across.
+
+    Touches the backend (``jax.devices()``) — unless
+    ``only_if_initialized=True`` and no backend exists yet, in which
+    case only the version fields are returned (the bench watchdog's
+    error path must never block on instantiating a wedged backend)."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:  # pragma: no cover — jax implies jaxlib
+        jaxlib_version = "unknown"
+    out = {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+    }
+    if only_if_initialized and not _backend_initialized():
+        return out
+    devs = jax.devices()
+    out.update({
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "n_devices": len(devs),
+        "n_processes": jax.process_count(),
+    })
+    if mesh is not None:
+        out["mesh_shape"] = {str(k): int(v)
+                             for k, v in dict(mesh.shape).items()}
+    return out

@@ -1,0 +1,338 @@
+"""Perf-regression gate over canonical run-record JSONLs.
+
+The BENCH_* trajectory (``BENCH_r01..r05.json``) accumulated five
+rounds of stamped run records with no automated way to say "this PR
+made the hot path slower".  This module is that answer: compare a
+candidate JSONL against a baseline JSONL, record-by-record, on the
+metrics that define "fast as the hardware allows" —
+
+- **wall clock**: ``wall_to_eps_s`` (and its capped twin), ``wall_s``,
+  ``iters_per_sec``, ``compile_s``;
+- **iterations-to-tolerance**: ``iters`` when both runs stopped under
+  their own rule (``converged``);
+- **compiled-program facts** (``program_cost`` records, from
+  ``obs.introspect``): FLOPs, bytes accessed, peak HBM, and
+  per-collective counts — the MLPerf-on-TPU-pod lesson that regression
+  tracking must be tied to the compiled program's cost model, not just
+  wall clock.
+
+Records pair by a stable identity key (tool / name / config /
+algorithm / dtype / pallas for runs; label / algorithm for program
+costs).  Relative thresholds are configurable per metric; collective
+counts gate on an *absolute* allowed increase (default 0 — a new
+collective in the hot program is never noise).  Environments must
+match: a gate between records whose provenance fields (platform,
+device kind/count, jax/jaxlib version, mesh shape) differ is refused
+unless explicitly allowed — cross-environment "regressions" are
+hardware deltas, not code deltas.
+
+Deliberately dependency-free (stdlib only), like ``obs.schema``: the
+CI entry point ``tools/perf_gate.py`` must run anywhere the artifacts
+exist, with or without a working jax install.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import schema
+
+# metric -> (direction, default relative threshold).  direction "lower"
+# = smaller is better; "higher" = larger is better.  The candidate
+# regresses when it is worse by more than the threshold (relative to
+# the baseline value).
+RUN_METRICS: Dict[str, Tuple[str, float]] = {
+    "wall_to_eps_s": ("lower", 0.15),
+    "wall_to_eps_capped": ("lower", 0.15),
+    "wall_s": ("lower", 0.15),
+    "compile_s": ("lower", 0.50),
+    "iters_per_sec": ("higher", 0.15),
+    "iters_to_tol": ("lower", 0.10),
+}
+
+PROGRAM_METRICS: Dict[str, Tuple[str, float]] = {
+    "flops": ("lower", 0.01),
+    "bytes_accessed": ("lower", 0.05),
+    "peak_hbm_bytes": ("lower", 0.05),
+    "temp_bytes": ("lower", 0.10),
+}
+
+# absolute allowed increase in each collective's op count (default 0)
+COLLECTIVES_METRIC = "collectives"
+DEFAULT_COLLECTIVE_SLACK = 0.0
+
+# run-record fields that define the measurement environment; a
+# mismatch on any present-on-both-sides field refuses the comparison
+ENV_FIELDS = ("platform", "device_kind", "n_devices", "jax_version",
+              "jaxlib_version", "n_processes", "mesh_shape")
+
+_RUN_KEY_FIELDS = ("tool", "name", "config", "algorithm", "dtype",
+                   "pallas")
+_PROGRAM_KEY_FIELDS = ("label", "algorithm", "tool")
+
+
+@dataclasses.dataclass
+class Delta:
+    """One compared metric on one paired record."""
+
+    key: str
+    metric: str
+    baseline: Optional[float]
+    candidate: Optional[float]
+    rel_change: Optional[float]  # signed; + = candidate worse
+    threshold: float
+    status: str  # "ok" | "regression" | "improved" | "skipped"
+
+
+@dataclasses.dataclass
+class GateResult:
+    deltas: List[Delta]
+    env_mismatches: List[str]
+    unmatched_baseline: List[str]
+    unmatched_candidate: List[str]
+    allow_cross_env: bool = False
+
+    @property
+    def regressions(self) -> List[Delta]:
+        return [d for d in self.deltas if d.status == "regression"]
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.env_mismatches) and not self.allow_cross_env
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions and not self.refused
+
+    def exit_code(self) -> int:
+        """0 pass, 1 regression, 2 refused (cross-environment)."""
+        if self.refused:
+            return 2
+        return 1 if self.regressions else 0
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "nan"
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _table(headers: Sequence[str], rows: List[Sequence[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+
+    def line(cells):
+        return "  ".join(c.ljust(w)
+                         for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def format_deltas(deltas: List[Delta], *,
+                  only_compared: bool = False) -> str:
+    """Human-readable diff table (the gate's failure output and
+    ``tools/agd_report.py --compare``'s body)."""
+    headers = ["key", "metric", "baseline", "candidate", "change",
+               "threshold", "status"]
+    rows = []
+    for d in deltas:
+        if only_compared and d.status == "skipped":
+            continue
+        change = ("-" if d.rel_change is None
+                  # collective deltas are absolute op counts, the rest
+                  # relative
+                  else f"{d.rel_change:+g}"
+                  if d.metric.startswith("collectives.")
+                  else f"{d.rel_change:+.1%}")
+        rows.append([d.key, d.metric, _fmt(d.baseline),
+                     _fmt(d.candidate), change,
+                     f"{d.threshold:g}", d.status])
+    if not rows:
+        return "(no comparable metrics)"
+    return _table(headers, rows)
+
+
+def _key(rec: dict, fields) -> str:
+    parts = [f"{f}={rec[f]}" for f in fields if rec.get(f) is not None]
+    return " ".join(parts) if parts else "(unkeyed)"
+
+
+def _split(records: List[dict]):
+    """(run_records, program_cost_records) keyed by identity; multiple
+    records per key keep the LAST (the freshest measurement in an
+    append-style artifact)."""
+    runs: Dict[str, dict] = {}
+    progs: Dict[str, dict] = {}
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        if kind == "program_cost":
+            progs[_key(rec, _PROGRAM_KEY_FIELDS)] = rec
+        elif kind == "run" or (kind is None and (
+                "final_loss" in rec or "iters_per_sec" in rec)):
+            # pre-schema BENCH rows gate too (legacy best-effort, like
+            # tools/agd_report.py)
+            runs[_key(rec, _RUN_KEY_FIELDS)] = rec
+    return runs, progs
+
+
+def _num(rec: dict, field: str) -> Optional[float]:
+    v = rec.get(field)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    if isinstance(v, float) and math.isnan(v):
+        return None
+    return float(v)
+
+
+def _run_metric(rec: dict, metric: str) -> Optional[float]:
+    if metric == "iters_to_tol":
+        # only a tolerance claim when the run stopped under its own
+        # rule — an iteration-capped count is the cap, not convergence
+        if rec.get("converged") is not True:
+            return None
+        return _num(rec, "iters")
+    return _num(rec, metric)
+
+
+def environment_mismatches(base: dict, cand: dict,
+                           key: str) -> List[str]:
+    """Provenance fields present on BOTH sides that disagree."""
+    out = []
+    for f in ENV_FIELDS:
+        b, c = base.get(f), cand.get(f)
+        if b is not None and c is not None and b != c:
+            out.append(f"{key}: {f} differs (baseline {b!r} vs "
+                       f"candidate {c!r})")
+    return out
+
+
+def _compare_metric(key, metric, direction, b, c, threshold,
+                    deltas: List[Delta]):
+    if b is None or c is None:
+        deltas.append(Delta(key, metric, b, c, None, threshold,
+                            "skipped"))
+        return
+    if b == 0:
+        rel = 0.0 if c == 0 else math.inf * (1 if c > 0 else -1)
+    else:
+        rel = (c - b) / abs(b)
+    if direction == "higher":
+        rel = -rel  # normalize: positive rel_change = worse
+    status = ("regression" if rel > threshold
+              else "improved" if rel < -threshold else "ok")
+    deltas.append(Delta(key, metric, b, c, rel, threshold, status))
+
+
+def compare_records(
+    baseline: List[dict],
+    candidate: List[dict],
+    *,
+    thresholds: Optional[Dict[str, float]] = None,
+    collective_slack: float = DEFAULT_COLLECTIVE_SLACK,
+    allow_cross_env: bool = False,
+) -> GateResult:
+    """The comparison core: pair records by identity key, compare every
+    gated metric, and collect environment mismatches.  ``thresholds``
+    overrides per-metric defaults (relative); ``collective_slack`` is
+    the absolute op-count increase allowed per collective."""
+    thresholds = dict(thresholds or {})
+    b_runs, b_progs = _split(baseline)
+    c_runs, c_progs = _split(candidate)
+
+    deltas: List[Delta] = []
+    env_bad: List[str] = []
+
+    for key in sorted(set(b_runs) & set(c_runs)):
+        b, c = b_runs[key], c_runs[key]
+        env_bad.extend(environment_mismatches(b, c, key))
+        for metric, (direction, default_thr) in RUN_METRICS.items():
+            thr = thresholds.get(metric, default_thr)
+            _compare_metric(key, metric, direction,
+                            _run_metric(b, metric),
+                            _run_metric(c, metric), thr, deltas)
+
+    for key in sorted(set(b_progs) & set(c_progs)):
+        b, c = b_progs[key], c_progs[key]
+        for metric, (direction, default_thr) in PROGRAM_METRICS.items():
+            thr = thresholds.get(metric, default_thr)
+            _compare_metric(key, metric, direction, _num(b, metric),
+                            _num(c, metric), thr, deltas)
+        slack = thresholds.get(COLLECTIVES_METRIC, collective_slack)
+        bc = b.get("collectives") or {}
+        cc = c.get("collectives") or {}
+        for op in sorted(set(bc) | set(cc)):
+            bn = float(bc.get(op, 0) or 0)
+            cn = float(cc.get(op, 0) or 0)
+            worse = cn - bn
+            status = ("regression" if worse > slack
+                      else "improved" if worse < -slack else "ok")
+            rel = None if bn == 0 and cn == 0 else worse
+            deltas.append(Delta(key, f"collectives.{op}", bn, cn, rel,
+                                slack, status))
+
+    unmatched_b = sorted((set(b_runs) - set(c_runs))
+                         | (set(b_progs) - set(c_progs)))
+    unmatched_c = sorted((set(c_runs) - set(b_runs))
+                         | (set(c_progs) - set(b_progs)))
+    return GateResult(deltas=deltas, env_mismatches=env_bad,
+                      unmatched_baseline=unmatched_b,
+                      unmatched_candidate=unmatched_c,
+                      allow_cross_env=allow_cross_env)
+
+
+def load_records(path: str) -> List[dict]:
+    """Tolerant JSONL load (non-dict lines dropped; malformed JSON
+    raises ``ValueError`` naming the line, via ``schema.read_jsonl``)."""
+    return [r for r in schema.read_jsonl(path) if isinstance(r, dict)]
+
+
+def gate_files(baseline_path: str, candidate_path: str,
+               **kwargs) -> GateResult:
+    """File-level convenience: :func:`compare_records` over two
+    JSONLs."""
+    return compare_records(load_records(baseline_path),
+                           load_records(candidate_path), **kwargs)
+
+
+def format_report(result: GateResult, *, verbose: bool = False) -> str:
+    """The gate's full human-readable report."""
+    lines: List[str] = []
+    if result.env_mismatches:
+        head = ("ENVIRONMENT MISMATCH (comparison "
+                + ("allowed by --allow-cross-env"
+                   if result.allow_cross_env else "REFUSED") + "):")
+        lines.append(head)
+        lines.extend("  " + m for m in result.env_mismatches)
+        lines.append("")
+    reg = result.regressions
+    shown = result.deltas if verbose else [
+        d for d in result.deltas if d.status != "skipped"]
+    if reg:
+        lines.append(f"PERF GATE: {len(reg)} regression(s)")
+    elif not result.refused:
+        n = sum(1 for d in result.deltas if d.status != "skipped")
+        lines.append(f"PERF GATE: pass ({n} metric(s) compared)")
+    if shown:
+        lines.append(format_deltas(shown))
+    elif not result.deltas:
+        lines.append("no paired records — nothing compared")
+    for name, keys in (("baseline", result.unmatched_baseline),
+                       ("candidate", result.unmatched_candidate)):
+        if keys:
+            lines.append(f"note: {len(keys)} {name}-only record "
+                         f"key(s) not compared: "
+                         + "; ".join(keys[:4])
+                         + (" …" if len(keys) > 4 else ""))
+    return "\n".join(lines)

@@ -35,9 +35,11 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 SCHEMA_VERSION = 1
 
-KINDS = ("run", "iteration", "span", "metrics")
+KINDS = ("run", "iteration", "span", "metrics", "program_cost",
+         "numerics_failure")
 
 _NUM = (int, float)
+_OPT_NUM = _NUM + (type(None),)
 
 # kind -> {field: allowed types}; None in a tuple permits JSON null
 _REQUIRED: Dict[str, dict] = {
@@ -46,22 +48,48 @@ _REQUIRED: Dict[str, dict] = {
                   "loss": _NUM},
     "span": {"run_id": str, "name": str, "seconds": _NUM},
     "metrics": {"run_id": str, "metrics": dict},
+    # one compiled program's cost/memory/collective accounting
+    # (obs.introspect.ProgramCost); ``label`` is the pairing key the
+    # perf gate matches baseline/candidate programs on
+    "program_cost": {"run_id": str, "label": str, "collectives": dict},
+    # a sanitizer hit (utils.debug) or an in-loop non-finite loss,
+    # landed in the same JSONL as the metrics it poisoned
+    "numerics_failure": {"run_id": str, "message": str},
 }
 
 _OPTIONAL: Dict[str, dict] = {
     "run": {
         "algorithm": str, "name": str, "platform": str,
         "device_kind": str, "n_devices": int, "iters": int,
-        "final_loss": _NUM + (type(None),), "converged": bool,
-        "iters_per_sec": _NUM + (type(None),),
+        "final_loss": _OPT_NUM, "converged": bool,
+        "iters_per_sec": _OPT_NUM,
         "wall_s": _NUM, "compile_s": _NUM,
         "error": (str, type(None)), "metrics": dict,
+        # environment provenance (obs.introspect.environment_
+        # fingerprint) — the fields the perf gate refuses to compare
+        # across
+        "jax_version": str, "jaxlib_version": str,
+        "n_processes": int, "mesh_shape": dict,
     },
     "iteration": {"L": _NUM, "theta": _NUM, "step": _NUM,
                   "restarted": bool, "accepted": bool,
                   "timestamp_unix": _NUM},
     "span": {"timestamp_unix": _NUM},
     "metrics": {"timestamp_unix": _NUM, "tool": str},
+    "program_cost": {
+        "flops": _OPT_NUM, "transcendentals": _OPT_NUM,
+        "bytes_accessed": _OPT_NUM,
+        "argument_bytes": _OPT_NUM, "output_bytes": _OPT_NUM,
+        "temp_bytes": _OPT_NUM, "alias_bytes": _OPT_NUM,
+        "generated_code_bytes": _OPT_NUM, "peak_hbm_bytes": _OPT_NUM,
+        "hlo_bytes": int, "backend": str, "algorithm": str,
+        "tool": str, "timestamp_unix": _NUM,
+    },
+    "numerics_failure": {
+        "leaf": (str, type(None)), "iter": int, "evaluation": int,
+        "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
+    },
 }
 
 _run_counter = itertools.count()
@@ -164,6 +192,23 @@ def metrics_record(run_id: str, metrics: dict, *,
     return rec
 
 
+def program_cost_record(run_id: str, label: str, collectives: dict,
+                        **fields) -> dict:
+    """One compiled program's cost accounting; ``collectives`` maps
+    collective op name -> count (``obs.introspect.collective_census``)."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "program_cost",
+            "run_id": run_id, "label": label,
+            "collectives": dict(collectives), **fields}
+
+
+def numerics_failure_record(run_id: str, message: str,
+                            **fields) -> dict:
+    """A non-finite hit: ``leaf`` names the first failing quantity when
+    known, ``iter``/``evaluation`` locate it in the run."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "numerics_failure",
+            "run_id": run_id, "message": message, **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -202,6 +247,24 @@ EXAMPLE_SPAN_RECORD = {
     "run_id": "r18c2d3e4-1a2b-0", "name": "compile", "seconds": 1.25,
 }
 
+EXAMPLE_PROGRAM_COST_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "program_cost",
+    "run_id": "r18c2d3e4-1a2b-0", "label": "agd", "algorithm": "agd",
+    "flops": 528383.0, "bytes_accessed": 65580.0,
+    "argument_bytes": 16384, "output_bytes": 4, "temp_bytes": 16400,
+    "peak_hbm_bytes": 32788, "backend": "cpu",
+    "collectives": {"all-reduce": 3, "all-gather": 0,
+                    "reduce-scatter": 0, "collective-permute": 0,
+                    "all-to-all": 0},
+}
+
+EXAMPLE_NUMERICS_FAILURE_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "numerics_failure",
+    "run_id": "r18c2d3e4-1a2b-0",
+    "message": "smooth: gradient leaf ['w'] non-finite",
+    "leaf": "['w']", "evaluation": 3, "source": "smooth",
+}
+
 
 def selfcheck() -> Tuple[bool, List[str]]:
     """Validate the example records, a JSON round-trip, and a negative
@@ -211,7 +274,10 @@ def selfcheck() -> Tuple[bool, List[str]]:
     ok = True
     for name, rec in (("run", EXAMPLE_RUN_RECORD),
                       ("iteration", EXAMPLE_ITERATION_RECORD),
-                      ("span", EXAMPLE_SPAN_RECORD)):
+                      ("span", EXAMPLE_SPAN_RECORD),
+                      ("program_cost", EXAMPLE_PROGRAM_COST_RECORD),
+                      ("numerics_failure",
+                       EXAMPLE_NUMERICS_FAILURE_RECORD)):
         errs = validate_record(json.loads(json.dumps(rec)))
         if errs:
             ok = False
@@ -226,6 +292,15 @@ def selfcheck() -> Tuple[bool, List[str]]:
     else:
         ok = False
         msgs.append("FAIL: record missing run_id passed validation")
+    bad_pc = dict(EXAMPLE_PROGRAM_COST_RECORD)
+    del bad_pc["collectives"]
+    if validate_record(bad_pc):
+        msgs.append("ok: negative control (program_cost missing "
+                    "collectives) rejected")
+    else:
+        ok = False
+        msgs.append("FAIL: program_cost record missing collectives "
+                    "passed validation")
     stamped = stamp({"value": 1.0}, tool="selfcheck")
     errs = validate_record(stamped)
     if errs:

@@ -26,6 +26,8 @@ bounds sink I/O, not the round-trip).
 
 from __future__ import annotations
 
+import math
+import re
 from typing import Iterable, List, Optional
 
 from . import schema
@@ -61,13 +63,22 @@ class Telemetry:
     ``host_mode``: ``"all"`` (default; single-host no-op) or
     ``"primary"`` (rank-0-only emission on multihost jobs) — see
     ``obs.events.EventBus``.
+
+    ``profile_dir``: when set, the FIRST instrumented ``execute`` phase
+    of a ``telemetry=`` fit is captured as a JAX profiler trace into
+    this directory (``utils.profiling`` one-shot capture), with every
+    span phase wrapped in a matching ``TraceAnnotation`` so the span
+    timers and the device timeline line up.  One-shot by design:
+    traces are large and ``start_trace`` cannot nest.
     """
 
     def __init__(self, sinks: Optional[Iterable[Sink]] = None, *,
                  registry: Optional[MetricsRegistry] = None,
                  every: int = 1, host_mode: str = "all",
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 profile_dir: Optional[str] = None):
         self.run_id = run_id or schema.new_run_id()
+        self.profile_dir = profile_dir
         self.registry = registry or MetricsRegistry()
         self._mem: Optional[InMemorySink] = None
         if sinks is None:
@@ -105,6 +116,7 @@ class Telemetry:
         every = self.every
         run_id = self.run_id
         bus = self.bus
+        nonfinite_seen = []  # one numerics_failure per run, not per iter
 
         def on_iteration(**fields):
             accepted = fields.pop("accepted", None)
@@ -113,18 +125,61 @@ class Telemetry:
                 return
             it = int(fields.pop("it"))
             emitted.inc()
+            vals = {_FIELD_NAMES.get(k, k): _scalar(v)
+                    for k, v in fields.items()}
+            loss = vals.get("loss")
+            if (not nonfinite_seen and isinstance(loss, float)
+                    and not math.isfinite(loss)):
+                # the in-loop sanitizer's cheap twin: the streamed loss
+                # went non-finite — land the failure in the same JSONL
+                # as the metrics instead of only aborting the loop
+                nonfinite_seen.append(it)
+                self.numerics_failure(
+                    f"{algorithm}: non-finite loss in compiled loop",
+                    iter=it, algorithm=algorithm, source="iteration")
             if every > 1 and it % every:
                 return
-            bus.emit(schema.iteration_record(
-                run_id, algorithm, it,
-                **{_FIELD_NAMES.get(k, k): _scalar(v)
-                   for k, v in fields.items()}))
+            bus.emit(schema.iteration_record(run_id, algorithm, it,
+                                             **vals))
 
         return on_iteration
 
     # -- records ----------------------------------------------------------
     def emit(self, record: dict) -> None:
         self.bus.emit(record)
+
+    def program_cost(self, cost, **fields) -> dict:
+        """Emit (and return) a ``program_cost`` record for one compiled
+        program — ``cost`` is an ``obs.introspect.ProgramCost``.  The
+        headline numbers also land as registry gauges
+        (``program.<label>.flops`` / ``.peak_hbm_bytes`` /
+        ``.collectives``) so they ride every ``run_summary`` snapshot."""
+        rec = cost.record(self.run_id, **fields)
+        for g, v in (("flops", cost.flops),
+                     ("peak_hbm_bytes", cost.peak_hbm_bytes),
+                     ("collectives", cost.n_collectives)):
+            if v is not None:
+                self.registry.gauge(f"program.{cost.label}.{g}").set(v)
+        self.bus.emit(rec)
+        return rec
+
+    def numerics_failure(self, message: str, *, leaf=None,
+                         **fields) -> dict:
+        """Emit (and return) a ``numerics_failure`` record — a
+        sanitizer hit (``utils.debug``) or an in-loop non-finite loss —
+        and count it (``numerics.failures``), so the failure lands in
+        the same JSONL as the metrics it poisoned."""
+        if leaf is None:
+            # checkify messages name the failing quantity; surface it
+            # as a first-class field when present
+            m = re.search(r"leaf (.+?) non-finite", message)
+            leaf = m.group(1) if m else None
+        self.registry.counter("numerics.failures").inc()
+        rec = schema.numerics_failure_record(
+            self.run_id, str(message),
+            **({"leaf": leaf} if leaf is not None else {}), **fields)
+        self.bus.emit(rec)
+        return rec
 
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the

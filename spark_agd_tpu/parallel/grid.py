@@ -146,7 +146,7 @@ def make_mesh_sweep_fit(
     step = _make(False)
     step_w = _make(True)
 
-    def fit(reg_params, initial_weights, warm=None):
+    def _place(reg_params, initial_weights):
         regs = jnp.asarray(reg_params, jnp.float32)
         if regs.ndim != 1:
             raise ValueError("reg_params must be 1-D")
@@ -154,11 +154,19 @@ def make_mesh_sweep_fit(
         # pre-replicated, so a transfer-guarded fit stays transfer-free)
         regs = mesh_lib.replicate(regs, mesh)
         w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
-        w0 = mesh_lib.replicate(w0, mesh)
+        return regs, mesh_lib.replicate(w0, mesh)
+
+    def fit(reg_params, initial_weights, warm=None):
+        regs, w0 = _place(reg_params, initial_weights)
         if warm is None:
             return step(regs, w0, *args)
         return step_w(regs, w0, mesh_lib.replicate(warm, mesh), *args)
 
+    # AOT introspection hook (obs.introspect.analyze_lowered): the
+    # cold-path program fit() runs, lowered without executing — the
+    # sharded-grid member of the program-census surface
+    fit.lower = lambda reg_params, initial_weights: step.lower(
+        *_place(reg_params, initial_weights), *args)
     return fit
 
 

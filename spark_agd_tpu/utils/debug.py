@@ -27,6 +27,7 @@ is instrumented.
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Tuple
 
 import jax
@@ -54,17 +55,45 @@ def checking_smooth(smooth: Callable[[Any], Tuple[jax.Array, Any]],
     return inner
 
 
+def report_numerics_failure(err, telemetry=None, *, source: str = "smooth",
+                            **fields) -> None:
+    """Raise a checkify ``Error`` the observable way: when it carries a
+    failure AND a telemetry bus is attached, a ``numerics_failure``
+    record (first failing leaf name parsed from the message, plus any
+    locator ``fields`` — ``evaluation=``, ``iter=``) is emitted to the
+    same JSONL stream as the metrics BEFORE the raise, so a sanitizer
+    hit is an artifact, not just a traceback.  The
+    ``checking_smooth``-in-compiled-program pattern calls this instead
+    of ``err.throw()``::
+
+        err, res = checkified_run(w0)
+        debug.report_numerics_failure(err, telemetry)   # raises iff bad
+    """
+    msg = err.get()
+    if msg is not None and telemetry is not None:
+        telemetry.numerics_failure(msg, source=source, **fields)
+    checkify.check_error(err)
+
+
 def checked_smooth(smooth: Callable[[Any], Tuple[jax.Array, Any]],
-                   name: str = "smooth") -> Callable:
+                   name: str = "smooth", *, telemetry=None) -> Callable:
     """Eager-raising wrapper around :func:`checking_smooth` — same
     signature as ``smooth``; raises on the first non-finite loss or
     gradient leaf.  For host-driven/streamed paths; for the fused
-    compiled loop use :func:`checking_smooth` (module docstring)."""
+    compiled loop use :func:`checking_smooth` (module docstring).
+
+    ``telemetry`` (an ``obs.Telemetry``): a failure additionally emits
+    one ``numerics_failure`` record (failing leaf name, 1-based
+    evaluation index) before raising — sanitizer hits land in the same
+    JSONL as the run's metrics instead of only existing as a raise."""
     checked = checkify.checkify(checking_smooth(smooth, name))
+    n_evals = itertools.count(1)
 
     def wrapped(w):
+        k = next(n_evals)
         err, out = checked(w)
-        checkify.check_error(err)
+        report_numerics_failure(err, telemetry, source=name,
+                                evaluation=k)
         return out
 
     return wrapped

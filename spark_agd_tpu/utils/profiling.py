@@ -31,6 +31,36 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+class OneShotTrace:
+    """Capture the FIRST wrapped region into a profiler trace, then
+    become a no-op — ``jax.profiler.start_trace`` cannot nest and
+    traces are large, so instrumented fits capture exactly one device
+    timeline per telemetry object.  ``log_dir=None`` disables (every
+    call is a no-op), letting call sites wrap unconditionally::
+
+        capture = profiling.OneShotTrace(telemetry.profile_dir)
+        with capture(), telemetry.span("execute"):
+            exe(w, dargs)
+
+    ``captured`` holds the log dir after the one capture (else None).
+    """
+
+    def __init__(self, log_dir: Optional[str]):
+        self.log_dir = log_dir
+        self.captured: Optional[str] = None
+        self._armed = log_dir is not None
+
+    @contextlib.contextmanager
+    def __call__(self):
+        if not self._armed:
+            yield
+            return
+        self._armed = False
+        with trace(self.log_dir):
+            yield
+        self.captured = self.log_dir
+
+
 class TimedStats(NamedTuple):
     """Full repeat statistics from :func:`timed_stats` (seconds)."""
 

@@ -16,18 +16,17 @@ import numpy as np
 import pytest
 
 from spark_agd_tpu.core import agd, smooth as smooth_lib
+from spark_agd_tpu.obs import introspect
 from spark_agd_tpu.ops.losses import LogisticGradient
 from spark_agd_tpu.ops.prox import L2Prox
 from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
 
-
-def compiled_text(fn, *args):
-    return jax.jit(fn).lower(*args).compile().as_text()
-
-
-def count_ops(hlo: str, name: str) -> int:
-    return sum(1 for line in hlo.splitlines()
-               if f" {name}(" in line or f" {name}-start(" in line)
+# ONE source of truth for compiled-program op counting: these guards
+# assert through the public census API (obs.introspect), not a private
+# test helper — the same counters the perf gate's program_cost records
+# are built from (tests/test_introspect.py pins the agreement)
+compiled_text = introspect.hlo_text
+count_ops = introspect.count_ops
 
 
 @pytest.fixture(scope="module")
@@ -51,10 +50,11 @@ class TestCollectiveCount:
         them), and nothing else."""
         sm, _, w0 = dp_problem
         hlo = compiled_text(sm, w0)
-        n_ar = count_ops(hlo, "all-reduce")
+        census = introspect.collective_census(hlo)
+        n_ar = census["all-reduce"]
         assert 1 <= n_ar <= 3, f"expected the single psum phase, {n_ar}"
         for op in ("all-gather", "collective-permute", "all-to-all"):
-            assert count_ops(hlo, op) == 0, f"unexpected {op} in:\n{hlo}"
+            assert census[op] == 0, f"unexpected {op} in:\n{hlo}"
 
     def test_loop_collectives_independent_of_iteration_cap(self,
                                                            dp_problem):
@@ -83,8 +83,9 @@ class TestCollectiveCount:
         # newer XLA fuses them to <= 9); the invariant that matters —
         # independence of the iteration cap — is the equality above
         assert n5 <= 12, f"unexpectedly many all-reduces: {n5}"
+        census5 = introspect.collective_census(hlo5)
         for op in ("all-gather", "collective-permute", "all-to-all"):
-            assert count_ops(hlo5, op) == 0
+            assert census5[op] == 0
 
     def test_loss_mode_pass_counts(self, dp_problem):
         """SURVEY §3.1's cost table, pinned in the compiled program: the
@@ -164,5 +165,5 @@ class TestCollectiveCount:
         hlo = compiled_text(
             lambda w: agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl),
             w0)
-        for op in ("outfeed", "infeed", "send", "recv"):
+        for op in introspect.HOST_TRANSFER_OPS:
             assert count_ops(hlo, op) == 0, f"host {op} in compiled loop"

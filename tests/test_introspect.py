@@ -1,0 +1,417 @@
+"""Compiled-program introspection + perf-regression gate.
+
+CPU-deterministic coverage of ``obs.introspect`` (the ``ProgramCost``
+census over AGD, L-BFGS, and the sharded paths — its collective counts
+must agree with the raw HLO guards in ``test_hlo_cost_shape.py``) and
+``obs.perfgate`` / ``tools/perf_gate.py`` (identical baseline/candidate
+run records pass; a synthetically regressed candidate fails with a
+diff table; cross-environment comparisons are refused).
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.obs import (Telemetry, introspect, perfgate, schema)
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox, SquaredL2Updater
+from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
+
+
+def _tiny_problem(n=64, d=8, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------- census
+
+class TestProgramCost:
+    def test_agd_runner_census_cpu(self):
+        X, y = _tiny_problem()
+        fit = api.make_runner((X, y), LogisticGradient(), L2Prox(),
+                              reg_param=0.1, num_iterations=5,
+                              mesh=False)
+        cost = introspect.analyze_runner(fit, np.zeros(X.shape[1],
+                                                       np.float32))
+        assert cost.label == "agd" and cost.backend == "cpu"
+        # XLA CPU reports the cost model: a real fit does real FLOPs
+        assert cost.flops and cost.flops > 0
+        assert cost.bytes_accessed and cost.bytes_accessed > 0
+        # memory analysis: the data rides as arguments (staged split)
+        assert cost.argument_bytes >= X.nbytes
+        assert cost.peak_hbm_bytes >= cost.argument_bytes
+        # single-device program: no collectives at all
+        assert cost.n_collectives == 0
+        assert set(cost.collectives) == set(introspect.COLLECTIVE_OPS)
+        assert cost.hlo_bytes > 0
+
+    def test_lbfgs_runner_census_cpu(self):
+        X, y = _tiny_problem()
+        fit = api.make_lbfgs_runner((X, y), LogisticGradient(),
+                                    SquaredL2Updater(), reg_param=0.1,
+                                    num_iterations=5, mesh=False)
+        cost = introspect.analyze_runner(
+            fit, np.zeros(X.shape[1], np.float32))
+        assert cost.label == "lbfgs"
+        assert cost.flops and cost.flops > 0
+        assert cost.n_collectives == 0
+
+    def test_sharded_smooth_census_agrees_with_hlo_guard(self,
+                                                         cpu_devices):
+        """The census API and the raw HLO text count the same ops — the
+        one-source-of-truth contract behind refactoring
+        test_hlo_cost_shape.py onto introspect.count_ops."""
+        X, y = _tiny_problem(n=256, d=16)
+        mesh = mesh_lib.make_mesh({"data": 8})
+        batch = mesh_lib.shard_batch(mesh, X, y)
+        sm, _ = dist_smooth.make_dist_smooth(LogisticGradient(), batch,
+                                             mesh=mesh)
+        w0 = mesh_lib.replicate(jnp.zeros(X.shape[1], jnp.float32),
+                                mesh)
+        cost = introspect.analyze(sm, w0, label="dist_smooth")
+        hlo = introspect.hlo_text(sm, w0)
+        assert cost.collectives == introspect.collective_census(hlo)
+        # the same envelope test_hlo_cost_shape pins: one psum phase
+        assert 1 <= cost.collectives["all-reduce"] <= 3
+        for op in ("all-gather", "collective-permute", "all-to-all"):
+            assert cost.collectives[op] == 0
+
+    def test_sharded_runner_census(self, cpu_devices):
+        """The PUBLIC runner on a mesh reports the mesh program's
+        collectives (nonzero all-reduce count)."""
+        X, y = _tiny_problem(n=256, d=16)
+        mesh = mesh_lib.make_mesh({"data": 8})
+        fit = api.make_runner((X, y), LogisticGradient(), L2Prox(),
+                              reg_param=0.1, num_iterations=5,
+                              convergence_tol=0.0, mesh=mesh)
+        cost = introspect.analyze_runner(
+            fit, np.zeros(X.shape[1], np.float32))
+        assert cost.collectives["all-reduce"] >= 1
+        assert cost.collectives["all-gather"] == 0
+
+    def test_mesh_sweep_lower_hook(self, cpu_devices):
+        """parallel.grid's fit.lower hook censuses the sharded-grid
+        program: the lane-vmapped loop keeps the same per-collective
+        shape as a solo mesh fit (all-reduces only)."""
+        from spark_agd_tpu.core import agd
+        from spark_agd_tpu.parallel import grid
+
+        X, y = _tiny_problem(n=256, d=16)
+        mesh = mesh_lib.make_mesh({"data": 8})
+        batch = mesh_lib.shard_batch(mesh, X, y)
+        cfg = agd.AGDConfig(num_iterations=5, convergence_tol=0.0)
+        fit = grid.make_mesh_sweep_fit(LogisticGradient(), L2Prox(),
+                                       batch, mesh, cfg)
+        cost = introspect.analyze_lowered(
+            fit.lower([0.1, 0.2], np.zeros(16, np.float32)),
+            label="mesh_sweep")
+        assert cost.collectives["all-reduce"] >= 1
+        for op in ("all-gather", "collective-permute", "all-to-all",
+                   "reduce-scatter"):
+            assert cost.collectives[op] == 0
+
+    def test_record_emission_validates(self):
+        X, y = _tiny_problem()
+        fit = api.make_runner((X, y), LogisticGradient(), L2Prox(),
+                              reg_param=0.1, num_iterations=3,
+                              mesh=False)
+        cost = introspect.analyze_runner(
+            fit, np.zeros(X.shape[1], np.float32))
+        tel = Telemetry()
+        rec = tel.program_cost(cost, algorithm="agd")
+        assert schema.validate_record(
+            json.loads(json.dumps(rec))) == []
+        assert rec["kind"] == "program_cost" and rec["label"] == "agd"
+        assert rec in tel.records
+        snap = tel.registry.snapshot()
+        assert snap["program.agd.flops"] == cost.flops
+        assert snap["program.agd.collectives"] == 0
+
+    def test_environment_fingerprint(self):
+        fp = introspect.environment_fingerprint()
+        assert fp["platform"] == "cpu" and fp["n_devices"] >= 8
+        assert fp["jax_version"] == jax.__version__
+        mesh = mesh_lib.make_mesh({"data": 8})
+        fp2 = introspect.environment_fingerprint(mesh)
+        assert fp2["mesh_shape"] == {"data": 8}
+        # provenance fields are valid optional run-record fields
+        rec = schema.run_record(tool="test", **fp2)
+        assert schema.validate_record(json.loads(json.dumps(rec))) == []
+
+
+class TestProfilerCapture:
+    def test_one_shot_trace_and_annotated_spans(self, tmp_path):
+        """telemetry=profile_dir captures the first execute phase as a
+        profiler trace; span records still stream for every phase."""
+        X, y = _tiny_problem()
+        tel = Telemetry(profile_dir=str(tmp_path / "trace"))
+        fit = api.make_runner((X, y), LogisticGradient(), L2Prox(),
+                              reg_param=0.1, num_iterations=3,
+                              mesh=False, telemetry=tel)
+        w0 = np.zeros(X.shape[1], np.float32)
+        fit(w0)
+        fit(w0)  # second fit: capture must NOT re-arm (no nested trace)
+        assert [s["name"] for s in tel.spans()].count("execute") == 2
+        # the profiler wrote a trace under the requested dir
+        captured = []
+        for root, _, files in os.walk(tmp_path / "trace"):
+            captured += files
+        assert captured, "no profiler trace files written"
+
+
+class TestNumericsFailureEvents:
+    def test_checked_smooth_emits_event(self):
+        from spark_agd_tpu.utils import debug
+
+        tel = Telemetry()
+
+        def smooth(w):
+            return jnp.sum(w), {"w": w * jnp.nan}
+
+        sm = debug.checked_smooth(smooth, telemetry=tel)
+        with pytest.raises(Exception):
+            sm(jnp.ones(3))
+        recs = [r for r in tel.records
+                if r.get("kind") == "numerics_failure"]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert schema.validate_record(json.loads(json.dumps(rec))) == []
+        assert "non-finite" in rec["message"]
+        assert rec["leaf"] is not None and "w" in rec["leaf"]
+        assert rec["evaluation"] == 1
+        assert tel.registry.snapshot()["numerics.failures"] == 1
+
+    def test_checked_smooth_clean_run_emits_nothing(self):
+        from spark_agd_tpu.utils import debug
+
+        tel = Telemetry()
+        sm = debug.checked_smooth(lambda w: (jnp.sum(w), w),
+                                  telemetry=tel)
+        sm(jnp.ones(3))
+        assert not [r for r in tel.records
+                    if r.get("kind") == "numerics_failure"]
+
+    def test_live_stream_flags_nonfinite_loss(self):
+        """The in-loop iteration stream lands a numerics_failure record
+        when the streamed loss goes non-finite (once per run)."""
+        tel = Telemetry()
+        cb = tel.iteration_callback("agd")
+        cb(it=1, loss=0.5)
+        cb(it=2, loss=float("nan"))
+        cb(it=3, loss=float("nan"))
+        recs = [r for r in tel.records
+                if r.get("kind") == "numerics_failure"]
+        assert len(recs) == 1 and recs[0]["iter"] == 2
+
+
+# ------------------------------------------------------------- perf gate
+
+def _run_rec(**over):
+    rec = dict(schema.EXAMPLE_RUN_RECORD)
+    rec.update(name="cfg1", algorithm="agd", wall_to_eps_s=2.0,
+               iters_per_sec=400.0, converged=True, iters=20,
+               device_kind="cpu", jax_version="0.4.37")
+    rec.update(over)
+    return rec
+
+
+def _cost_rec(**over):
+    rec = dict(schema.EXAMPLE_PROGRAM_COST_RECORD)
+    rec.update(over)
+    return rec
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+@pytest.mark.perfgate
+class TestPerfGate:
+    def test_identical_records_pass(self):
+        base = [_run_rec(), _cost_rec()]
+        result = perfgate.compare_records(base, [dict(r) for r in base])
+        assert result.ok and result.exit_code() == 0
+        assert not result.regressions
+        compared = [d for d in result.deltas if d.status != "skipped"]
+        assert compared, "identical records must actually be compared"
+
+    def test_wall_time_regression_fails(self):
+        base = [_run_rec()]
+        cand = [_run_rec(wall_to_eps_s=4.0)]  # 2x slower
+        result = perfgate.compare_records(base, cand)
+        assert result.exit_code() == 1
+        assert any(d.metric == "wall_to_eps_s"
+                   for d in result.regressions)
+        table = perfgate.format_report(result)
+        assert "wall_to_eps_s" in table and "regression" in table
+
+    def test_improvement_is_not_a_regression(self):
+        result = perfgate.compare_records(
+            [_run_rec()], [_run_rec(wall_to_eps_s=1.0,
+                                    iters_per_sec=800.0)])
+        assert result.exit_code() == 0
+        assert any(d.status == "improved" for d in result.deltas)
+
+    def test_within_threshold_noise_passes(self):
+        result = perfgate.compare_records(
+            [_run_rec()], [_run_rec(wall_to_eps_s=2.1)])  # +5% < 15%
+        assert result.exit_code() == 0
+
+    def test_collective_count_regression_fails(self):
+        base = [_cost_rec()]
+        cand = [_cost_rec(collectives={"all-reduce": 3,
+                                       "all-gather": 1})]
+        result = perfgate.compare_records(base, cand)
+        assert result.exit_code() == 1
+        assert any(d.metric == "collectives.all-gather"
+                   for d in result.regressions)
+
+    def test_flops_and_hbm_regression(self):
+        base = [_cost_rec()]
+        cand = [_cost_rec(flops=base[0]["flops"] * 1.5,
+                          peak_hbm_bytes=base[0]["peak_hbm_bytes"] * 2)]
+        result = perfgate.compare_records(base, cand)
+        names = {d.metric for d in result.regressions}
+        assert {"flops", "peak_hbm_bytes"} <= names
+
+    def test_iters_to_tol_requires_convergence(self):
+        """A capped (converged=False) iteration count is the cap, not a
+        tolerance claim — it must not gate."""
+        result = perfgate.compare_records(
+            [_run_rec(converged=False)],
+            [_run_rec(converged=False, iters=100)])
+        d = [x for x in result.deltas if x.metric == "iters_to_tol"]
+        assert d and d[0].status == "skipped"
+
+    def test_cross_environment_refused(self):
+        base = [_run_rec(platform="tpu", device_kind="TPU v5e")]
+        cand = [_run_rec()]
+        result = perfgate.compare_records(base, cand)
+        assert result.refused and result.exit_code() == 2
+        allowed = perfgate.compare_records(base, cand,
+                                           allow_cross_env=True)
+        assert not allowed.refused and allowed.exit_code() != 2
+
+    def test_threshold_override(self):
+        result = perfgate.compare_records(
+            [_run_rec()], [_run_rec(wall_to_eps_s=2.1)],
+            thresholds={"wall_to_eps_s": 0.01})
+        assert result.exit_code() == 1
+
+
+def _load_tool(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.perfgate
+class TestPerfGateCLI:
+    def test_identical_files_exit_zero(self, tmp_path, capsys):
+        recs = [_run_rec(), _cost_rec()]
+        b = _write_jsonl(tmp_path / "base.jsonl", recs)
+        c = _write_jsonl(tmp_path / "cand.jsonl", recs)
+        assert _load_tool("perf_gate").main([b, c]) == 0
+        assert "pass" in capsys.readouterr().out
+
+    def test_regressed_candidate_exits_nonzero_with_table(
+            self, tmp_path, capsys):
+        b = _write_jsonl(tmp_path / "base.jsonl",
+                         [_run_rec(), _cost_rec()])
+        c = _write_jsonl(
+            tmp_path / "cand.jsonl",
+            [_run_rec(wall_to_eps_s=40.0),
+             _cost_rec(collectives={"all-reduce": 9})])
+        code = _load_tool("perf_gate").main([b, c])
+        assert code == 1
+        out = capsys.readouterr().out
+        assert "wall_to_eps_s" in out
+        assert "collectives.all-reduce" in out
+        assert "regression" in out
+
+    def test_cross_env_refused_then_allowed(self, tmp_path):
+        b = _write_jsonl(tmp_path / "base.jsonl",
+                         [_run_rec(platform="tpu")])
+        c = _write_jsonl(tmp_path / "cand.jsonl", [_run_rec()])
+        tool = _load_tool("perf_gate")
+        assert tool.main([b, c]) == 2
+        assert tool.main([b, c, "--allow-cross-env"]) == 0
+
+    def test_threshold_flag(self, tmp_path):
+        b = _write_jsonl(tmp_path / "base.jsonl", [_run_rec()])
+        c = _write_jsonl(tmp_path / "cand.jsonl",
+                         [_run_rec(wall_to_eps_s=2.1)])
+        tool = _load_tool("perf_gate")
+        assert tool.main([b, c]) == 0
+        assert tool.main(
+            [b, c, "--threshold", "wall_to_eps_s=0.01"]) == 1
+
+    def test_require_match_guards_empty_gate(self, tmp_path):
+        b = _write_jsonl(tmp_path / "base.jsonl",
+                         [_run_rec(name="only-in-base")])
+        c = _write_jsonl(tmp_path / "cand.jsonl",
+                         [_run_rec(name="only-in-cand")])
+        tool = _load_tool("perf_gate")
+        assert tool.main([b, c]) == 0  # nothing compared, nothing broke
+        assert tool.main([b, c, "--require-match"]) == 1
+
+    def test_gate_on_real_runner_census(self, tmp_path):
+        """End-to-end on a real compiled program: census the AGD
+        runner, write baseline/candidate JSONLs, gate them — identical
+        passes, an inflated collective count fails."""
+        X, y = _tiny_problem()
+        fit = api.make_runner((X, y), LogisticGradient(), L2Prox(),
+                              reg_param=0.1, num_iterations=3,
+                              mesh=False)
+        cost = introspect.analyze_runner(
+            fit, np.zeros(X.shape[1], np.float32))
+        rec = cost.record(schema.new_run_id(), algorithm="agd")
+        b = _write_jsonl(tmp_path / "base.jsonl", [rec])
+        c_same = _write_jsonl(tmp_path / "cand.jsonl", [rec])
+        tool = _load_tool("perf_gate")
+        assert tool.main([b, c_same, "--require-match"]) == 0
+        worse = dict(rec)
+        worse["collectives"] = dict(rec["collectives"],
+                                    **{"all-reduce": 5})
+        c_bad = _write_jsonl(tmp_path / "worse.jsonl", [worse])
+        assert tool.main([b, c_bad]) == 1
+
+
+@pytest.mark.perfgate
+class TestAgdReportCompare:
+    def test_side_by_side_diff(self, tmp_path, capsys):
+        b = _write_jsonl(tmp_path / "base.jsonl", [
+            _run_rec(),
+            schema.iteration_record("ra", "agd", 1, loss=1.0),
+            schema.iteration_record("ra", "agd", 2, loss=0.5),
+        ])
+        c = _write_jsonl(tmp_path / "cand.jsonl", [
+            _run_rec(wall_to_eps_s=3.0),
+            schema.iteration_record("rb", "agd", 1, loss=1.0),
+            schema.iteration_record("rb", "agd", 2, loss=0.4),
+        ])
+        report = _load_tool("agd_report")
+        assert report.main(["--compare", b, c]) == 0
+        out = capsys.readouterr().out
+        assert "wall_to_eps_s" in out and "+50" in out
+        assert "iteration streams" in out and "final_loss" in out
+
+    def test_plain_report_still_works(self, tmp_path, capsys):
+        b = _write_jsonl(tmp_path / "one.jsonl", [_run_rec()])
+        report = _load_tool("agd_report")
+        assert report.main([b]) == 0
+        assert "runs (1)" in capsys.readouterr().out

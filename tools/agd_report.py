@@ -13,10 +13,18 @@ anything with ``iter``+``loss`` as an iteration row).
 Usage::
 
     python tools/agd_report.py RUN.jsonl [MORE.jsonl ...] [--eps 1e-3]
+    python tools/agd_report.py --compare BASE.jsonl CAND.jsonl
 
 Prints one table of run rows, one convergence summary per iteration
 stream (grouped by run_id), and a span-phase rollup.  Exit code 0 when
 every line parsed, 1 when nothing could be read.
+
+``--compare BASE CAND`` renders a side-by-side convergence/timing diff
+of two run JSONLs instead — the ``obs.perfgate`` comparison core
+(paired run/program_cost records, signed relative change per metric)
+plus an iteration-stream convergence diff, as a report: it never
+fails the exit code on a regression (that is ``tools/perf_gate.py``'s
+job).
 """
 
 from __future__ import annotations
@@ -160,6 +168,74 @@ def summarize_spans(spans: List[dict]) -> str:
     return _table(headers, rows)
 
 
+def _iteration_summary(records: List[dict], eps: float) -> dict:
+    """Aggregate convergence facts of one file's iteration streams."""
+    losses = [float(r["loss"]) for r in
+              sorted(records, key=lambda r: (r.get("run_id", "-"),
+                                             r.get("iter", 0)))
+              if isinstance(r.get("loss"), (int, float))]
+    if not losses:
+        return {}
+    return {
+        "iterations": len(losses),
+        "first_loss": losses[0],
+        "best_loss": min(v for v in losses if v == v),
+        "final_loss": losses[-1],
+        f"iters_to_eps({eps:g})": iters_to_eps(losses, eps),
+    }
+
+
+def compare_report(base_path: str, cand_path: str, eps: float) -> int:
+    """``--compare``: side-by-side diff of two run JSONLs via the
+    ``obs.perfgate`` comparison core — report-only (exit 0 unless a
+    file is unreadable)."""
+    try:
+        from spark_agd_tpu.obs import perfgate
+    except ImportError as e:
+        print(f"--compare unavailable: {e}", file=sys.stderr)
+        return 1
+    try:
+        base = perfgate.load_records(base_path)
+        cand = perfgate.load_records(cand_path)
+    except (OSError, ValueError) as e:
+        print(f"cannot read records: {e}", file=sys.stderr)
+        return 1
+    result = perfgate.compare_records(base, cand)
+    print(f"== compare: {base_path} (baseline) vs {cand_path} "
+          f"(candidate) ==")
+    print(perfgate.format_deltas(result.deltas, only_compared=True))
+    for name, keys in (("baseline", result.unmatched_baseline),
+                       ("candidate", result.unmatched_candidate)):
+        if keys:
+            print(f"note: {len(keys)} {name}-only record key(s): "
+                  + "; ".join(keys[:4])
+                  + (" …" if len(keys) > 4 else ""))
+    if result.env_mismatches:
+        print("note: environment differs — timing deltas are "
+              "hardware deltas, not code deltas:")
+        for m in result.env_mismatches:
+            print(f"  {m}")
+
+    # convergence diff of the two iteration streams, when present
+    b_it = [r for r in base if _kind(r) == "iteration"]
+    c_it = [r for r in cand if _kind(r) == "iteration"]
+    if b_it and c_it:
+        bs, cs = (_iteration_summary(b_it, eps),
+                  _iteration_summary(c_it, eps))
+        rows = []
+        for field in bs:
+            b, c = bs.get(field), cs.get(field)
+            delta = ("-" if not all(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                for v in (b, c)) or not b
+                else f"{(c - b) / abs(b):+.1%}")
+            rows.append([field, _fmt(b), _fmt(c), delta])
+        print("\n== iteration streams ==")
+        print(_table(["metric", "baseline", "candidate", "change"],
+                     rows))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("paths", nargs="+", metavar="FILE.jsonl")
@@ -169,7 +245,17 @@ def main(argv=None) -> int:
     p.add_argument("--validate", action="store_true",
                    help="also validate each record against the "
                         "canonical schema and report violations")
+    p.add_argument("--compare", action="store_true",
+                   help="treat the two paths as BASELINE CANDIDATE and "
+                        "render a side-by-side timing/convergence diff "
+                        "(report-only; the failing gate is "
+                        "tools/perf_gate.py)")
     args = p.parse_args(argv)
+
+    if args.compare:
+        if len(args.paths) != 2:
+            p.error("--compare wants exactly two paths: BASE CAND")
+        return compare_report(args.paths[0], args.paths[1], args.eps)
 
     records, bad = _load(args.paths)
     if not records:

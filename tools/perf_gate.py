@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Perf-regression gate CLI: fail a PR when the candidate run records
+regress against a baseline.
+
+Compares two canonical run-record JSONLs (``obs.schema``: the stamped
+``BENCH_*`` artifacts, ``benchmarks/run.py --out`` files,
+``Telemetry`` JSONL sinks) record-by-record on wall clock,
+iterations-to-tolerance, and compiled-program facts (FLOPs, peak HBM,
+per-collective counts from ``program_cost`` records) — the
+``obs.perfgate`` comparison core.
+
+Usage::
+
+    python -m tools.perf_gate BASELINE.jsonl CANDIDATE.jsonl
+    python -m tools.perf_gate BENCH_r04.json BENCH_r05.json \\
+        --threshold wall_to_eps_s=0.25 --threshold flops=0.02
+    python -m tools.perf_gate base.jsonl cand.jsonl --allow-cross-env
+
+Exit codes: 0 pass, 1 regression (diff table on stdout), 2 refused —
+cross-environment comparison (the records' jax/jaxlib/backend/device
+provenance differs; pass ``--allow-cross-env`` to compare anyway) or
+unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _parse_thresholds(pairs, parser):
+    out = {}
+    from spark_agd_tpu.obs import perfgate
+
+    known = (set(perfgate.RUN_METRICS) | set(perfgate.PROGRAM_METRICS)
+             | {perfgate.COLLECTIVES_METRIC})
+    for pair in pairs or ():
+        name, sep, val = pair.partition("=")
+        if not sep:
+            parser.error(f"--threshold wants NAME=VALUE, got {pair!r}")
+        if name not in known:
+            parser.error(f"unknown metric {name!r}; choose from "
+                         f"{', '.join(sorted(known))}")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            parser.error(f"--threshold {name}: {val!r} is not a number")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.perf_gate",
+        description=__doc__.splitlines()[0])
+    p.add_argument("baseline", metavar="BASELINE.jsonl")
+    p.add_argument("candidate", metavar="CANDIDATE.jsonl")
+    p.add_argument("--threshold", action="append", metavar="NAME=REL",
+                   help="override one metric's relative threshold "
+                        "(repeatable); 'collectives' is an ABSOLUTE "
+                        "allowed op-count increase (default 0)")
+    p.add_argument("--allow-cross-env", action="store_true",
+                   help="compare even when environment provenance "
+                        "(platform/device/jax version/mesh) differs")
+    p.add_argument("--require-match", action="store_true",
+                   help="also fail when no record pairs were compared "
+                        "(guards against a silently empty gate)")
+    p.add_argument("--verbose", action="store_true",
+                   help="show skipped (not-present-on-both-sides) "
+                        "metrics in the table")
+    args = p.parse_args(argv)
+
+    from spark_agd_tpu.obs import perfgate
+
+    thresholds = _parse_thresholds(args.threshold, p)
+    try:
+        result = perfgate.gate_files(
+            args.baseline, args.candidate, thresholds=thresholds,
+            allow_cross_env=args.allow_cross_env)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot read records: {e}", file=sys.stderr)
+        return 2
+
+    print(perfgate.format_report(result, verbose=args.verbose))
+    code = result.exit_code()
+    if code == 0 and args.require_match and not any(
+            d.status != "skipped" for d in result.deltas):
+        print("perf_gate: --require-match: no record pairs compared",
+              file=sys.stderr)
+        return 1
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
