@@ -311,6 +311,8 @@ def run(
     return_result: bool = False,
     telemetry=None,
     verbose: bool = False,
+    resilience=None,
+    checkpointer=None,
 ):
     """Functional entry point, signature-parity with reference ``run``
     (``:177-189``).  Returns ``(weights, loss_history)`` where
@@ -326,9 +328,31 @@ def run(
     post-hoc per-iteration diagnostics through ``utils.logging.
     log_result`` (the structured lines + the reference's completion/
     abort lines) on the ``spark_agd_tpu`` logger — no callback, no
-    overhead inside the compiled program."""
+    overhead inside the compiled program.
+
+    ``resilience`` (a ``resilience.ResiliencePolicy``, or ``True`` for
+    the defaults; off by default — zero new machinery in the plain
+    path): run under the fault-aware supervisor instead of one bare
+    fused call — segmented execution, bounded retries with backoff on
+    transient failures, rollback to the last-good warm state with a
+    step cut on non-finite numerics, and ``attempt``/``recovery``
+    records on the telemetry stream.  ``checkpointer`` (a
+    ``resilience.AutoCheckpointer``, supervised path only) adds
+    preemption-safe auto-checkpointing and corruption-tolerant resume.
+    ``return_result=True`` then returns the ``SupervisedResult`` as the
+    third element.  See ``docs/ROBUSTNESS.md``."""
     if initial_weights is None:
         raise ValueError("initial_weights is required")
+    if resilience is not None:
+        return _run_supervised(
+            data, gradient, updater, convergence_tol, num_iterations,
+            reg_param, initial_weights, l0, l_exact, beta, alpha,
+            may_restart, mesh, dist_mode, loss_mode, return_result,
+            telemetry, verbose, resilience, checkpointer)
+    if checkpointer is not None:
+        raise ValueError(
+            "checkpointer= requires the supervised path; pass "
+            "resilience=True (or a ResiliencePolicy) as well")
     fit = make_runner(
         data, gradient, updater, convergence_tol=convergence_tol,
         num_iterations=num_iterations, reg_param=reg_param, l0=l0,
@@ -354,6 +378,56 @@ def run(
     if return_result:
         return result.weights, loss_history, result
     return result.weights, loss_history
+
+
+def _run_supervised(data, gradient, updater, convergence_tol,
+                    num_iterations, reg_param, initial_weights, l0,
+                    l_exact, beta, alpha, may_restart, mesh, dist_mode,
+                    loss_mode, return_result, telemetry, verbose,
+                    resilience, checkpointer):
+    """The ``resilience=`` branch of :func:`run`: the same data staging
+    and mesh resolution as :func:`make_runner`, driven by
+    ``resilience.supervisor.run_agd_supervised`` (segmented fused
+    programs — data rides as jit ARGUMENTS, so supervision costs no
+    extra compiles beyond one per segment length)."""
+    from .resilience import supervisor as supervisor_lib
+
+    policy = None if resilience is True else resilience
+    data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
+    build, dargs = _build_smooth(gradient, data, m, dist_mode)
+    px, rv = smooth_lib.make_prox(updater, reg_param)
+    cfg = agd.AGDConfig(
+        convergence_tol=convergence_tol, num_iterations=num_iterations,
+        l0=l0, l_exact=l_exact, beta=beta, alpha=alpha,
+        may_restart=may_restart, loss_mode=loss_mode)
+
+    def _place_w(w):
+        w0 = jax.tree_util.tree_map(jnp.asarray, w)
+        return w0 if m is None else mesh_lib.replicate(w0, m)
+
+    sres = supervisor_lib.run_agd_supervised(
+        prox=px, reg_value=rv, w0=initial_weights, config=cfg,
+        policy=policy, telemetry=telemetry, checkpointer=checkpointer,
+        staged=(build, dargs), place_w=_place_w)
+    loss_history = np.asarray(sres.loss_history)
+    if telemetry is not None:
+        telemetry.run_summary(
+            tool="api.run", algorithm="agd", iters=int(sres.num_iters),
+            final_loss=(float(loss_history[-1]) if len(loss_history)
+                        else None),
+            converged=bool(sres.converged),
+            error=("aborted: non-finite loss"
+                   if sres.aborted_non_finite else None))
+    if verbose:
+        from .utils import logging as logging_utils
+
+        logging_utils.logger.info(
+            "supervised run: %d iterations, %d retries, %d rollbacks, "
+            "resumed from %d", sres.num_iters, sres.retries,
+            sres.rollbacks, sres.resumed_from)
+    if return_result:
+        return sres.weights, loss_history, sres
+    return sres.weights, loss_history
 
 
 def run_minibatch_agd(

@@ -40,6 +40,7 @@ Two assembly layouts:
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -47,7 +48,37 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
+from ..resilience import retry as retry_lib
 from . import libsvm
+
+logger = logging.getLogger("spark_agd_tpu")
+
+# transient IO mid-ingest costs a short backoff, not the whole SPMD
+# job; bounded so a genuinely-dead source still fails fast (the
+# supervisor above classifies OSError TRANSIENT and retries the
+# larger unit)
+DEFAULT_READ_RETRIES = retry_lib.RetryPolicy(
+    max_attempts=3, backoff_base=0.05, backoff_max=2.0, jitter=0.1)
+
+
+def _retrying_loader(loader: Callable, retries, telemetry) -> Callable:
+    """``loader`` under the shared retrying helper (``resilience.
+    retry``): transient IO errors back off and re-read; each retry is
+    logged and — when a ``telemetry`` is attached — emitted as a
+    ``recovery`` record into the run's JSONL.  Shared by the ingest
+    assemblers and ``data.streaming.StreamingDataset.
+    from_libsvm_parts``."""
+    policy = retries if retries is not None else DEFAULT_READ_RETRIES
+
+    def on_retry(n_failures, exc, delay):
+        logger.warning(
+            "ingest read failed (%s: %s); retry %d/%d in %.2fs",
+            type(exc).__name__, exc, n_failures,
+            policy.max_attempts - 1, delay)
+
+    return retry_lib.retrying(policy, label="ingest_read",
+                              telemetry=telemetry,
+                              on_retry=on_retry)(loader)
 
 
 def _allgather_max(value: int) -> int:
@@ -89,6 +120,8 @@ def from_partitioned_files(
     binarize_labels: bool = True,
     loader: Optional[Callable[..., "libsvm.CSRData"]] = None,
     axis: str = mesh_lib.DATA_AXIS,
+    retries: Optional[retry_lib.RetryPolicy] = None,
+    telemetry=None,
 ) -> mesh_lib.ShardedBatch:
     """Load one LIBSVM partition set into a mesh-sharded batch.
 
@@ -99,13 +132,20 @@ def from_partitioned_files(
     partitions (one allgather).  Labels are mapped to {0,1} unless
     ``binarize_labels=False`` (multinomial class ids).
 
+    Every partition read runs under the shared retrying helper
+    (``retries``, default 3 attempts with backoff): one flaky NFS read
+    must not abort a whole-pod SPMD ingest.  Retries are logged and,
+    when ``telemetry`` (an ``obs.Telemetry``) is given, emitted as
+    ``recovery`` records.
+
     Returns a :class:`~spark_agd_tpu.parallel.mesh.ShardedBatch` whose
     mask excludes inter-host padding rows; feed it straight to
     ``api.run`` / ``dist_smooth.make_dist_smooth``.
     """
     if not paths:
         raise ValueError("no partition files")
-    loader = loader or libsvm.load_libsvm
+    loader = _retrying_loader(loader or libsvm.load_libsvm, retries,
+                              telemetry)
     mesh = mesh if mesh is not None else mesh_lib.make_mesh(
         {axis: len(jax.devices())})
 
@@ -172,6 +212,8 @@ def from_partitioned_files_csr(
     balance: bool = True,
     loader: Optional[Callable[..., "libsvm.CSRData"]] = None,
     axis: str = mesh_lib.DATA_AXIS,
+    retries: Optional[retry_lib.RetryPolicy] = None,
+    telemetry=None,
 ) -> mesh_lib.ShardedBatch:
     """Load a LIBSVM partition set into a mesh-sharded SPARSE batch —
     no densification at any point (r2 VERDICT item 3).
@@ -187,11 +229,14 @@ def from_partitioned_files_csr(
     ``with_csc=True`` (default) builds each shard's column-sorted twin
     so the gradient uses sorted segment-sums.  ``n_features`` pins the
     global width (url_combined: 3,231,961); inferred by allgather-max
-    when omitted.
+    when omitted.  ``retries``/``telemetry``: per-partition reads run
+    under the shared retrying helper, as in
+    :func:`from_partitioned_files`.
     """
     if not paths:
         raise ValueError("no partition files")
-    loader = loader or libsvm.load_libsvm
+    loader = _retrying_loader(loader or libsvm.load_libsvm, retries,
+                              telemetry)
     mesh = mesh if mesh is not None else mesh_lib.make_mesh(
         {axis: len(jax.devices())})
     n_dev_axis = mesh.shape[axis]

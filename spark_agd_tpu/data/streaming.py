@@ -161,7 +161,8 @@ class StreamingDataset:
     def from_libsvm_parts(cls, paths, n_features: int, batch_rows: int,
                           with_csc="lazy",
                           nnz_pad: Optional[int] = None,
-                          binarize_labels: bool = True):
+                          binarize_labels: bool = True,
+                          retries=None, telemetry=None):
         """Stream LIBSVM partition files (e.g. a Spark job's part-*
         output — the north star's ingest seam) as fixed-shape CSR
         macro-batches WITHOUT ever materializing the full dataset: one
@@ -178,15 +179,24 @@ class StreamingDataset:
         feature space (per-part inference would disagree on trailing
         sparse columns), and out-of-range indices fail at parse time
         rather than silently clamping inside the compiled gather.
+
+        ``retries`` (a ``resilience.RetryPolicy``, default 3 attempts):
+        each part's parse runs under the shared retrying helper, so a
+        transient IO error mid-stream costs a backoff, not the whole
+        fit — the streamed smooth re-reads every part EVERY evaluation,
+        multiplying exposure to flaky storage.  Retries are logged and,
+        when ``telemetry`` is given, land as ``recovery`` records.
         """
         from .libsvm import load_libsvm
+        from .ingest import _retrying_loader
 
         paths = list(paths)
         if not paths:
             raise ValueError("from_libsvm_parts needs at least one path")
+        load = _retrying_loader(load_libsvm, retries, telemetry)
 
         def part_arrays(path):
-            d = load_libsvm(path, n_features=n_features)
+            d = load(path, n_features=n_features)
             if len(d.indices) and int(d.indices.max()) >= n_features:
                 raise ValueError(
                     f"{path}: feature index {int(d.indices.max())} >= "

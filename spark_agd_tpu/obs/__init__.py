@@ -56,11 +56,13 @@ from .introspect import (  # noqa: F401
 )
 from .schema import (  # noqa: F401
     SCHEMA_VERSION,
+    attempt_record,
     iteration_record,
     new_run_id,
     numerics_failure_record,
     program_cost_record,
     read_jsonl,
+    recovery_record,
     run_record,
     span_record,
     stamp,

@@ -36,7 +36,13 @@ from typing import Dict, Iterable, List, Optional, Tuple
 SCHEMA_VERSION = 1
 
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
-         "numerics_failure")
+         "numerics_failure", "attempt", "recovery")
+
+# the recovery actions the resilience layer emits; validation accepts
+# any string (producers may grow new actions), this tuple documents the
+# canonical set for consumers
+RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
+                    "checkpoint", "checkpoint_fallback", "resume")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -55,6 +61,12 @@ _REQUIRED: Dict[str, dict] = {
     # a sanitizer hit (utils.debug) or an in-loop non-finite loss,
     # landed in the same JSONL as the metrics it poisoned
     "numerics_failure": {"run_id": str, "message": str},
+    # one supervised fit attempt (resilience.supervisor): outcome is
+    # "ok" | "failed" | "aborted_non_finite"
+    "attempt": {"run_id": str, "attempt": int, "outcome": str},
+    # one recovery action (resilience layer): action is one of
+    # RECOVERY_ACTIONS (open set — consumers ignore unknown actions)
+    "recovery": {"run_id": str, "action": str},
 }
 
 _OPTIONAL: Dict[str, dict] = {
@@ -87,6 +99,19 @@ _OPTIONAL: Dict[str, dict] = {
     },
     "numerics_failure": {
         "leaf": (str, type(None)), "iter": int, "evaluation": int,
+        "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
+    },
+    "attempt": {
+        "start_iter": int, "iters": int, "seconds": _NUM,
+        "error": (str, type(None)),
+        "failure_kind": (str, type(None)), "algorithm": str,
+        "tool": str, "timestamp_unix": _NUM,
+    },
+    "recovery": {
+        "reason": str, "failure_kind": str, "attempt": int,
+        "backoff_s": _NUM, "from_iter": int, "to_iter": int,
+        "big_l": _NUM, "path": str, "generation": int,
         "source": str, "algorithm": str, "tool": str,
         "timestamp_unix": _NUM,
     },
@@ -209,6 +234,25 @@ def numerics_failure_record(run_id: str, message: str,
             "run_id": run_id, "message": message, **fields}
 
 
+def attempt_record(run_id: str, attempt: int, outcome: str,
+                   **fields) -> dict:
+    """One supervised fit attempt (``resilience.supervisor``):
+    ``outcome`` is ``ok`` / ``failed`` / ``aborted_non_finite``;
+    ``start_iter``/``iters``/``seconds``/``error``/``failure_kind``
+    locate and explain it."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "attempt",
+            "run_id": run_id, "attempt": int(attempt),
+            "outcome": str(outcome), **fields}
+
+
+def recovery_record(run_id: str, action: str, **fields) -> dict:
+    """One recovery action of the resilience layer — ``action`` is one
+    of :data:`RECOVERY_ACTIONS` (retry, rollback, preemption_flush,
+    checkpoint, checkpoint_fallback, resume)."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "recovery",
+            "run_id": run_id, "action": str(action), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -265,6 +309,22 @@ EXAMPLE_NUMERICS_FAILURE_RECORD = {
     "leaf": "['w']", "evaluation": 3, "source": "smooth",
 }
 
+EXAMPLE_ATTEMPT_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "attempt",
+    "run_id": "r18c2d3e4-1a2b-0", "attempt": 2, "outcome": "failed",
+    "start_iter": 10, "iters": 0, "seconds": 0.41,
+    "error": "SimulatedDeviceLoss: injected device loss at iteration 10",
+    "failure_kind": "transient", "algorithm": "agd",
+}
+
+EXAMPLE_RECOVERY_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "recovery",
+    "run_id": "r18c2d3e4-1a2b-0", "action": "rollback",
+    "reason": "non-finite loss in segment", "failure_kind": "numeric",
+    "from_iter": 10, "to_iter": 10, "big_l": 64.0,
+    "source": "supervisor",
+}
+
 
 def selfcheck() -> Tuple[bool, List[str]]:
     """Validate the example records, a JSON round-trip, and a negative
@@ -277,7 +337,9 @@ def selfcheck() -> Tuple[bool, List[str]]:
                       ("span", EXAMPLE_SPAN_RECORD),
                       ("program_cost", EXAMPLE_PROGRAM_COST_RECORD),
                       ("numerics_failure",
-                       EXAMPLE_NUMERICS_FAILURE_RECORD)):
+                       EXAMPLE_NUMERICS_FAILURE_RECORD),
+                      ("attempt", EXAMPLE_ATTEMPT_RECORD),
+                      ("recovery", EXAMPLE_RECOVERY_RECORD)):
         errs = validate_record(json.loads(json.dumps(rec)))
         if errs:
             ok = False
@@ -301,6 +363,15 @@ def selfcheck() -> Tuple[bool, List[str]]:
         ok = False
         msgs.append("FAIL: program_cost record missing collectives "
                     "passed validation")
+    bad_rec = dict(EXAMPLE_RECOVERY_RECORD)
+    del bad_rec["action"]
+    if validate_record(bad_rec):
+        msgs.append("ok: negative control (recovery missing action) "
+                    "rejected")
+    else:
+        ok = False
+        msgs.append("FAIL: recovery record missing action passed "
+                    "validation")
     stamped = stamp({"value": 1.0}, tool="selfcheck")
     errs = validate_record(stamped)
     if errs:

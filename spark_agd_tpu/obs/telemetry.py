@@ -181,6 +181,30 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def attempt(self, *, attempt: int, outcome: str, **fields) -> dict:
+        """Emit (and return) an ``attempt`` record — one supervised fit
+        attempt (``resilience.supervisor``) — and count it
+        (``resilience.attempts``; failures also land in
+        ``resilience.failed_attempts``)."""
+        self.registry.counter("resilience.attempts").inc()
+        if outcome != "ok":
+            self.registry.counter("resilience.failed_attempts").inc()
+        rec = schema.attempt_record(self.run_id, attempt, outcome,
+                                    **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def recovery(self, *, action: str, **fields) -> dict:
+        """Emit (and return) a ``recovery`` record — one resilience
+        action (retry / rollback / preemption_flush / checkpoint /
+        checkpoint_fallback / resume) — counted per action
+        (``resilience.<action>``), so the run summary's metrics
+        snapshot carries the recovery census."""
+        self.registry.counter(f"resilience.{action}").inc()
+        rec = schema.recovery_record(self.run_id, action, **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

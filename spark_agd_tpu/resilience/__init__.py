@@ -1,0 +1,61 @@
+"""``spark_agd_tpu.resilience`` — the supervision layer.
+
+The reference inherits fault tolerance from Spark (task re-execution,
+lineage recomputation, driver restart); the JAX/TPU runtime has none,
+so this package rebuilds the recovery discipline around the one fact
+that makes it cheap here: the complete optimizer state is two weight
+pytrees plus three scalars.  Four modules (guide:
+``docs/ROBUSTNESS.md``):
+
+- ``errors`` — the failure taxonomy (TRANSIENT / NUMERIC / PREEMPTED /
+  FATAL) and the ONE classifier every recovery path consults;
+- ``retry`` — bounded retries, exponential backoff with deterministic
+  jitter, per-attempt watchdog; shared by the supervisor and the data
+  layer's flaky-IO wrappers;
+- ``autockpt`` — cadence-based auto-checkpointing, a ``.bak``
+  retention chain, corruption-tolerant resume, SIGTERM/SIGINT
+  preemption flush;
+- ``supervisor`` — the fault-aware driver: segmented AGD fits with
+  classified failure handling (transient → retry; non-finite numerics
+  → rollback to the last-good ``AGDWarmState`` with a step-size cut;
+  preemption → flush and unwind; fatal → raise with the attempt
+  ledger), plus the generic ``supervised_call`` for any other runner;
+- ``faults`` — the deterministic fault-injection harness that proves
+  all of the above (``tools/fault_drill.py`` runs the scripted
+  kill-and-resume drill).
+
+Every retry, rollback, preemption flush, and checkpoint fallback lands
+as an ``attempt`` / ``recovery`` record in the canonical ``obs.schema``
+JSONL, so resilience events live in the same stream as the metrics.
+``api.run(..., resilience=ResiliencePolicy(...))`` is the one-argument
+entry point.
+"""
+
+from .errors import (  # noqa: F401
+    FATAL,
+    FAILURE_KINDS,
+    NUMERIC,
+    PREEMPTED,
+    TRANSIENT,
+    AttemptTimeout,
+    NumericsFailureError,
+    Preempted,
+    SimulatedDeviceLoss,
+    SupervisorGivingUp,
+    classify_failure,
+)
+from .retry import (  # noqa: F401
+    BackoffSchedule,
+    RetryPolicy,
+    call_with_retry,
+    retrying,
+)
+from .autockpt import AutoCheckpointer, generation_paths  # noqa: F401
+from .supervisor import (  # noqa: F401
+    ResiliencePolicy,
+    SupervisedResult,
+    run_agd_supervised,
+    supervised_call,
+)
+from . import faults  # noqa: F401
+from .faults import FaultScript  # noqa: F401

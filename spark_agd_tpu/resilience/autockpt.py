@@ -1,0 +1,218 @@
+"""Preemption-safe auto-checkpointing over ``utils.checkpoint``.
+
+``utils.checkpoint.run_agd_checkpointed`` persists at fixed SEGMENT
+boundaries; this module adds the operational half the north star needs
+on preemptible capacity:
+
+- **cadence**: save every N accumulated iterations and/or every T
+  seconds, whichever fires first (both optional; ``force=True`` always
+  saves) — so a slow segment cannot outrun the checkpoint budget;
+- **retention**: the last K generations survive as a ``.bak`` chain
+  (``path``, ``path.bak``, ``path.bak2`` …) rotated atomically before
+  each write, so one torn write never erases the run;
+- **corruption-tolerant load**: :meth:`load` walks the chain newest →
+  oldest, skipping corrupt generations (typed
+  ``CheckpointCorruptError``) and emitting one ``recovery`` record per
+  skip — a truncated latest file resumes from the surviving
+  generation;
+- **preemption flush**: :meth:`install_signal_handlers` hooks
+  SIGTERM/SIGINT; on delivery the last state handed to
+  :meth:`update` is flushed to disk, a ``recovery`` record
+  (``action="preemption_flush"``) is emitted, and
+  :class:`~spark_agd_tpu.resilience.errors.Preempted` is raised into
+  the main thread so drivers unwind — rerunning the same call resumes
+  from the flushed carry.
+"""
+
+from __future__ import annotations
+
+import os
+import signal as signal_lib
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..utils import checkpoint as ckpt
+from .errors import Preempted
+
+
+def generation_paths(path: str, keep: int) -> list:
+    """Newest-first retention chain: ``path``, ``path.bak``,
+    ``path.bak2``, … (``keep`` entries total)."""
+    out = [path]
+    for i in range(1, keep):
+        out.append(path + (".bak" if i == 1 else f".bak{i}"))
+    return out
+
+
+class AutoCheckpointer:
+    """See module docstring.  ``telemetry`` (``obs.Telemetry``,
+    optional) receives one ``recovery`` record per checkpoint written,
+    generation skipped, and preemption flush.
+
+    Thread/signal safety: :meth:`update` stores the latest state
+    BEFORE testing cadence, so a signal arriving at any point flushes
+    a state no older than the last completed segment.  The atomic
+    write (tempfile + rename, ``utils.checkpoint.atomic_savez``) makes
+    the flush itself kill-safe.
+    """
+
+    def __init__(self, path: str, *,
+                 every_iters: Optional[int] = None,
+                 every_seconds: Optional[float] = None,
+                 keep: int = 2,
+                 fingerprint: Optional[str] = None,
+                 telemetry=None,
+                 clock=time.monotonic):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        if every_iters is not None and every_iters < 1:
+            raise ValueError("every_iters must be >= 1")
+        if every_seconds is not None and every_seconds <= 0:
+            raise ValueError("every_seconds must be > 0")
+        self.path = path
+        self.every_iters = every_iters
+        self.every_seconds = every_seconds
+        self.keep = keep
+        self.fingerprint = fingerprint
+        self.telemetry = telemetry
+        self._clock = clock
+        self._last_saved_iters: Optional[int] = None
+        self._last_saved_t: Optional[float] = None
+        self._latest = None  # (warm, hist, converged, aborted)
+        self._prev_handlers = None
+        self.saves = 0
+        self.preempted = False
+
+    # -- cadence ----------------------------------------------------------
+    def _due(self, prior_iters: int) -> bool:
+        if self._last_saved_iters is None:
+            return True  # first state seen: establish generation zero
+        if (self.every_iters is not None and
+                prior_iters - self._last_saved_iters >= self.every_iters):
+            return True
+        if (self.every_seconds is not None and
+                self._clock() - self._last_saved_t >= self.every_seconds):
+            return True
+        return False
+
+    def update(self, warm, hist=None, *, converged: bool = False,
+               aborted: bool = False, force: bool = False) -> bool:
+        """Hand the checkpointer the newest carry; writes when the
+        cadence is due (or ``force``).  Returns True when a file was
+        written."""
+        self._latest = (warm, hist, bool(converged), bool(aborted))
+        if not (force or self._due(int(warm.prior_iters))):
+            return False
+        self._save(*self._latest)
+        return True
+
+    def flush(self, *, reason: str = "flush") -> bool:
+        """Force-write the latest known state (no-op when none seen)."""
+        if self._latest is None:
+            return False
+        self._save(*self._latest, action=reason)
+        return True
+
+    def _save(self, warm, hist, converged, aborted, *,
+              action: str = "checkpoint") -> None:
+        self._rotate()
+        ckpt.save_checkpoint(
+            self.path, warm,
+            None if hist is None else np.asarray(hist),
+            converged=converged, aborted=aborted,
+            fingerprint=self.fingerprint)
+        self._last_saved_iters = int(warm.prior_iters)
+        self._last_saved_t = self._clock()
+        self.saves += 1
+        if self.telemetry is not None:
+            self.telemetry.recovery(
+                action=action, path=self.path,
+                to_iter=int(warm.prior_iters), source="autockpt")
+
+    def _rotate(self) -> None:
+        """Shift the retention chain one slot (oldest generation falls
+        off); each shift is a rename, so the chain never holds a
+        half-copied file."""
+        gens = generation_paths(self.path, self.keep)
+        if os.path.exists(gens[-1]) and self.keep > 1:
+            os.unlink(gens[-1])
+        for newer, older in zip(reversed(gens[:-1]), reversed(gens[1:])):
+            if os.path.exists(newer) and self.keep > 1:
+                os.replace(newer, older)
+
+    # -- corruption-tolerant load -----------------------------------------
+    def load(self, template: Any) -> Optional[ckpt.LoadedCheckpoint]:
+        """Walk the generation chain newest → oldest; return the first
+        loadable checkpoint (fingerprint-validated), skipping corrupt
+        generations with a ``recovery`` record each.  None when no
+        generation exists/survives — corrupt-only chains resume from
+        scratch rather than refusing to run (every skip was
+        recorded)."""
+        found_any = False
+        for gen, path in enumerate(generation_paths(self.path, self.keep)):
+            if not os.path.exists(path):
+                continue
+            found_any = True
+            try:
+                loaded = ckpt.load_checkpoint(
+                    path, template, expect_fingerprint=self.fingerprint,
+                    fallback_to_bak=False)
+            except ckpt.CheckpointCorruptError as e:
+                ckpt.logger.warning("skipping corrupt checkpoint "
+                                    "generation %d: %s", gen, e)
+                if self.telemetry is not None:
+                    self.telemetry.recovery(
+                        action="checkpoint_fallback", path=path,
+                        generation=gen, reason=str(e), source="autockpt")
+                continue
+            if loaded is not None:
+                if gen > 0 and self.telemetry is not None:
+                    self.telemetry.recovery(
+                        action="resume", path=path, generation=gen,
+                        to_iter=int(loaded.warm.prior_iters),
+                        source="autockpt")
+                # seed cadence state so the next segment doesn't
+                # immediately re-save what we just read
+                self._last_saved_iters = int(loaded.warm.prior_iters)
+                self._last_saved_t = self._clock()
+                return loaded
+        if found_any:
+            ckpt.logger.warning(
+                "every checkpoint generation at %r was corrupt; "
+                "starting from scratch", self.path)
+        return None
+
+    # -- preemption -------------------------------------------------------
+    def _on_signal(self, signum, frame):
+        self.preempted = True
+        self.flush(reason="preemption_flush")
+        raise Preempted(signum)
+
+    def install_signal_handlers(self, signals=(signal_lib.SIGTERM,
+                                               signal_lib.SIGINT)):
+        """Install the flush-then-``Preempted`` handler (main thread
+        only — Python routes signals there).  Idempotent; pair with
+        :meth:`uninstall_signal_handlers` (or use the instance as a
+        context manager)."""
+        if self._prev_handlers is not None:
+            return
+        self._prev_handlers = {}
+        for s in signals:
+            self._prev_handlers[s] = signal_lib.signal(s, self._on_signal)
+
+    def uninstall_signal_handlers(self):
+        if self._prev_handlers is None:
+            return
+        for s, h in self._prev_handlers.items():
+            signal_lib.signal(s, h)
+        self._prev_handlers = None
+
+    def __enter__(self):
+        self.install_signal_handlers()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.uninstall_signal_handlers()
+        return False

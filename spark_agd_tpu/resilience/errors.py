@@ -1,0 +1,122 @@
+"""Failure taxonomy + the ONE classifier every recovery path consults.
+
+The reference inherits Spark's implicit taxonomy: a lost executor is
+retried by the scheduler, a deterministic exception fails the job, and a
+non-finite loss silently terminates the loop (reference
+``AcceleratedGradientDescent.scala:309-312``).  Here the taxonomy is
+explicit and shared — the supervisor (``resilience.supervisor``), the
+retrying IO helper (``resilience.retry``), the sanitizer
+(``utils.debug.report_numerics_failure``), and the fault-injection
+harness (``resilience.faults``) all speak these kinds:
+
+- ``TRANSIENT`` — worth retrying as-is: simulated/real device loss,
+  runtime/IO errors, attempt timeouts.  The supervisor retries with
+  exponential backoff; the same attempt is expected to succeed.
+- ``NUMERIC`` — the math went non-finite: retrying the identical
+  attempt would fail identically.  The supervisor rolls back to the
+  last-good ``AGDWarmState`` with a step-size cut instead.
+- ``PREEMPTED`` — the host was told to go away (SIGTERM/SIGINT).  The
+  auto-checkpointer has already flushed; the supervisor re-raises so
+  the process can exit and a NEW process resumes from the checkpoint.
+- ``FATAL`` — a programming/config error (ValueError, TypeError, …):
+  retrying is noise; raise immediately with the attempt ledger.
+
+Deliberately stdlib-only (no jax import): ``utils.debug`` and the data
+layer import this leaf without dragging in the supervisor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+TRANSIENT = "transient"
+NUMERIC = "numeric"
+PREEMPTED = "preempted"
+FATAL = "fatal"
+
+FAILURE_KINDS = (TRANSIENT, NUMERIC, PREEMPTED, FATAL)
+
+
+class SimulatedDeviceLoss(RuntimeError):
+    """A fault-injected stand-in for the runtime losing a device
+    mid-run (TPU preemption sibling: the XLA ``DATA_LOSS`` /
+    ``UNAVAILABLE`` RuntimeErrors).  Classified TRANSIENT."""
+
+
+class NumericsFailureError(FloatingPointError):
+    """The smooth evaluation (or the in-loop loss stream) went
+    non-finite — raised by ``utils.debug.report_numerics_failure`` so a
+    sanitizer hit enters the SAME rollback path as the fused loop's
+    abort flag.  ``FloatingPointError`` parent: classified NUMERIC by
+    type, not by message-matching."""
+
+
+class Preempted(Exception):
+    """Raised (from the ``AutoCheckpointer`` signal handler) after the
+    preemption flush lands: the process must stop, and a rerun of the
+    same call resumes from the flushed checkpoint."""
+
+    def __init__(self, signum: Optional[int] = None):
+        super().__init__(
+            f"preempted (signal {signum}); final checkpoint flushed"
+            if signum is not None else "preempted")
+        self.signum = signum
+
+
+class AttemptTimeout(TimeoutError):
+    """The per-attempt wall-clock watchdog fired.  Classified
+    TRANSIENT (a hung collective / stuck host looks exactly like a
+    lost device from the driver's seat)."""
+
+    def __init__(self, label: str, seconds: float):
+        super().__init__(f"{label}: attempt exceeded {seconds:g}s "
+                         "wall-clock watchdog")
+        self.seconds = seconds
+
+
+class SupervisorGivingUp(RuntimeError):
+    """The policy's budget is exhausted (retries or rollbacks) or the
+    failure was FATAL.  Carries the full attempt ledger so the
+    post-mortem does not depend on scraping logs."""
+
+    def __init__(self, message: str, ledger: Optional[List[dict]] = None):
+        super().__init__(message)
+        self.ledger = list(ledger or [])
+
+
+# message fragments that mark a RuntimeError as the runtime losing its
+# backend rather than a code bug (XLA status codes surface as text)
+_TRANSIENT_RUNTIME_MARKERS = (
+    "data_loss", "unavailable", "deadline_exceeded", "resource_exhausted",
+    "device", "socket closed", "connection reset", "aborted",
+)
+_NUMERIC_MARKERS = ("non-finite", "nan", " inf")
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map one exception to a failure kind (module constants).
+
+    Typed exceptions classify by type; bare ``RuntimeError`` (how both
+    jaxlib's ``XlaRuntimeError`` and checkify's ``JaxRuntimeError``
+    reach Python) falls back to message inspection — non-finite text
+    means NUMERIC, device/status markers (or no marker at all) mean
+    TRANSIENT, matching the issue contract "transient RuntimeError /
+    device loss → retry".
+    """
+    if isinstance(exc, Preempted):
+        return PREEMPTED
+    if isinstance(exc, (NumericsFailureError, FloatingPointError,
+                        ZeroDivisionError)):
+        return NUMERIC
+    if isinstance(exc, (SimulatedDeviceLoss, TimeoutError, OSError,
+                        ConnectionError, BrokenPipeError)):
+        return TRANSIENT
+    if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError,
+                        AssertionError, NotImplementedError)):
+        return FATAL
+    if isinstance(exc, RuntimeError):
+        msg = str(exc).lower()
+        if any(m in msg for m in _NUMERIC_MARKERS):
+            return NUMERIC
+        return TRANSIENT
+    return FATAL

@@ -1,0 +1,390 @@
+"""The fault-aware driver: supervised AGD fits with retry, rollback,
+and auto-checkpointing.
+
+The reference gets this layer for free from Spark — a failed task is
+re-executed from lineage, a lost executor's partitions recompute, and
+the driver survives by rerunning the job.  The JAX runtime offers none
+of that, so the supervisor rebuilds it at the one place the math makes
+cheap: the AGD carry is two weight pytrees plus three scalars
+(``core.agd.AGDWarmState``), so "re-run from last-good state" costs a
+tiny host copy, not a lineage graph.
+
+The execution model is SEGMENTED: ``policy.segment_iters`` compiled
+iterations per attempt (one jitted program per distinct segment
+length, exactly like ``utils.checkpoint.run_agd_checkpointed``).  Each
+segment runs under the retry engine (``resilience.retry``) with the
+shared failure taxonomy (``resilience.errors``):
+
+- TRANSIENT (device loss, runtime/IO errors, watchdog timeouts) —
+  retry the SAME segment from the same warm state, after exponential
+  backoff + jitter, at most ``max_attempts`` tries per segment;
+- NUMERIC (a non-finite loss — the fused loop's abort flag, or a
+  ``NumericsFailureError`` out of ``utils.debug``'s sanitizer) —
+  ROLL BACK: restore the last-good warm state with its Lipschitz
+  estimate multiplied by ``rollback_l_factor`` (the proximal step is
+  ``1/L``, so this is the step-size cut), at most ``max_rollbacks``
+  times; the poisoned segment's iterations and history are discarded;
+- PREEMPTED — the auto-checkpointer's handler already flushed;
+  re-raise so the process exits and the NEXT process resumes;
+- FATAL — raise :class:`SupervisorGivingUp` immediately, attempt
+  ledger attached.
+
+Every attempt lands as an ``attempt`` record and every recovery action
+as a ``recovery`` record in the canonical ``obs.schema`` JSONL, next to
+the run's metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, List, NamedTuple, Optional
+
+import jax
+import numpy as np
+
+from ..core import agd
+from ..core.agd import AGDConfig, AGDWarmState
+from ..utils import checkpoint as ckpt
+from . import errors, faults as faults_lib, retry as retry_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class ResiliencePolicy(retry_lib.RetryPolicy):
+    """The supervisor's knob set: the retry engine's fields
+    (``max_attempts``, ``backoff_*``, ``jitter``, ``seed``,
+    ``attempt_timeout``) plus the rollback and segmentation policy.
+
+    ``segment_iters=None`` runs the whole remaining budget as one
+    attempt (cheapest; rollback then restarts from the initial point or
+    the last checkpoint).  Smaller segments bound how much work one
+    fault can destroy — and set the granularity of auto-checkpoints,
+    fault injection, and preemption points.
+    """
+
+    max_rollbacks: int = 3
+    rollback_l_factor: float = 4.0
+    segment_iters: Optional[int] = None
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+        if self.rollback_l_factor <= 1.0:
+            raise ValueError(
+                "rollback_l_factor must be > 1 (a rollback must CUT "
+                "the step, or the retried segment fails identically)")
+        if self.segment_iters is not None and self.segment_iters < 1:
+            raise ValueError("segment_iters must be >= 1")
+
+
+class SupervisedResult(NamedTuple):
+    weights: Any
+    loss_history: np.ndarray
+    num_iters: int            # executed iterations that COUNT (rolled-
+    #                           back segments' work is discarded)
+    converged: bool
+    aborted_non_finite: bool  # True only when rollbacks were exhausted
+    #                           and the policy said to return, not raise
+    retries: int              # transient re-attempts across the run
+    rollbacks: int            # numeric rollbacks across the run
+    resumed_from: int         # iterations already checkpointed at start
+    attempts: List[dict]      # the full ledger, one dict per attempt
+
+
+def _rollback(warm: AGDWarmState, factor: float) -> AGDWarmState:
+    """Last-good carry with the step cut: the proximal step is ``1/L``,
+    so multiplying the Lipschitz estimate by ``factor`` shrinks the
+    next step by the same ratio.  ``bts=True`` re-arms backtracking so
+    the cut estimate can still grow back if it proves conservative."""
+    return warm._replace(big_l=float(warm.big_l) * float(factor),
+                         bts=True)
+
+
+def run_agd_supervised(
+    smooth: Optional[Callable] = None,
+    prox: Callable = None,
+    reg_value: Callable = None,
+    w0: Any = None,
+    config: AGDConfig = None,
+    *,
+    policy: Optional[ResiliencePolicy] = None,
+    telemetry=None,
+    checkpointer=None,
+    staged=None,
+    smooth_loss: Optional[Callable] = None,
+    faults: Optional["faults_lib.FaultScript"] = None,
+    place_w: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> SupervisedResult:
+    """Run one AGD fit to completion under the supervision policy.
+
+    ``staged=(build, data_args)`` (from ``core.smooth.
+    make_smooth_staged`` / the dist twin) passes the data THROUGH jit
+    as arguments — mandatory at scale (a closure smooth embeds the
+    dataset as program constants); ``smooth``/``smooth_loss`` closures
+    remain supported for small problems.  ``place_w`` (optional) maps
+    the initial weights onto devices (mesh replication) before the
+    first segment.
+
+    ``checkpointer`` (an :class:`~spark_agd_tpu.resilience.autockpt.
+    AutoCheckpointer`): resume happens from its surviving generation
+    (corruption-tolerant), each completed segment is offered for a
+    cadence save, signal handlers are installed for the duration of
+    the run, and terminal states are force-flushed.
+
+    ``faults`` (a :class:`~spark_agd_tpu.resilience.faults.
+    FaultScript`): consulted at segment boundaries — test/drill only.
+    """
+    if w0 is None or config is None:
+        raise ValueError("w0 and config are required")
+    if staged is None and smooth is None:
+        raise ValueError("pass smooth=... or staged=(build, data_args)")
+    policy = policy or ResiliencePolicy()
+    w0 = jax.tree_util.tree_map(np.asarray, w0)
+    if place_w is not None:
+        w0 = place_w(w0)
+
+    tel_cb = (None if telemetry is None
+              else telemetry.iteration_callback("agd"))
+
+    # one jitted program per (segment length, poisoned); the poisoned
+    # variant only ever traces in drills/tests
+    seg_fns = {}
+
+    def run_segment(warm: AGDWarmState, k: int, poisoned: bool):
+        cfg_k = dataclasses.replace(config, num_iterations=k)
+        key = (k, poisoned)
+        if staged is not None:
+            build, dargs = staged
+            if key not in seg_fns:
+                def _seg(ws, da, c=cfg_k, poison=poisoned):
+                    sm, sl = build(*da)
+                    if poison:
+                        sm = faults_lib.poison_smooth(sm)
+                    return agd.run_agd(sm, prox, reg_value, ws.x, c,
+                                       smooth_loss=sl, warm=ws,
+                                       telemetry_cb=tel_cb)
+
+                seg_fns[key] = jax.jit(_seg)
+            res = seg_fns[key](warm, dargs)
+        else:
+            if key not in seg_fns:
+                sm = (faults_lib.poison_smooth(smooth) if poisoned
+                      else smooth)
+                seg_fns[key] = jax.jit(
+                    lambda ws, c=cfg_k, s=sm: agd.run_agd(
+                        s, prox, reg_value, ws.x, c,
+                        smooth_loss=smooth_loss, warm=ws,
+                        telemetry_cb=tel_cb))
+            res = seg_fns[key](warm)
+        jax.block_until_ready(res.num_iters)
+        return res
+
+    # -- resume ----------------------------------------------------------
+    hist: list = []
+    warm = None
+    if checkpointer is not None:
+        loaded = checkpointer.load(w0)
+        if loaded is not None:
+            if loaded.converged or loaded.aborted:
+                # terminal checkpoint: rerunning must not add iterations
+                return SupervisedResult(
+                    weights=loaded.warm.x,
+                    loss_history=np.asarray(loaded.loss_history),
+                    num_iters=int(loaded.warm.prior_iters),
+                    converged=loaded.converged,
+                    aborted_non_finite=loaded.aborted,
+                    retries=0, rollbacks=0,
+                    resumed_from=int(loaded.warm.prior_iters),
+                    attempts=[])
+            warm = loaded.warm
+            hist = list(np.asarray(loaded.loss_history))
+    if warm is None:
+        warm = AGDWarmState.initial(w0, config)
+    resumed_from = int(warm.prior_iters)
+    if checkpointer is not None:
+        checkpointer.install_signal_handlers()
+        checkpointer.update(warm, hist)  # generation zero / post-resume
+
+    schedule = policy.backoff_schedule()
+    ledger: List[dict] = []
+    attempt_no = 0
+    seg_failures = 0   # consecutive transient failures of THIS segment
+    retries = rollbacks = 0
+    converged = aborted = False
+    total = int(config.num_iterations)
+
+    def record_attempt(outcome: str, start_iter: int, iters: int,
+                       seconds: float, error: Optional[str] = None,
+                       failure_kind: Optional[str] = None):
+        entry = {"attempt": attempt_no, "outcome": outcome,
+                 "start_iter": start_iter, "iters": iters,
+                 "seconds": round(seconds, 6), "error": error,
+                 "failure_kind": failure_kind, "algorithm": "agd"}
+        ledger.append(entry)
+        if telemetry is not None:
+            telemetry.attempt(**entry)
+
+    def recovery(action: str, **fields):
+        if telemetry is not None:
+            telemetry.recovery(action=action, **fields)
+
+    def numeric_rollback(start: int, reason: str):
+        nonlocal warm, rollbacks
+        if rollbacks >= policy.max_rollbacks:
+            raise errors.SupervisorGivingUp(
+                f"non-finite numerics persisted through "
+                f"{policy.max_rollbacks} rollbacks (last: {reason})",
+                ledger)
+        rollbacks += 1
+        warm = _rollback(warm, policy.rollback_l_factor)
+        recovery("rollback", reason=reason, failure_kind=errors.NUMERIC,
+                 from_iter=start, to_iter=int(warm.prior_iters),
+                 big_l=float(warm.big_l), source="supervisor")
+
+    try:
+        while int(warm.prior_iters) < total:
+            start = int(warm.prior_iters)
+            k = min(policy.segment_iters or total, total - start)
+            if faults is not None:
+                try:
+                    faults.before_segment(start)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    attempt_no += 1
+                    kind = errors.classify_failure(e)
+                    record_attempt("failed", start, 0, 0.0,
+                                   error=f"{type(e).__name__}: {e}",
+                                   failure_kind=kind)
+                    if kind != errors.TRANSIENT:
+                        raise
+                    seg_failures += 1
+                    retries += 1
+                    if seg_failures >= policy.max_attempts:
+                        raise errors.SupervisorGivingUp(
+                            f"segment at iteration {start} failed "
+                            f"{seg_failures} times (last: {e})",
+                            ledger) from e
+                    delay = schedule.next_delay(seg_failures)
+                    recovery("retry", reason=str(e), failure_kind=kind,
+                             attempt=seg_failures, backoff_s=delay,
+                             from_iter=start, source="supervisor")
+                    if delay:
+                        sleep(delay)
+                    continue
+            poisoned = (faults is not None and faults.take_poison(start))
+
+            attempt_no += 1
+            t0 = time.perf_counter()
+            try:
+                res = retry_lib.run_with_watchdog(
+                    run_segment, (warm, k, poisoned), {},
+                    policy.attempt_timeout, f"agd@{start}")
+            except errors.Preempted:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                dt = time.perf_counter() - t0
+                kind = errors.classify_failure(e)
+                record_attempt("failed", start, 0, dt,
+                               error=f"{type(e).__name__}: {e}",
+                               failure_kind=kind)
+                if kind == errors.NUMERIC:
+                    numeric_rollback(start, f"{type(e).__name__}: {e}")
+                    seg_failures = 0
+                    continue
+                if kind == errors.TRANSIENT:
+                    seg_failures += 1
+                    retries += 1
+                    if seg_failures >= policy.max_attempts:
+                        raise errors.SupervisorGivingUp(
+                            f"segment at iteration {start} failed "
+                            f"{seg_failures} times (last: {e})",
+                            ledger) from e
+                    delay = schedule.next_delay(seg_failures)
+                    recovery("retry", reason=str(e), failure_kind=kind,
+                             attempt=seg_failures, backoff_s=delay,
+                             from_iter=start, source="supervisor")
+                    if delay:
+                        sleep(delay)
+                    continue
+                raise errors.SupervisorGivingUp(
+                    f"fatal failure at iteration {start}: "
+                    f"{type(e).__name__}: {e}", ledger) from e
+            dt = time.perf_counter() - t0
+
+            if bool(res.aborted_non_finite):
+                record_attempt("aborted_non_finite", start,
+                               int(res.num_iters), dt,
+                               failure_kind=errors.NUMERIC)
+                numeric_rollback(start, "non-finite loss in segment")
+                seg_failures = 0
+                continue
+
+            done = int(res.num_iters)
+            record_attempt("ok", start, done, dt)
+            hist.extend(np.asarray(res.loss_history)[:done].tolist())
+            warm = ckpt.warm_from_result(res, start + done)
+            converged = bool(res.converged)
+            seg_failures = 0
+            if checkpointer is not None:
+                checkpointer.update(warm, hist, converged=converged)
+            if converged or done == 0:
+                break
+    finally:
+        if checkpointer is not None:
+            # terminal/abandon flush: whatever the exit path, the last
+            # completed state is on disk before handlers come off
+            checkpointer.update(warm, hist, converged=converged,
+                                aborted=aborted, force=True)
+            checkpointer.uninstall_signal_handlers()
+
+    return SupervisedResult(
+        weights=warm.x, loss_history=np.asarray(hist),
+        num_iters=int(warm.prior_iters), converged=converged,
+        aborted_non_finite=aborted, retries=retries,
+        rollbacks=rollbacks, resumed_from=resumed_from,
+        attempts=ledger)
+
+
+def supervised_call(fn: Callable, *args, policy=None, telemetry=None,
+                    label: str = "fit", **kwargs):
+    """Wrap ANY runner's ``fit`` (L-BFGS, sweeps, custom drivers) in the
+    bounded-retry half of the supervision policy — the generic member
+    for result types that carry no ``AGDWarmState`` to roll back to.
+    Transient failures retry with backoff (each emitting a ``recovery``
+    record); NUMERIC/FATAL raise immediately; the final failure raises
+    :class:`SupervisorGivingUp` with the ledger."""
+    policy = policy or ResiliencePolicy()
+    ledger: List[dict] = []
+    attempt = [0]
+
+    def attempted(*a, **kw):
+        attempt[0] += 1
+        t0 = time.perf_counter()
+        try:
+            out = fn(*a, **kw)
+        except Exception as e:
+            entry = {"attempt": attempt[0], "outcome": "failed",
+                     "seconds": round(time.perf_counter() - t0, 6),
+                     "error": f"{type(e).__name__}: {e}",
+                     "failure_kind": errors.classify_failure(e)}
+            ledger.append(entry)
+            if telemetry is not None:
+                telemetry.attempt(**entry)
+            raise
+        entry = {"attempt": attempt[0], "outcome": "ok",
+                 "seconds": round(time.perf_counter() - t0, 6)}
+        ledger.append(entry)
+        if telemetry is not None:
+            telemetry.attempt(**entry)
+        return out
+
+    try:
+        return retry_lib.call_with_retry(
+            attempted, *args, policy=policy, label=label,
+            telemetry=telemetry, **kwargs)
+    except Exception as e:
+        if isinstance(e, (errors.Preempted, errors.SupervisorGivingUp)):
+            raise
+        raise errors.SupervisorGivingUp(
+            f"{label}: {type(e).__name__}: {e}", ledger) from e

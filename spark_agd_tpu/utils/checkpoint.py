@@ -26,9 +26,11 @@ zero-step gate via ``prior_iters``), pinned by the parity tests in
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import tempfile
-from typing import Any, NamedTuple, Optional
+import zipfile
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +39,62 @@ import numpy as np
 from ..core import agd
 from ..core.agd import AGDConfig, AGDWarmState
 
+logger = logging.getLogger("spark_agd_tpu")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """``path`` holds a truncated/garbage npz (kill mid-write on a
+    non-atomic filesystem, torn volume, bad sector) — the typed wrapper
+    every loader raises instead of surfacing a raw
+    ``zipfile.BadZipFile`` / zlib error from deep inside numpy.
+    Classified TRANSIENT-adjacent by recovery code: the
+    ``AutoCheckpointer`` falls back to the previous ``.bak``
+    generation; ``load_checkpoint`` does the same one-level fallback
+    itself."""
+
+    def __init__(self, path: str, cause: Optional[BaseException] = None):
+        detail = f" ({type(cause).__name__}: {cause})" if cause else ""
+        super().__init__(f"checkpoint at {path!r} is corrupt or "
+                         f"truncated{detail}")
+        self.path = path
+
 
 def _flat(tree):
     return jax.tree_util.tree_leaves(tree)
+
+
+def read_npz_entries(path: str) -> Dict[str, np.ndarray]:
+    """Materialize EVERY entry of an npz into host arrays, converting
+    any parse failure — bad zip directory, truncated member, zlib
+    garbage — into one typed :class:`CheckpointCorruptError`.  Forcing
+    the full read up front is the point: ``np.load`` is lazy, so a
+    truncated member would otherwise explode only at first access,
+    midway through rebuilding a pytree."""
+    try:
+        with np.load(path) as data:
+            return {k: np.asarray(data[k]) for k in data.files}
+    except (zipfile.BadZipFile, EOFError, OSError, KeyError,
+            ValueError) as e:
+        raise CheckpointCorruptError(path, e) from e
+
+
+class _Entries:
+    """Dict view over materialized npz entries whose missing-key error
+    is the typed corruption error (a successfully-unzipped file missing
+    required keys is a torn write, not a different format)."""
+
+    def __init__(self, path: str, entries: Dict[str, np.ndarray]):
+        self._path = path
+        self._entries = entries
+
+    def __contains__(self, key):
+        return key in self._entries
+
+    def __getitem__(self, key):
+        try:
+            return self._entries[key]
+        except KeyError as e:
+            raise CheckpointCorruptError(self._path, e) from e
 
 
 def _load_tree(data, treedef, n: int, name: str):
@@ -116,18 +171,27 @@ class LoadedCheckpoint(NamedTuple):
 
 
 def load_checkpoint(path: str, template: Any,
-                    expect_fingerprint: Optional[str] = None,
+                    expect_fingerprint: Optional[str] = None, *,
+                    fallback_to_bak: bool = True,
                     ) -> Optional[LoadedCheckpoint]:
     """Rebuild a checkpoint from ``path``; None if the file does not exist.
     ``template`` supplies the pytree structure (and therefore leaf order)
     of the weights — normally ``w0``.  If ``expect_fingerprint`` is given
     and the file carries a different one, raises ValueError rather than
-    resuming the wrong problem."""
+    resuming the wrong problem.
+
+    A truncated/garbage file raises :class:`CheckpointCorruptError` —
+    unless ``fallback_to_bak`` (default) and a ``path + ".bak"``
+    generation exists (the ``AutoCheckpointer`` retention chain), in
+    which case the previous generation is loaded instead (logged).  The
+    corrupt primary is left in place for post-mortems; the next save
+    atomically replaces it."""
     if not os.path.exists(path):
         return None
-    treedef = jax.tree_util.tree_structure(template)
-    n = treedef.num_leaves
-    with np.load(path) as data:
+    try:
+        data = _Entries(path, read_npz_entries(path))
+        treedef = jax.tree_util.tree_structure(template)
+        n = treedef.num_leaves
         fp = str(data["fingerprint"]) if "fingerprint" in data else None
         if (expect_fingerprint is not None and fp is not None
                 and fp != expect_fingerprint):
@@ -155,7 +219,16 @@ def load_checkpoint(path: str, template: Any,
         hist = np.asarray(data["loss_history"])
         converged = bool(data["converged"]) if "converged" in data else False
         aborted = bool(data["aborted"]) if "aborted" in data else False
-    return LoadedCheckpoint(warm, hist, converged, aborted, fp)
+        return LoadedCheckpoint(warm, hist, converged, aborted, fp)
+    except CheckpointCorruptError:
+        bak = path + ".bak"
+        if fallback_to_bak and os.path.exists(bak):
+            logger.warning(
+                "checkpoint %r is corrupt; falling back to previous "
+                "generation %r", path, bak)
+            return load_checkpoint(bak, template, expect_fingerprint,
+                                   fallback_to_bak=False)
+        raise
 
 
 # The iteration-zero carry is defined ONCE, in core.agd (all drivers expand
@@ -191,6 +264,7 @@ def run_agd_checkpointed(
     smooth_loss=None,
     driver: str = "fused",
     staged=None,
+    resilience=None,
 ) -> CheckpointedResult:
     """AGD with periodic checkpoints: run ``segment_iters`` outer
     iterations per launch, persist the carry after each.  Kill the
@@ -210,7 +284,16 @@ def run_agd_checkpointed(
     are ignored — a closure-captured smooth embeds the dataset as
     program constants and makes each segment's XLA compile scale with
     nnz (the r4 ``compile_s: 1842.74`` defect class).  Closure smooths
-    remain supported for small problems and custom objectives."""
+    remain supported for small problems and custom objectives.
+
+    ``resilience`` (a ``resilience.RetryPolicy``, or ``True`` for the
+    defaults): each segment additionally runs under the shared
+    bounded-retry helper, so a TRANSIENT failure (device loss, runtime
+    hiccup) re-executes that segment from its already-persisted carry
+    instead of killing the driver.  For the full supervision set
+    (numerics rollback, preemption flush, fault drills) use
+    ``resilience.supervisor.run_agd_supervised`` /
+    ``api.run(resilience=...)``."""
     if segment_iters <= 0:
         raise ValueError("segment_iters must be positive")
     if driver not in ("fused", "host"):
@@ -265,6 +348,18 @@ def run_agd_checkpointed(
                     smooth, prox, reg_value, ws.x, c,
                     smooth_loss=smooth_loss, warm=ws))
         return seg_fns[k](warm_state)
+
+    if resilience is not None:
+        from ..resilience import retry as retry_lib
+
+        retry_policy = (retry_lib.RetryPolicy() if resilience is True
+                        else resilience)
+        plain_segment = run_segment
+
+        def run_segment(warm_state, k):  # noqa: F811 — retry shell
+            return retry_lib.call_with_retry(
+                plain_segment, warm_state, k, policy=retry_policy,
+                label="checkpointed_segment")
 
     total = config.num_iterations
     aborted = False
@@ -326,33 +421,33 @@ def load_multi_checkpoint(path: str, template: Any,
         return None
     treedef = jax.tree_util.tree_structure(template)
     n = treedef.num_leaves
-    with np.load(path) as data:
-        fp = str(data["fingerprint"]) if "fingerprint" in data else None
-        if (expect_fingerprint is not None and fp is not None
-                and fp != expect_fingerprint):
-            raise ValueError(
-                f"checkpoint at {path!r} belongs to a different problem "
-                "(weight structure or config changed); delete it or use "
-                "a different path")
-        if "multi" not in data:
-            raise ValueError(
-                f"checkpoint at {path!r} is a single-run checkpoint, "
-                "not a multi-lane one")
+    data = _Entries(path, read_npz_entries(path))
+    fp = str(data["fingerprint"]) if "fingerprint" in data else None
+    if (expect_fingerprint is not None and fp is not None
+            and fp != expect_fingerprint):
+        raise ValueError(
+            f"checkpoint at {path!r} belongs to a different problem "
+            "(weight structure or config changed); delete it or use "
+            "a different path")
+    if "multi" not in data:
+        raise ValueError(
+            f"checkpoint at {path!r} is a single-run checkpoint, "
+            "not a multi-lane one")
 
-        tree = lambda name: _load_tree(data, treedef, n, name)
+    tree = lambda name: _load_tree(data, treedef, n, name)
 
-        warm = host_agd.HostMultiWarm(
-            x=tree("x"), z=tree("z"),
-            theta=np.asarray(data["theta"]),
-            big_l=np.asarray(data["big_l"]),
-            bts=np.asarray(data["bts"]),
-            prior_iters=np.asarray(data["prior_iters"]),
-            converged=np.asarray(data["converged"]),
-            aborted=np.asarray(data["aborted"]),
-            num_backtracks=np.asarray(data["num_backtracks"]),
-            num_restarts=np.asarray(data["num_restarts"]),
-            last_loss=np.asarray(data["last_loss"]))
-        hist = np.asarray(data["loss_history"])
+    warm = host_agd.HostMultiWarm(
+        x=tree("x"), z=tree("z"),
+        theta=np.asarray(data["theta"]),
+        big_l=np.asarray(data["big_l"]),
+        bts=np.asarray(data["bts"]),
+        prior_iters=np.asarray(data["prior_iters"]),
+        converged=np.asarray(data["converged"]),
+        aborted=np.asarray(data["aborted"]),
+        num_backtracks=np.asarray(data["num_backtracks"]),
+        num_restarts=np.asarray(data["num_restarts"]),
+        last_loss=np.asarray(data["last_loss"]))
+    hist = np.asarray(data["loss_history"])
     return warm, hist
 
 
@@ -496,33 +591,32 @@ def load_lbfgs_checkpoint(path: str, template: Any,
         return None
     treedef = jax.tree_util.tree_structure(template)
     n = treedef.num_leaves
-    with np.load(path) as data:
-        if "lbfgs" not in data:
-            raise ValueError(
-                f"checkpoint at {path!r} is not an L-BFGS checkpoint; "
-                "load it with load_checkpoint / load_multi_checkpoint")
-        fp = str(data["fingerprint"]) if "fingerprint" in data else None
-        if (expect_fingerprint is not None and fp is not None
-                and fp != expect_fingerprint):
-            raise ValueError(
-                f"checkpoint at {path!r} belongs to a different problem "
-                "(weight structure or config changed); delete it or use "
-                "a different path")
+    data = _Entries(path, read_npz_entries(path))
+    if "lbfgs" not in data:
+        raise ValueError(
+            f"checkpoint at {path!r} is not an L-BFGS checkpoint; "
+            "load it with load_checkpoint / load_multi_checkpoint")
+    fp = str(data["fingerprint"]) if "fingerprint" in data else None
+    if (expect_fingerprint is not None and fp is not None
+            and fp != expect_fingerprint):
+        raise ValueError(
+            f"checkpoint at {path!r} belongs to a different problem "
+            "(weight structure or config changed); delete it or use "
+            "a different path")
 
-        tree = lambda name: _load_tree(data, treedef, n, name)
+    tree = lambda name: _load_tree(data, treedef, n, name)
 
-        rho = np.asarray(data["rho"])
-        pairs = tuple(
-            (tree(f"p{k}s"), tree(f"p{k}y"), float(rho[k]))
-            for k in range(int(data["n_pairs"])))
-        warm = HostLBFGSWarm(
-            w=tree("w"), f=float(data["f"]), g=tree("g"), pairs=pairs,
-            prior_iters=int(data["prior_iters"]))
-        out = LoadedLBFGSCheckpoint(
-            warm, np.asarray(data["loss_history"]),
-            bool(data["converged"]), bool(data["ls_failed"]),
-            bool(data["aborted"]), fp)
-    return out
+    rho = np.asarray(data["rho"])
+    pairs = tuple(
+        (tree(f"p{k}s"), tree(f"p{k}y"), float(rho[k]))
+        for k in range(int(data["n_pairs"])))
+    warm = HostLBFGSWarm(
+        w=tree("w"), f=float(data["f"]), g=tree("g"), pairs=pairs,
+        prior_iters=int(data["prior_iters"]))
+    return LoadedLBFGSCheckpoint(
+        warm, np.asarray(data["loss_history"]),
+        bool(data["converged"]), bool(data["ls_failed"]),
+        bool(data["aborted"]), fp)
 
 
 class CheckpointedLBFGSResult(NamedTuple):

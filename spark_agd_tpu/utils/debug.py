@@ -57,12 +57,16 @@ def checking_smooth(smooth: Callable[[Any], Tuple[jax.Array, Any]],
 
 def report_numerics_failure(err, telemetry=None, *, source: str = "smooth",
                             **fields) -> None:
-    """Raise a checkify ``Error`` the observable way: when it carries a
-    failure AND a telemetry bus is attached, a ``numerics_failure``
-    record (first failing leaf name parsed from the message, plus any
-    locator ``fields`` — ``evaluation=``, ``iter=``) is emitted to the
-    same JSONL stream as the metrics BEFORE the raise, so a sanitizer
-    hit is an artifact, not just a traceback.  The
+    """Raise a checkify ``Error`` the observable AND classifiable way:
+    when it carries a failure and a telemetry bus is attached, a
+    ``numerics_failure`` record (first failing leaf name parsed from
+    the message, plus any locator ``fields`` — ``evaluation=``,
+    ``iter=``) is emitted to the same JSONL stream as the metrics
+    BEFORE the raise; the raise itself is a typed
+    ``resilience.NumericsFailureError``, which the supervisor's
+    failure classifier maps to NUMERIC — so a sanitizer hit enters the
+    SAME rollback path (last-good warm state, step cut) as the fused
+    loop's abort flag, instead of only existing as an event.  The
     ``checking_smooth``-in-compiled-program pattern calls this instead
     of ``err.throw()``::
 
@@ -70,9 +74,13 @@ def report_numerics_failure(err, telemetry=None, *, source: str = "smooth",
         debug.report_numerics_failure(err, telemetry)   # raises iff bad
     """
     msg = err.get()
-    if msg is not None and telemetry is not None:
+    if msg is None:
+        return
+    if telemetry is not None:
         telemetry.numerics_failure(msg, source=source, **fields)
-    checkify.check_error(err)
+    from ..resilience.errors import NumericsFailureError
+
+    raise NumericsFailureError(msg)
 
 
 def checked_smooth(smooth: Callable[[Any], Tuple[jax.Array, Any]],

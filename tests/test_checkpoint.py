@@ -476,3 +476,92 @@ class TestLBFGSCheckpoint:
         with pytest.raises(ValueError, match="different problem"):
             ckpt.run_lbfgs_checkpointed(obj, np.zeros(d), cfg, path,
                                         segment_iters=4, l1_reg=0.2)
+
+
+class TestCorruptionHardening:
+    """Satellite (resilience PR): a truncated/garbage npz must surface
+    as a typed ``CheckpointCorruptError`` — and fall back to the
+    ``.bak`` generation when one exists — never as a raw
+    ``zipfile.BadZipFile`` out of numpy's lazy reader."""
+
+    def _save(self, path, iters, problem):
+        res = _run(problem, iters)
+        warm = ckpt.warm_from_result(res, iters)
+        ckpt.save_checkpoint(path, warm,
+                             np.asarray(res.loss_history)[:iters])
+        return warm
+
+    def test_truncated_raises_typed_error(self, problem, tmp_path):
+        path = str(tmp_path / "c.npz")
+        self._save(path, 4, problem)
+        size = len(open(path, "rb").read())
+        with open(path, "r+b") as f:  # byte-truncate a REAL checkpoint
+            f.truncate(size // 3)
+        with pytest.raises(ckpt.CheckpointCorruptError, match="c.npz"):
+            ckpt.load_checkpoint(path, problem[4])
+
+    def test_garbage_bytes_raise_typed_error(self, problem, tmp_path):
+        path = str(tmp_path / "c.npz")
+        with open(path, "wb") as f:
+            f.write(b"\x00not a zip archive at all\xff" * 40)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_checkpoint(path, problem[4])
+
+    def test_truncated_member_raises_typed_error(self, problem,
+                                                 tmp_path):
+        """A cut INSIDE the zip payload (directory may still parse):
+        the forced full-read converts the lazy failure too."""
+        path = str(tmp_path / "c.npz")
+        self._save(path, 4, problem)
+        size = len(open(path, "rb").read())
+        with open(path, "r+b") as f:
+            f.truncate(size - 30)  # keep most of the file
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_checkpoint(path, problem[4])
+
+    def test_falls_back_to_bak_generation(self, problem, tmp_path,
+                                          caplog):
+        path = str(tmp_path / "c.npz")
+        warm_old = self._save(path + ".bak", 3, problem)
+        self._save(path, 6, problem)
+        with open(path, "r+b") as f:
+            f.truncate(10)
+        with caplog.at_level(logging.WARNING, logger="spark_agd_tpu"):
+            loaded = ckpt.load_checkpoint(path, problem[4])
+        assert int(loaded.warm.prior_iters) == 3  # the .bak survived
+        np.testing.assert_array_equal(np.asarray(loaded.warm.x),
+                                      np.asarray(warm_old.x))
+        assert any("falling back" in r.message for r in caplog.records)
+
+    def test_corrupt_bak_still_raises(self, problem, tmp_path):
+        path = str(tmp_path / "c.npz")
+        self._save(path, 4, problem)
+        with open(path, "r+b") as f:
+            f.truncate(10)
+        with open(path + ".bak", "wb") as f:
+            f.write(b"also garbage")
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_checkpoint(path, problem[4])
+
+    def test_fallback_opt_out(self, problem, tmp_path):
+        path = str(tmp_path / "c.npz")
+        self._save(path + ".bak", 3, problem)
+        with open(path, "wb") as f:
+            f.write(b"garbage")
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_checkpoint(path, problem[4],
+                                 fallback_to_bak=False)
+
+    def test_multi_loader_raises_typed_error(self, tmp_path):
+        path = str(tmp_path / "m.npz")
+        with open(path, "wb") as f:
+            f.write(b"garbage multi")
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_multi_checkpoint(path, np.zeros((2, 3)))
+
+    def test_lbfgs_loader_raises_typed_error(self, tmp_path):
+        path = str(tmp_path / "l.npz")
+        with open(path, "wb") as f:
+            f.write(b"garbage lbfgs")
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_lbfgs_checkpoint(path, np.zeros(3))

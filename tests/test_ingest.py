@@ -175,3 +175,84 @@ class TestFromPartitionedFilesCSR:
         paths, _, _ = partitioned
         with pytest.raises(ValueError, match="n_features"):
             ingest.from_partitioned_files_csr(paths, n_features=3)
+
+
+class TestRetryableReads:
+    """Satellite (resilience PR): partition reads run under the shared
+    ``resilience.retry`` helper — transient IO errors back off and
+    re-read instead of aborting the whole ingest."""
+
+    def _policy(self, attempts=3):
+        from spark_agd_tpu.resilience import RetryPolicy
+
+        return RetryPolicy(max_attempts=attempts, backoff_base=0.0,
+                           jitter=0.0)
+
+    def test_flaky_loader_retried_to_success(self, cpu_devices,
+                                             partitioned):
+        from spark_agd_tpu.resilience import faults
+
+        paths, X_all, _ = partitioned
+        flaky = faults.flaky(libsvm.load_libsvm, 2)
+        batch = ingest.from_partitioned_files(
+            paths, loader=flaky, retries=self._policy())
+        assert batch.y.shape[0] >= X_all.shape[0]
+        assert flaky.calls() == len(paths) + 2  # 2 failures re-read
+
+    def test_exhausted_retries_raise(self, cpu_devices, partitioned):
+        from spark_agd_tpu.resilience import faults
+
+        paths, _, _ = partitioned
+        flaky = faults.flaky(libsvm.load_libsvm, 99)
+        with pytest.raises(OSError, match="injected IO failure"):
+            ingest.from_partitioned_files(paths, loader=flaky,
+                                          retries=self._policy(2))
+        assert flaky.calls() == 2  # bounded, not unbounded spinning
+
+    def test_retries_emit_recovery_records(self, cpu_devices,
+                                           partitioned):
+        from spark_agd_tpu.obs import Telemetry
+        from spark_agd_tpu.resilience import faults
+
+        paths, _, _ = partitioned
+        tel = Telemetry()
+        flaky = faults.flaky(libsvm.load_libsvm, 1)
+        ingest.from_partitioned_files_csr(
+            paths, loader=flaky, retries=self._policy(),
+            telemetry=tel)
+        recs = [r for r in tel.records if r.get("kind") == "recovery"]
+        assert len(recs) == 1
+        assert recs[0]["action"] == "retry"
+        assert recs[0]["source"] == "ingest_read"
+
+    def test_streaming_parts_retry(self, cpu_devices, partitioned,
+                                   monkeypatch):
+        from spark_agd_tpu.data import streaming
+        from spark_agd_tpu.resilience import faults
+
+        paths, X_all, _ = partitioned
+        flaky = faults.flaky(libsvm.load_libsvm, 2)
+        # from_libsvm_parts resolves the parser via data.libsvm — make
+        # it flaky at the source so retry wraps a really-failing read
+        monkeypatch.setattr("spark_agd_tpu.data.libsvm.load_libsvm",
+                            flaky)
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=X_all.shape[1], batch_rows=32,
+            retries=self._policy())
+        rows = sum(int(m.sum()) for _, _, m in ds)
+        assert rows == X_all.shape[0]
+        assert flaky.calls() > len(paths)  # failures were re-read
+
+    def test_streaming_parts_exhaustion_raises(self, cpu_devices,
+                                               partitioned,
+                                               monkeypatch):
+        from spark_agd_tpu.data import streaming
+        from spark_agd_tpu.resilience import faults
+
+        paths, X_all, _ = partitioned
+        monkeypatch.setattr("spark_agd_tpu.data.libsvm.load_libsvm",
+                            faults.flaky(libsvm.load_libsvm, 99))
+        with pytest.raises(OSError, match="injected IO failure"):
+            streaming.StreamingDataset.from_libsvm_parts(
+                paths, n_features=X_all.shape[1], batch_rows=32,
+                retries=self._policy(2))

@@ -1,0 +1,511 @@
+"""The resilience layer (spark_agd_tpu/resilience/): failure taxonomy,
+retry engine, fault injection, auto-checkpointing, and the supervised
+AGD driver — all CPU-deterministic (``fault`` marker, tier-1)."""
+
+import json
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.core import agd, smooth as smooth_lib
+from spark_agd_tpu.data import synthetic
+from spark_agd_tpu.obs import Telemetry, schema, validate_record
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.resilience import (
+    AttemptTimeout,
+    AutoCheckpointer,
+    FaultScript,
+    NumericsFailureError,
+    Preempted,
+    ResiliencePolicy,
+    RetryPolicy,
+    SimulatedDeviceLoss,
+    SupervisorGivingUp,
+    call_with_retry,
+    classify_failure,
+    errors,
+    faults,
+    generation_paths,
+    retrying,
+    run_agd_supervised,
+    supervised_call,
+)
+from spark_agd_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(scope="module")
+def problem():
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 300, 42)
+    X = synthetic.with_intercept_column(X).astype(np.float32)
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    w0 = jnp.zeros(2, jnp.float32)
+    return build, dargs, px, rv, w0, (X, y)
+
+
+def _policy(**kw):
+    base = dict(max_attempts=3, backoff_base=0.0, jitter=0.0, seed=0,
+                segment_iters=5)
+    base.update(kw)
+    return ResiliencePolicy(**base)
+
+
+def _supervise(problem, cfg, **kw):
+    build, dargs, px, rv, w0, _ = problem
+    return run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                              staged=(build, dargs), **kw)
+
+
+class TestClassifier:
+    @pytest.mark.parametrize("exc,kind", [
+        (SimulatedDeviceLoss("lost"), errors.TRANSIENT),
+        (OSError("nfs hiccup"), errors.TRANSIENT),
+        (TimeoutError("slow"), errors.TRANSIENT),
+        (AttemptTimeout("x", 1.0), errors.TRANSIENT),
+        (RuntimeError("UNAVAILABLE: device"), errors.TRANSIENT),
+        (RuntimeError("something opaque"), errors.TRANSIENT),
+        (RuntimeError("loss non-finite (check failed)"), errors.NUMERIC),
+        (NumericsFailureError("nan"), errors.NUMERIC),
+        (FloatingPointError("overflow"), errors.NUMERIC),
+        (Preempted(15), errors.PREEMPTED),
+        (ValueError("bad arg"), errors.FATAL),
+        (TypeError("bad type"), errors.FATAL),
+        (KeyError("missing"), errors.FATAL),
+    ])
+    def test_kinds(self, exc, kind):
+        assert classify_failure(exc) == kind
+
+
+class TestRetryEngine:
+    def test_backoff_deterministic_and_capped(self):
+        p = RetryPolicy(backoff_base=0.1, backoff_factor=2.0,
+                        backoff_max=0.3, jitter=0.5, seed=7)
+        a = [p.backoff_schedule().next_delay(i) for i in (1, 2, 3, 4)]
+        b = [p.backoff_schedule().next_delay(i) for i in (1, 2, 3, 4)]
+        assert a == b  # seeded jitter is reproducible
+        assert all(d <= 0.3 * 1.5 for d in a)  # cap (+jitter headroom)
+        assert a[1] > a[0] * 0.5  # grows (modulo jitter)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=2.0)
+        with pytest.raises(ValueError):
+            ResiliencePolicy(rollback_l_factor=1.0)
+        with pytest.raises(ValueError):
+            ResiliencePolicy(segment_iters=0)
+
+    def test_flaky_call_recovers(self):
+        fn = faults.flaky(lambda: "done", 2)
+        out = call_with_retry(fn, policy=RetryPolicy(
+            max_attempts=3, backoff_base=0.0, jitter=0.0))
+        assert out == "done" and fn.calls() == 3
+
+    def test_exhaustion_reraises_last(self):
+        fn = faults.flaky(lambda: "done", 5)
+        with pytest.raises(OSError, match="injected IO failure"):
+            call_with_retry(fn, policy=RetryPolicy(
+                max_attempts=3, backoff_base=0.0, jitter=0.0))
+        assert fn.calls() == 3  # bounded: 3 attempts, not 5
+
+    def test_fatal_not_retried(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("config bug")
+
+        with pytest.raises(ValueError):
+            call_with_retry(fn, policy=RetryPolicy(
+                max_attempts=5, backoff_base=0.0, jitter=0.0))
+        assert len(calls) == 1
+
+    def test_retry_emits_recovery_records(self):
+        tel = Telemetry()
+        fn = faults.flaky(lambda: 1, 2)
+        call_with_retry(fn, policy=RetryPolicy(
+            max_attempts=3, backoff_base=0.0, jitter=0.0),
+            telemetry=tel, label="unit")
+        recs = [r for r in tel.records if r.get("kind") == "recovery"]
+        assert [r["action"] for r in recs] == ["retry", "retry"]
+        assert all(r["source"] == "unit" for r in recs)
+        assert all(validate_record(json.loads(json.dumps(r))) == []
+                   for r in recs)
+
+    def test_retrying_decorator(self):
+        fn = faults.flaky(lambda x: x * 2, 1)
+        wrapped = retrying(max_attempts=2, backoff_base=0.0,
+                           jitter=0.0)(fn)
+        assert wrapped(21) == 42
+
+    def test_watchdog_times_out(self):
+        import time
+
+        def hang():
+            time.sleep(5.0)
+
+        with pytest.raises(SupervisorGivingUp):
+            supervised_call(hang, policy=ResiliencePolicy(
+                max_attempts=2, backoff_base=0.0, jitter=0.0,
+                attempt_timeout=0.05))
+
+
+class TestFaultScript:
+    def test_one_shot_firing(self):
+        fs = FaultScript(device_loss_at_iter=10)
+        fs.before_segment(5)  # not yet
+        with pytest.raises(SimulatedDeviceLoss):
+            fs.before_segment(10)
+        fs.before_segment(10)  # disarmed: no second raise
+        assert fs.fired == [("device_loss", 10)] and fs.exhausted
+
+    def test_poison_one_shot(self):
+        fs = FaultScript(nan_at_iter=3)
+        assert not fs.take_poison(0)
+        assert fs.take_poison(4)
+        assert not fs.take_poison(4)
+
+    def test_poison_smooth_goes_nonfinite(self):
+        sm = faults.poison_smooth(lambda w: (jnp.sum(w ** 2), 2.0 * w))
+        loss, grad = sm(jnp.ones(3))
+        assert not np.isfinite(float(loss))
+        assert not np.isfinite(np.asarray(grad)).any()
+
+    def test_truncate_file(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"x" * 1000)
+        n = faults.truncate_file(str(p), keep_fraction=0.5)
+        assert n == 500 and p.stat().st_size == 500
+
+
+class TestAutoCheckpointer:
+    def _warm(self, problem, iters):
+        build, dargs, px, rv, w0, _ = problem
+        cfg = agd.AGDConfig(num_iterations=iters)
+        import jax
+
+        res = jax.jit(lambda ws, da: agd.run_agd(
+            build(*da)[0], px, rv, ws.x, cfg,
+            smooth_loss=build(*da)[1], warm=ws))(
+                agd.AGDWarmState.initial(w0, cfg), dargs)
+        return ckpt.warm_from_result(res, int(res.num_iters))
+
+    def test_cadence_every_iters(self, problem, tmp_path):
+        path = str(tmp_path / "c.npz")
+        ck = AutoCheckpointer(path, every_iters=4, keep=2)
+        w3 = self._warm(problem, 3)
+        w5 = self._warm(problem, 5)
+        w8 = self._warm(problem, 8)
+        assert ck.update(w3, [1.0])       # first state always saves
+        assert not ck.update(w5, [1.0])   # only 2 iters since
+        assert ck.update(w8, [1.0])       # 5 iters since -> due
+        assert ck.saves == 2
+
+    def test_retention_chain_rotates(self, problem, tmp_path):
+        path = str(tmp_path / "c.npz")
+        ck = AutoCheckpointer(path, keep=3)
+        for it in (2, 4, 6, 8):
+            ck.update(self._warm(problem, it), [0.0], force=True)
+        gens = generation_paths(path, 3)
+        assert [os.path.exists(g) for g in gens] == [True, True, True]
+        w0 = problem[4]
+        iters = [int(ckpt.load_checkpoint(g, w0).warm.prior_iters)
+                 for g in gens]
+        assert iters == [8, 6, 4]  # newest first, oldest dropped
+
+    def test_load_skips_corrupt_generation(self, problem, tmp_path):
+        tel = Telemetry()
+        path = str(tmp_path / "c.npz")
+        ck = AutoCheckpointer(path, keep=3, telemetry=tel)
+        ck.update(self._warm(problem, 4), [0.5], force=True)
+        ck.update(self._warm(problem, 8), [0.5, 0.4], force=True)
+        faults.truncate_file(path, keep_fraction=0.3)
+        loaded = AutoCheckpointer(path, keep=3,
+                                  telemetry=tel).load(problem[4])
+        assert int(loaded.warm.prior_iters) == 4  # the .bak generation
+        actions = [r["action"] for r in tel.records
+                   if r.get("kind") == "recovery"]
+        assert "checkpoint_fallback" in actions and "resume" in actions
+
+    def test_all_generations_corrupt_resumes_fresh(self, problem,
+                                                   tmp_path):
+        path = str(tmp_path / "c.npz")
+        ck = AutoCheckpointer(path, keep=2)
+        ck.update(self._warm(problem, 4), [0.5], force=True)
+        faults.scramble_file(path, seed=0)
+        assert AutoCheckpointer(path, keep=2).load(problem[4]) is None
+
+    def test_sigterm_flushes_and_raises_preempted(self, problem,
+                                                  tmp_path):
+        tel = Telemetry()
+        path = str(tmp_path / "c.npz")
+        warm = self._warm(problem, 4)
+        with AutoCheckpointer(path, telemetry=tel) as ck:
+            ck._latest = (warm, [0.5], False, False)
+            with pytest.raises(Preempted):
+                signal.raise_signal(signal.SIGTERM)
+        assert os.path.exists(path) and ck.preempted
+        assert int(ckpt.load_checkpoint(path,
+                                        problem[4]).warm.prior_iters) == 4
+        assert any(r.get("action") == "preemption_flush"
+                   for r in tel.records if r.get("kind") == "recovery")
+        # handlers restored: SIGTERM is back to default disposition
+        assert signal.getsignal(signal.SIGTERM) is not ck._on_signal
+
+
+class TestSupervisor:
+    def test_clean_run_matches_unsegmented(self, problem):
+        build, dargs, px, rv, w0, _ = problem
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=30)
+        import jax
+
+        plain = jax.jit(lambda ws, da: agd.run_agd(
+            build(*da)[0], px, rv, ws.x, cfg,
+            smooth_loss=build(*da)[1], warm=ws))(
+                agd.AGDWarmState.initial(w0, cfg), dargs)
+        sup = _supervise(problem, cfg, policy=_policy())
+        n = int(plain.num_iters)
+        assert sup.num_iters == n
+        np.testing.assert_array_equal(
+            np.asarray(sup.weights), np.asarray(plain.weights))
+        np.testing.assert_allclose(
+            sup.loss_history, np.asarray(plain.loss_history)[:n],
+            rtol=0, atol=0)
+        assert all(a["outcome"] == "ok" for a in sup.attempts)
+
+    def test_rollback_on_nan_resumes_and_converges(self, problem):
+        """Satellite: force a NaN at a chosen iteration; the supervisor
+        must resume from the last-good warm state with a REDUCED step
+        (raised L) and still converge to the reference objective."""
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=30)
+        ref = _supervise(problem, cfg, policy=_policy())
+        tel = Telemetry()
+        fs = FaultScript(nan_at_iter=10)
+        res = _supervise(problem, cfg, policy=_policy(),
+                         telemetry=tel, faults=fs)
+        assert fs.fired == [("nan", 10)]
+        assert res.rollbacks == 1
+        rb = [r for r in tel.records if r.get("kind") == "recovery"
+              and r["action"] == "rollback"]
+        assert len(rb) == 1
+        # rolled back TO the last-good iteration, with the step cut
+        # (L multiplied by the policy factor => step = 1/L reduced)
+        assert rb[0]["to_iter"] == 10
+        assert rb[0]["big_l"] > 1.0
+        # discarded poisoned work: history stays NaN-free, and the run
+        # still reaches the reference objective
+        assert np.isfinite(res.loss_history).all()
+        assert abs(float(res.loss_history[-1])
+                   - float(ref.loss_history[-1])) < 1e-6
+
+    def test_device_loss_retried_to_identical_result(self, problem):
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=20)
+        ref = _supervise(problem, cfg, policy=_policy())
+        fs = FaultScript(device_loss_at_iter=10)
+        res = _supervise(problem, cfg, policy=_policy(), faults=fs)
+        assert res.retries == 1
+        np.testing.assert_array_equal(np.asarray(res.weights),
+                                      np.asarray(ref.weights))
+
+    def test_transient_exhaustion_gives_up_with_ledger(self, problem):
+        cfg = agd.AGDConfig(num_iterations=10)
+        fs = FaultScript(device_loss_at_iter=0)
+        fs._take = lambda attr, it: attr == "_device_loss_at"  # never disarm
+        with pytest.raises(SupervisorGivingUp) as ei:
+            _supervise(problem, cfg, policy=_policy(max_attempts=3),
+                       faults=fs)
+        ledger = ei.value.ledger
+        assert len(ledger) == 3
+        assert all(e["failure_kind"] == errors.TRANSIENT for e in ledger)
+
+    def test_rollback_exhaustion_gives_up(self, problem):
+        build, dargs, px, rv, w0, _ = problem
+        cfg = agd.AGDConfig(num_iterations=10)
+        # a permanently-poisoned smooth: every segment aborts non-finite
+        poisoned = {"build": lambda *da: (
+            faults.poison_smooth(build(*da)[0]), build(*da)[1])}
+        with pytest.raises(SupervisorGivingUp, match="rollback"):
+            run_agd_supervised(
+                prox=px, reg_value=rv, w0=w0, config=cfg,
+                policy=_policy(max_rollbacks=2),
+                staged=(poisoned["build"], dargs))
+
+    def test_fatal_raises_immediately(self, problem):
+        build, dargs, px, rv, w0, _ = problem
+        cfg = agd.AGDConfig(num_iterations=10)
+
+        def bad_build(*da):
+            raise ValueError("config bug")
+
+        with pytest.raises(SupervisorGivingUp, match="fatal"):
+            run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                               policy=_policy(),
+                               staged=(bad_build, dargs))
+
+    def test_records_schema_valid(self, problem):
+        tel = Telemetry()
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=20)
+        fs = FaultScript(nan_at_iter=5, device_loss_at_iter=10)
+        _supervise(problem, cfg, policy=_policy(), telemetry=tel,
+                   faults=fs)
+        recs = [r for r in tel.records
+                if r.get("kind") in ("attempt", "recovery")]
+        assert recs
+        for r in recs:
+            assert validate_record(json.loads(json.dumps(r))) == [], r
+        snap = tel.registry.snapshot()
+        assert snap["resilience.attempts"] >= 3
+        assert snap["resilience.rollback"] == 1
+        assert snap["resilience.retry"] == 1
+
+    def test_kill_and_resume_via_checkpointer(self, problem, tmp_path):
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=20)
+        ref = _supervise(problem, cfg, policy=_policy())
+        path = str(tmp_path / "c.npz")
+        fs = FaultScript(sigterm_at_iter=10)
+        ck = AutoCheckpointer(path, every_iters=5, keep=2)
+        with pytest.raises(Preempted):
+            _supervise(problem, cfg, policy=_policy(),
+                       checkpointer=ck, faults=fs)
+        ck2 = AutoCheckpointer(path, every_iters=5, keep=2)
+        res = _supervise(problem, cfg, policy=_policy(),
+                         checkpointer=ck2)
+        assert res.resumed_from == 10
+        assert res.num_iters == ref.num_iters
+        np.testing.assert_allclose(np.asarray(res.weights),
+                                   np.asarray(ref.weights),
+                                   rtol=0, atol=0)
+
+    def test_terminal_checkpoint_resume_is_noop(self, problem,
+                                                tmp_path):
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=20)
+        path = str(tmp_path / "c.npz")
+        first = _supervise(problem, cfg, policy=_policy(),
+                           checkpointer=AutoCheckpointer(path))
+        again = _supervise(problem, cfg, policy=_policy(),
+                           checkpointer=AutoCheckpointer(path))
+        assert again.resumed_from == first.num_iters
+        assert again.attempts == []  # no segment executed
+
+
+class TestSupervisedCall:
+    def test_generic_runner_retry(self):
+        fit = faults.flaky(lambda: {"loss": 0.1}, 1)
+        tel = Telemetry()
+        out = supervised_call(fit, policy=ResiliencePolicy(
+            max_attempts=3, backoff_base=0.0, jitter=0.0),
+            telemetry=tel)
+        assert out == {"loss": 0.1}
+        outcomes = [r["outcome"] for r in tel.records
+                    if r.get("kind") == "attempt"]
+        assert outcomes == ["failed", "ok"]
+
+    def test_generic_runner_gives_up(self):
+        fit = faults.flaky(lambda: 1, 9)
+        with pytest.raises(SupervisorGivingUp) as ei:
+            supervised_call(fit, policy=ResiliencePolicy(
+                max_attempts=2, backoff_base=0.0, jitter=0.0))
+        assert len(ei.value.ledger) == 2
+
+
+class TestApiResilience:
+    def test_run_resilience_matches_plain(self, problem):
+        _, _, _, _, _, (X, y) = problem
+        w0 = np.zeros(2, np.float32)
+        wp, hp = api.run((X, y), LogisticGradient(), L2Prox(),
+                         reg_param=0.1, initial_weights=w0,
+                         num_iterations=25)
+        ws, hs, sres = api.run(
+            (X, y), LogisticGradient(), L2Prox(), reg_param=0.1,
+            initial_weights=w0, num_iterations=25,
+            resilience=ResiliencePolicy(segment_iters=7, jitter=0.0,
+                                        seed=0),
+            return_result=True)
+        np.testing.assert_array_equal(np.asarray(wp), np.asarray(ws))
+        np.testing.assert_allclose(hp, hs, rtol=0, atol=0)
+        assert sres.rollbacks == 0 and sres.retries == 0
+
+    def test_run_resilience_true_uses_defaults(self, problem):
+        _, _, _, _, _, (X, y) = problem
+        w0 = np.zeros(2, np.float32)
+        ws, hs = api.run((X, y), LogisticGradient(), L2Prox(),
+                         reg_param=0.1, initial_weights=w0,
+                         num_iterations=10, resilience=True)
+        assert len(hs) <= 10 and np.isfinite(hs).all()
+
+    def test_checkpointer_without_resilience_rejected(self, problem,
+                                                      tmp_path):
+        _, _, _, _, _, (X, y) = problem
+        with pytest.raises(ValueError, match="resilience"):
+            api.run((X, y), LogisticGradient(), L2Prox(),
+                    initial_weights=np.zeros(2, np.float32),
+                    checkpointer=AutoCheckpointer(
+                        str(tmp_path / "c.npz")))
+
+    def test_run_summary_emitted_on_supervised_path(self, problem):
+        _, _, _, _, _, (X, y) = problem
+        tel = Telemetry()
+        api.run((X, y), LogisticGradient(), L2Prox(), reg_param=0.1,
+                initial_weights=np.zeros(2, np.float32),
+                num_iterations=10, resilience=True, telemetry=tel)
+        runs = [r for r in tel.records if r.get("kind") == "run"]
+        assert len(runs) == 1 and runs[0]["tool"] == "api.run"
+        assert runs[0]["metrics"]["resilience.attempts"] >= 1
+
+
+class TestDebugClassifierRouting:
+    def test_report_numerics_failure_is_numeric_kind(self):
+        from spark_agd_tpu.utils import debug
+
+        tel = Telemetry()
+        sm = debug.checked_smooth(
+            lambda w: (jnp.sum(w), {"w": w * jnp.nan}), telemetry=tel)
+        with pytest.raises(NumericsFailureError) as ei:
+            sm(jnp.ones(3))
+        assert classify_failure(ei.value) == errors.NUMERIC
+        assert "non-finite" in str(ei.value)
+        # the event still lands (observability unchanged)
+        assert any(r.get("kind") == "numerics_failure"
+                   for r in tel.records)
+
+    def test_checkpointed_resilience_hook(self, problem, tmp_path):
+        build, dargs, px, rv, w0, _ = problem
+        cfg = agd.AGDConfig(num_iterations=12)
+        res = ckpt.run_agd_checkpointed(
+            None, px, rv, w0, cfg, path=str(tmp_path / "c.npz"),
+            segment_iters=4, staged=(build, dargs),
+            resilience=RetryPolicy(max_attempts=2, backoff_base=0.0,
+                                   jitter=0.0))
+        assert res.num_iters == 12
+
+
+class TestSchemaKinds:
+    def test_new_kinds_registered(self):
+        assert "attempt" in schema.KINDS and "recovery" in schema.KINDS
+
+    def test_examples_validate(self):
+        assert validate_record(schema.EXAMPLE_ATTEMPT_RECORD) == []
+        assert validate_record(schema.EXAMPLE_RECOVERY_RECORD) == []
+
+    def test_selfcheck_covers_new_kinds(self):
+        ok, msgs = schema.selfcheck()
+        assert ok
+        joined = "\n".join(msgs)
+        assert "attempt" in joined and "recovery" in joined
+
+    def test_required_fields_enforced(self):
+        bad = dict(schema.EXAMPLE_ATTEMPT_RECORD)
+        del bad["outcome"]
+        assert validate_record(bad)
+        bad = dict(schema.EXAMPLE_RECOVERY_RECORD)
+        bad["action"] = 7
+        assert validate_record(bad)

@@ -64,7 +64,8 @@ def _load(paths: List[str]):
 
 def _kind(rec: dict) -> Optional[str]:
     k = rec.get("kind")
-    if k in ("run", "iteration", "span", "metrics"):
+    if k in ("run", "iteration", "span", "metrics", "attempt",
+             "recovery", "numerics_failure"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -168,6 +169,34 @@ def summarize_spans(spans: List[dict]) -> str:
     return _table(headers, rows)
 
 
+def summarize_resilience(attempts: List[dict], recoveries: List[dict],
+                         numerics: List[dict]) -> str:
+    """The resilience rollup: per-run attempt outcomes and recovery
+    actions (the ``resilience`` layer's ``attempt``/``recovery``
+    records, plus any ``numerics_failure`` hits) — so a run's recovery
+    story reads out of the same JSONL as its convergence."""
+    per_run: Dict[str, dict] = defaultdict(
+        lambda: {"ok": 0, "failed": 0, "actions": defaultdict(int),
+                 "numerics": 0})
+    for a in attempts:
+        e = per_run[a.get("run_id", "-")]
+        e["ok" if a.get("outcome") == "ok" else "failed"] += 1
+    for r in recoveries:
+        per_run[r.get("run_id", "-")]["actions"][
+            r.get("action", "?")] += 1
+    for nrec in numerics:
+        per_run[nrec.get("run_id", "-")]["numerics"] += 1
+    headers = ["run_id", "attempts_ok", "attempts_failed",
+               "numerics_failures", "recovery_actions"]
+    rows = []
+    for run_id, e in sorted(per_run.items()):
+        acts = ", ".join(f"{k}x{v}" for k, v in sorted(
+            e["actions"].items())) or "-"
+        rows.append([_fmt(run_id)[:18], str(e["ok"]), str(e["failed"]),
+                     str(e["numerics"]), acts])
+    return _table(headers, rows)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -266,6 +295,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     runs, spans = [], []
+    attempts, recoveries, numerics = [], [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -276,6 +306,12 @@ def main(argv=None) -> int:
             iters_by_run[rec.get("run_id", "-")].append(rec)
         elif k == "span":
             spans.append(rec)
+        elif k == "attempt":
+            attempts.append(rec)
+        elif k == "recovery":
+            recoveries.append(rec)
+        elif k == "numerics_failure":
+            numerics.append(rec)
         elif k is None:
             unknown += 1
 
@@ -290,6 +326,11 @@ def main(argv=None) -> int:
     if spans:
         print(f"\n== spans ({len(spans)}) ==")
         print(summarize_spans(spans))
+    if attempts or recoveries or numerics:
+        print(f"\n== resilience ({len(attempts)} attempts, "
+              f"{len(recoveries)} recoveries, {len(numerics)} "
+              f"numerics failures) ==")
+        print(summarize_resilience(attempts, recoveries, numerics))
     if unknown:
         print(f"\nnote: {unknown} record(s) of unknown shape ignored")
 

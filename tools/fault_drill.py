@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""The scripted kill-and-resume drill — CI proof the resilience layer
+actually recovers.
+
+One process, four scripted faults against a supervised AGD fit on a
+small synthetic logistic problem:
+
+1. an injected **NaN loss** at iteration ``--nan-at`` → the supervisor
+   must ROLL BACK to the last-good warm state with a step cut;
+2. an injected **device loss** at iteration ``--device-loss-at`` → the
+   supervisor must RETRY the segment after backoff;
+3. a self-delivered **SIGTERM** at iteration ``--sigterm-at`` → the
+   auto-checkpointer must flush a final checkpoint and the run must
+   unwind with ``Preempted`` (the "kill");
+4. the latest checkpoint is then byte-**truncated** → the relaunch
+   (same process, fresh driver state — the "resume") must fall back to
+   the surviving ``.bak`` generation and run to completion.
+
+PASS (exit 0) requires: all scripted faults fired; the resumed run
+continued from a non-zero iteration; the final loss matches an
+uninterrupted baseline within ``--tol`` (default 1e-6); the run JSONL
+contains at least one ``recovery`` record per expected action (retry,
+rollback, preemption_flush, checkpoint_fallback, resume) plus failed
+AND successful ``attempt`` records; and EVERY record in the JSONL
+validates against the canonical ``obs.schema``.  Any miss prints the
+reason and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/fault_drill.py [--out DIR] [-v]
+
+CPU-deterministic; runs in a few seconds.  See ``docs/ROBUSTNESS.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/fault_drill.py",
+        description="scripted kill-and-resume resilience drill")
+    p.add_argument("--iters", type=int, default=40,
+                   help="iteration budget (default 40)")
+    p.add_argument("--segment", type=int, default=4,
+                   help="supervisor segment length = checkpoint cadence "
+                        "(default 4)")
+    p.add_argument("--nan-at", type=int, default=4,
+                   help="inject a NaN loss at this iteration (rollback)")
+    p.add_argument("--device-loss-at", type=int, default=8,
+                   help="inject a device loss at this iteration (retry)")
+    p.add_argument("--sigterm-at", type=int, default=12,
+                   help="deliver SIGTERM at this iteration (preemption)")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="|final loss - baseline| bound (default 1e-6)")
+    p.add_argument("--out", default=None,
+                   help="directory for the checkpoint chain + drill "
+                        "JSONL (default: a fresh temp dir)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.data import synthetic
+    from spark_agd_tpu.obs import JSONLSink, Telemetry, schema
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.resilience import (AutoCheckpointer, FaultScript,
+                                          Preempted, ResiliencePolicy,
+                                          faults as faults_lib,
+                                          run_agd_supervised)
+
+    failures: list = []
+
+    def check(ok: bool, what: str):
+        tag = "ok" if ok else "FAIL"
+        if not ok:
+            failures.append(what)
+        if args.verbose or not ok:
+            print(f"{tag}: {what}")
+
+    # -- the problem (small, CPU-deterministic) ---------------------------
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 300, 42)
+    X = synthetic.with_intercept_column(X).astype(np.float32)
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    w0 = jnp.zeros(2, jnp.float32)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=args.iters)
+    policy = ResiliencePolicy(
+        max_attempts=3, backoff_base=0.01, backoff_max=0.05, jitter=0.0,
+        seed=0, segment_iters=args.segment)
+
+    # -- uninterrupted baseline ------------------------------------------
+    base = run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                              policy=policy, staged=(build, dargs))
+    base_loss = float(base.loss_history[-1])
+    if args.verbose:
+        print(f"baseline: {base.num_iters} iters, final loss "
+              f"{base_loss:.8f}")
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="fault_drill_")
+    os.makedirs(out_dir, exist_ok=True)
+    ckpt_path = os.path.join(out_dir, "drill_ckpt.npz")
+    jsonl_path = os.path.join(out_dir, "drill.jsonl")
+    # a reused --out must rerun the whole drill, not resume last
+    # drill's terminal checkpoint
+    from spark_agd_tpu.resilience import generation_paths
+
+    for stale in generation_paths(ckpt_path, 8) + [jsonl_path]:
+        if os.path.exists(stale):
+            os.unlink(stale)
+
+    tel = Telemetry([JSONLSink(jsonl_path)])
+
+    # -- phase 1: the killed run -----------------------------------------
+    script = FaultScript(nan_at_iter=args.nan_at,
+                         device_loss_at_iter=args.device_loss_at,
+                         sigterm_at_iter=args.sigterm_at)
+    ck = AutoCheckpointer(ckpt_path, every_iters=args.segment, keep=3,
+                          telemetry=tel)
+    preempted = False
+    try:
+        run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                           policy=policy, telemetry=tel,
+                           checkpointer=ck, staged=(build, dargs),
+                           faults=script)
+    except Preempted:
+        preempted = True
+    check(preempted, "SIGTERM unwound the run as Preempted after the "
+                     "preemption flush")
+    fired = dict((name, it) for name, it in script.fired)
+    check("nan" in fired, f"NaN fault fired (at iter {fired.get('nan')})")
+    check("device_loss" in fired,
+          f"device-loss fault fired (at iter {fired.get('device_loss')})")
+    check("sigterm" in fired,
+          f"SIGTERM fault fired (at iter {fired.get('sigterm')})")
+
+    # -- phase 2: corrupt the latest generation, then resume -------------
+    faults_lib.truncate_file(ckpt_path, keep_fraction=0.4)
+    ck2 = AutoCheckpointer(ckpt_path, every_iters=args.segment, keep=3,
+                           telemetry=tel)
+    res = run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                             policy=policy, telemetry=tel,
+                             checkpointer=ck2, staged=(build, dargs))
+    tel.flush()
+    check(res.resumed_from > 0,
+          f"resume continued from iteration {res.resumed_from} (the "
+          "surviving .bak generation), not from scratch")
+    final_loss = float(res.loss_history[-1])
+    diff = abs(final_loss - base_loss)
+    check(diff <= args.tol,
+          f"final loss {final_loss:.8f} matches uninterrupted baseline "
+          f"{base_loss:.8f} (|diff| = {diff:.2e} <= {args.tol:g})")
+
+    # -- the JSONL evidence ----------------------------------------------
+    records = schema.read_jsonl(jsonl_path)
+    invalid = [(i, errs) for i, rec in enumerate(records, 1)
+               if (errs := schema.validate_record(
+                   json.loads(json.dumps(rec, default=str))))]
+    check(not invalid,
+          f"all {len(records)} drill records are schema-valid"
+          + (f" (first bad: {invalid[0]})" if invalid else ""))
+    actions = {}
+    for rec in records:
+        if rec.get("kind") == "recovery":
+            actions[rec["action"]] = actions.get(rec["action"], 0) + 1
+    for action in ("retry", "rollback", "preemption_flush",
+                   "checkpoint_fallback", "resume"):
+        check(actions.get(action, 0) >= 1,
+              f"recovery action {action!r} recorded "
+              f"(x{actions.get(action, 0)})")
+    outcomes = {r.get("outcome") for r in records
+                if r.get("kind") == "attempt"}
+    check("ok" in outcomes and outcomes - {"ok"},
+          f"both failed and successful attempts recorded ({outcomes})")
+
+    print(f"drill artifacts: {jsonl_path} "
+          f"({len(records)} records), checkpoints under {out_dir}")
+    if failures:
+        print(f"FAULT DRILL FAILED ({len(failures)} checks):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("FAULT DRILL PASSED: killed run resumed from the surviving "
+          f"checkpoint generation to the baseline loss (diff {diff:.2e})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
