@@ -336,9 +336,14 @@ def run(
     fused call — segmented execution, bounded retries with backoff on
     transient failures, rollback to the last-good warm state with a
     step cut on non-finite numerics, and ``attempt``/``recovery``
-    records on the telemetry stream.  ``checkpointer`` (a
-    ``resilience.AutoCheckpointer``, supervised path only) adds
-    preemption-safe auto-checkpointing and corruption-tolerant resume.
+    records on the telemetry stream.  ``checkpointer`` (supervised path
+    only) adds preemption-safe auto-checkpointing and
+    corruption-tolerant resume: a ``resilience.AutoCheckpointer``
+    (single process, ``.bak`` retention chain) or a
+    ``resilience.DistributedCheckpointer`` (multi-host SPMD:
+    barrier-committed generations with checksummed manifests, host
+    shards exchanged through one allgather, elastic resume onto a
+    changed process count — see ``docs/ROBUSTNESS.md`` §distributed).
     ``return_result=True`` then returns the ``SupervisedResult`` as the
     third element.  See ``docs/ROBUSTNESS.md``."""
     if initial_weights is None:

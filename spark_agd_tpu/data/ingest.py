@@ -81,6 +81,39 @@ def _retrying_loader(loader: Callable, retries, telemetry) -> Callable:
                               on_retry=on_retry)(loader)
 
 
+def _validated_parts(paths_used, parts, d, validate, telemetry):
+    """Apply the ``validate=`` policy to freshly-read partitions:
+    ``False`` = trust the writer (the historical behavior), ``"raise"``
+    = typed :class:`~spark_agd_tpu.data.libsvm.DataValidationError` on
+    the first bad partition (classified FATAL by the resilience layer —
+    re-reading garbage yields garbage), ``"drop"`` = discard invalid
+    rows, log, and count them on the ``data.invalid_records`` telemetry
+    counter — bounded data loss instead of silently training on NaNs."""
+    if not validate:
+        return parts
+    if validate not in ("raise", "drop"):
+        raise ValueError(
+            f"validate must be False, 'raise', or 'drop'; "
+            f"got {validate!r}")
+    out = []
+    for path, part in zip(paths_used, parts):
+        mask = libsvm.invalid_row_mask(part, d)
+        n_bad = int(mask.sum())
+        if not n_bad:
+            out.append(part)
+            continue
+        if validate == "raise":
+            raise libsvm.DataValidationError(
+                path, libsvm.describe_invalid(part, mask))
+        logger.warning(
+            "%s: dropping %d invalid row(s) (non-finite features/"
+            "labels or out-of-range indices)", path, n_bad)
+        if telemetry is not None:
+            telemetry.registry.counter("data.invalid_records").inc(n_bad)
+        out.append(libsvm.drop_rows(part, mask))
+    return out
+
+
 def _allgather_max(value: int) -> int:
     """Max of a per-host int across the SPMD job (identity when
     single-process)."""
@@ -122,6 +155,7 @@ def from_partitioned_files(
     axis: str = mesh_lib.DATA_AXIS,
     retries: Optional[retry_lib.RetryPolicy] = None,
     telemetry=None,
+    validate=False,
 ) -> mesh_lib.ShardedBatch:
     """Load one LIBSVM partition set into a mesh-sharded batch.
 
@@ -137,6 +171,13 @@ def from_partitioned_files(
     must not abort a whole-pod SPMD ingest.  Retries are logged and,
     when ``telemetry`` (an ``obs.Telemetry``) is given, emitted as
     ``recovery`` records.
+
+    ``validate`` (default off): ``"raise"`` rejects non-finite
+    features/labels and out-of-range indices with a typed
+    :class:`~spark_agd_tpu.data.libsvm.DataValidationError`; ``"drop"``
+    discards the offending ROWS, logging and counting them on the
+    ``data.invalid_records`` telemetry counter — either way the model
+    never silently trains on garbage.
 
     Returns a :class:`~spark_agd_tpu.parallel.mesh.ShardedBatch` whose
     mask excludes inter-host padding rows; feed it straight to
@@ -155,6 +196,8 @@ def from_partitioned_files(
     if d == 0:
         raise ValueError("could not infer n_features (all partitions "
                          "empty on this host and none given)")
+    parts = _validated_parts(local_partitions(paths), parts, d,
+                             validate, telemetry)
 
     ys, Xs = [], []
     for part in parts:
@@ -214,6 +257,7 @@ def from_partitioned_files_csr(
     axis: str = mesh_lib.DATA_AXIS,
     retries: Optional[retry_lib.RetryPolicy] = None,
     telemetry=None,
+    validate=False,
 ) -> mesh_lib.ShardedBatch:
     """Load a LIBSVM partition set into a mesh-sharded SPARSE batch —
     no densification at any point (r2 VERDICT item 3).
@@ -229,9 +273,11 @@ def from_partitioned_files_csr(
     ``with_csc=True`` (default) builds each shard's column-sorted twin
     so the gradient uses sorted segment-sums.  ``n_features`` pins the
     global width (url_combined: 3,231,961); inferred by allgather-max
-    when omitted.  ``retries``/``telemetry``: per-partition reads run
-    under the shared retrying helper, as in
-    :func:`from_partitioned_files`.
+    when omitted.  ``retries``/``telemetry``/``validate``: per-partition
+    reads run under the shared retrying helper and the same validation
+    policy as :func:`from_partitioned_files` (``"drop"`` removes
+    invalid rows BEFORE the nnz-balanced layout, so a poisoned
+    partition costs rows, not the ingest).
     """
     if not paths:
         raise ValueError("no partition files")
@@ -254,6 +300,8 @@ def from_partitioned_files_csr(
     if d == 0:
         raise ValueError("could not infer n_features (all partitions "
                          "empty on this host and none given)")
+    parts = _validated_parts(local_partitions(paths), parts, d,
+                             validate, telemetry)
     for p, part in zip(local_partitions(paths), parts):
         if len(part.indices) and int(part.indices.max()) >= d:
             raise ValueError(

@@ -23,6 +23,24 @@ import numpy as np
 from .. import native
 
 
+class DataValidationError(ValueError):
+    """The dataset itself is bad — a non-finite feature/label or an
+    out-of-range feature index.  ``ValueError`` parent on purpose: the
+    resilience classifier (``resilience.errors.classify_failure``) maps
+    it FATAL — re-reading garbage yields the same garbage, so retry/
+    backoff would only delay the failure.  Raised by ``validate="raise"``
+    ingest; ``validate="drop"`` discards the offending rows instead and
+    counts them (``data.invalid_records``)."""
+
+    def __init__(self, where: str, problems):
+        problems = list(problems)
+        shown = "; ".join(problems[:5])
+        more = f" (+{len(problems) - 5} more)" if len(problems) > 5 else ""
+        super().__init__(f"{where}: invalid data: {shown}{more}")
+        self.where = where
+        self.problems = problems
+
+
 class CSRData(NamedTuple):
     """Labels + CSR features; the LabeledPoint collection analogue."""
 
@@ -52,17 +70,95 @@ class CSRData(NamedTuple):
         return (y > 0).astype(np.float64)
 
 
+def invalid_row_mask(data: CSRData,
+                     n_features: Optional[int] = None) -> np.ndarray:
+    """Boolean (n_rows,) mask of rows that must not reach training: a
+    non-finite label, a non-finite feature value, or a feature index
+    outside ``[0, n_features)``.  Index checks need a width — pass
+    ``n_features`` or rely on ``data.n_features``."""
+    d = int(n_features or data.n_features)
+    bad = ~np.isfinite(np.asarray(data.labels, np.float64))
+    values = np.asarray(data.values)
+    indices = np.asarray(data.indices)
+    bad_nnz = ~np.isfinite(values)
+    if d > 0:
+        bad_nnz |= (indices < 0) | (indices >= d)
+    if bad_nnz.any():
+        counts = np.diff(np.asarray(data.indptr))
+        rows = np.repeat(np.arange(len(counts)), counts)
+        bad |= np.isin(np.arange(len(counts)), rows[bad_nnz])
+    return bad
+
+
+def describe_invalid(data: CSRData, mask: np.ndarray) -> list:
+    """Human-readable problems for the masked rows (first few; the
+    DataValidationError payload)."""
+    problems = []
+    for i in np.nonzero(mask)[0][:8]:
+        s, e = int(data.indptr[i]), int(data.indptr[i + 1])
+        label = data.labels[i]
+        if not np.isfinite(label):
+            problems.append(f"row {i}: non-finite label {label!r}")
+            continue
+        vals = np.asarray(data.values[s:e])
+        idxs = np.asarray(data.indices[s:e])
+        nf = np.nonzero(~np.isfinite(vals))[0]
+        if len(nf):
+            problems.append(
+                f"row {i}: non-finite value at feature "
+                f"{int(idxs[nf[0]])}")
+            continue
+        oob = np.nonzero((idxs < 0) | (idxs >= data.n_features))[0]
+        if len(oob):
+            problems.append(
+                f"row {i}: feature index {int(idxs[oob[0]])} outside "
+                f"[0, {data.n_features})")
+        else:
+            problems.append(f"row {i}: invalid")
+    return problems
+
+
+def drop_rows(data: CSRData, mask: np.ndarray) -> CSRData:
+    """``data`` without the masked rows (CSR re-packed; width kept)."""
+    keep = ~np.asarray(mask, bool)
+    counts = np.diff(np.asarray(data.indptr))
+    nnz_keep = np.repeat(keep, counts)
+    return CSRData(
+        labels=np.asarray(data.labels)[keep],
+        indptr=np.concatenate([[0], np.cumsum(counts[keep])]).astype(
+            np.int64),
+        indices=np.asarray(data.indices)[nnz_keep],
+        values=np.asarray(data.values)[nnz_keep],
+        n_features=data.n_features)
+
+
+def validate_csr(data: CSRData, *, n_features: Optional[int] = None,
+                 where: str = "data") -> None:
+    """Raise :class:`DataValidationError` when any row is invalid."""
+    mask = invalid_row_mask(data, n_features)
+    if mask.any():
+        raise DataValidationError(where, describe_invalid(data, mask))
+
+
 def load_libsvm(path: str, n_features: Optional[int] = None,
-                force_python: bool = False) -> CSRData:
+                force_python: bool = False,
+                validate: bool = False) -> CSRData:
     """Parse a LIBSVM file.  ``n_features`` overrides the inferred feature
     count (pass it when a test split lacks the train split's tail
-    features)."""
+    features).  ``validate=True`` additionally rejects non-finite
+    features/labels and out-of-range indices with a typed
+    :class:`DataValidationError` — LIBSVM text happily encodes ``nan``
+    and the parser happily reads it, so an unvalidated bad file would
+    otherwise train to garbage silently."""
     parsed = None if force_python else native.parse_libsvm_native(path)
     if parsed is None:
         parsed = _parse_python(path)
     labels, indptr, indices, values, inferred = parsed
-    return CSRData(labels, indptr, indices, values,
+    data = CSRData(labels, indptr, indices, values,
                    int(n_features or inferred))
+    if validate:
+        validate_csr(data, where=path)
+    return data
 
 
 def _parse_python(path: str):

@@ -36,13 +36,14 @@ from typing import Dict, Iterable, List, Optional, Tuple
 SCHEMA_VERSION = 1
 
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
-         "numerics_failure", "attempt", "recovery")
+         "numerics_failure", "attempt", "recovery", "heartbeat")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
 # canonical set for consumers
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
-                    "checkpoint", "checkpoint_fallback", "resume")
+                    "checkpoint", "checkpoint_fallback", "resume",
+                    "host_lost", "elastic_resume")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -67,6 +68,10 @@ _REQUIRED: Dict[str, dict] = {
     # one recovery action (resilience layer): action is one of
     # RECOVERY_ACTIONS (open set — consumers ignore unknown actions)
     "recovery": {"run_id": str, "action": str},
+    # one liveness beat of one SPMD process (resilience.distributed.
+    # HeartbeatWriter); ``process`` is the jax process index — the
+    # host-loss monitor reads staleness from these
+    "heartbeat": {"run_id": str, "process": int},
 }
 
 _OPTIONAL: Dict[str, dict] = {
@@ -112,8 +117,13 @@ _OPTIONAL: Dict[str, dict] = {
         "reason": str, "failure_kind": str, "attempt": int,
         "backoff_s": _NUM, "from_iter": int, "to_iter": int,
         "big_l": _NUM, "path": str, "generation": int,
+        "process": int, "process_count": int, "saved_process_count": int,
         "source": str, "algorithm": str, "tool": str,
         "timestamp_unix": _NUM,
+    },
+    "heartbeat": {
+        "process_count": int, "iter": int, "phase": str, "pid": int,
+        "algorithm": str, "tool": str, "timestamp_unix": _NUM,
     },
 }
 
@@ -248,9 +258,18 @@ def attempt_record(run_id: str, attempt: int, outcome: str,
 def recovery_record(run_id: str, action: str, **fields) -> dict:
     """One recovery action of the resilience layer — ``action`` is one
     of :data:`RECOVERY_ACTIONS` (retry, rollback, preemption_flush,
-    checkpoint, checkpoint_fallback, resume)."""
+    checkpoint, checkpoint_fallback, resume, host_lost,
+    elastic_resume)."""
     return {"schema_version": SCHEMA_VERSION, "kind": "recovery",
             "run_id": run_id, "action": str(action), **fields}
+
+
+def heartbeat_record(run_id: str, process: int, **fields) -> dict:
+    """One liveness beat of one SPMD process — ``process`` is the jax
+    process index; ``iter``/``phase`` locate the beat in the run, and
+    the host-loss monitor derives staleness from ``timestamp_unix``."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "heartbeat",
+            "run_id": run_id, "process": int(process), **fields}
 
 
 def read_jsonl(path: str) -> List[dict]:
@@ -325,6 +344,13 @@ EXAMPLE_RECOVERY_RECORD = {
     "source": "supervisor",
 }
 
+EXAMPLE_HEARTBEAT_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "heartbeat",
+    "run_id": "r18c2d3e4-1a2b-0", "process": 1, "process_count": 2,
+    "iter": 12, "phase": "segment", "pid": 4242,
+    "timestamp_unix": 1754000000.0,
+}
+
 
 def selfcheck() -> Tuple[bool, List[str]]:
     """Validate the example records, a JSON round-trip, and a negative
@@ -339,7 +365,8 @@ def selfcheck() -> Tuple[bool, List[str]]:
                       ("numerics_failure",
                        EXAMPLE_NUMERICS_FAILURE_RECORD),
                       ("attempt", EXAMPLE_ATTEMPT_RECORD),
-                      ("recovery", EXAMPLE_RECOVERY_RECORD)):
+                      ("recovery", EXAMPLE_RECOVERY_RECORD),
+                      ("heartbeat", EXAMPLE_HEARTBEAT_RECORD)):
         errs = validate_record(json.loads(json.dumps(rec)))
         if errs:
             ok = False
@@ -371,6 +398,15 @@ def selfcheck() -> Tuple[bool, List[str]]:
     else:
         ok = False
         msgs.append("FAIL: recovery record missing action passed "
+                    "validation")
+    bad_hb = dict(EXAMPLE_HEARTBEAT_RECORD)
+    del bad_hb["process"]
+    if validate_record(bad_hb):
+        msgs.append("ok: negative control (heartbeat missing process) "
+                    "rejected")
+    else:
+        ok = False
+        msgs.append("FAIL: heartbeat record missing process passed "
                     "validation")
     stamped = stamp({"value": 1.0}, tool="selfcheck")
     errs = validate_record(stamped)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import math
 import re
+import time as _time
 from typing import Iterable, List, Optional
 
 from . import schema
@@ -191,6 +192,25 @@ class Telemetry:
             self.registry.counter("resilience.failed_attempts").inc()
         rec = schema.attempt_record(self.run_id, attempt, outcome,
                                     **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def heartbeat(self, *, process: Optional[int] = None,
+                  **fields) -> dict:
+        """Emit (and return) a ``heartbeat`` record — one liveness beat
+        of this SPMD process (``resilience.distributed``) — and count it
+        (``resilience.heartbeats``).  ``process`` defaults to this
+        process's jax index (0 when no backend is up)."""
+        if process is None:
+            try:
+                import jax
+
+                process = jax.process_index()
+            except Exception:  # noqa: BLE001 — no backend: single host
+                process = 0
+        self.registry.counter("resilience.heartbeats").inc()
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
+        rec = schema.heartbeat_record(self.run_id, int(process), **fields)
         self.bus.emit(rec)
         return rec
 

@@ -190,10 +190,52 @@ def host_suffixed(path: str) -> str:
     return f"{root}.{tag}{ext}"
 
 
+def local_rows_slice(n_rows: int, process_index: int,
+                     process_count: int) -> slice:
+    """The row range process ``process_index`` of ``process_count`` owns
+    (ceil-divided blocks; the last block may be short).  Pure function of
+    its arguments — the elastic-resume re-split
+    (``resilience.distributed.load_for_topology``) computes assignments
+    for a topology that is NOT this process's, so it cannot go through
+    :func:`process_local_rows`."""
+    per = -(-n_rows // process_count)
+    return slice(process_index * per,
+                 min((process_index + 1) * per, n_rows))
+
+
 def process_local_rows(n_rows: int) -> slice:
     """The row range this host should load — the data-loading side of
     multi-host DP (each host feeds only its local shard; ``jax.make_array_
     from_process_local_data`` assembles the global array)."""
-    p, n = jax.process_index(), jax.process_count()
-    per = -(-n_rows // n)
-    return slice(p * per, min((p + 1) * per, n_rows))
+    return local_rows_slice(n_rows, jax.process_index(),
+                            jax.process_count())
+
+
+def process_allgather_int64(values) -> np.ndarray:
+    """Allgather one small row of NON-NEGATIVE int64s per process → a
+    ``(process_count, k)`` array, row ``p`` from process ``p``.  Doubles
+    as a BARRIER: the call returns only after every process has
+    contributed, which is how the distributed checkpoint's commit waits
+    for all shard writes.  Single-process: returns ``values[None, :]``
+    without touching any collective machinery.
+
+    Transport rides as 16-bit limbs in int32: with ``jax_enable_x64``
+    off (the default) jax silently downcasts int64 to int32, which
+    corrupted CRC32 values above 2**31 until the limb encoding."""
+    row = np.atleast_1d(np.asarray(values, np.int64))
+    if (row < 0).any():
+        raise ValueError("process_allgather_int64 carries non-negative "
+                         f"values only, got {row}")
+    if jax.process_count() <= 1:
+        return row[None, :]
+    from jax.experimental import multihost_utils
+
+    limbs = np.stack([(row >> s) & 0xFFFF for s in (0, 16, 32, 48)],
+                     axis=-1).astype(np.int32)  # (k, 4)
+    gathered = np.asarray(multihost_utils.process_allgather(
+        limbs.reshape(-1)), np.int64)
+    gathered = gathered.reshape(jax.process_count(), row.size, 4)
+    out = np.zeros((jax.process_count(), row.size), np.int64)
+    for i in range(4):
+        out |= (gathered[:, :, i] & 0xFFFF) << (16 * i)
+    return out

@@ -22,7 +22,14 @@ pytrees plus three scalars.  Four modules (guide:
   ledger), plus the generic ``supervised_call`` for any other runner;
 - ``faults`` — the deterministic fault-injection harness that proves
   all of the above (``tools/fault_drill.py`` runs the scripted
-  kill-and-resume drill).
+  kill-and-resume drill);
+- ``manifest`` + ``distributed`` — the MULTI-HOST half (PR 4):
+  barrier-committed generation checkpoints with checksummed manifests
+  (``DistributedCheckpointer``), heartbeat files + ``HostLost``
+  detection (``HeartbeatWriter``/``HostMonitor``), and elastic resume
+  onto a changed topology (``load_for_topology``); drilled by
+  ``tools/dist_fault_drill.py`` (SIGKILL one of two real processes,
+  resume on one).
 
 Every retry, rollback, preemption flush, and checkpoint fallback lands
 as an ``attempt`` / ``recovery`` record in the canonical ``obs.schema``
@@ -38,6 +45,7 @@ from .errors import (  # noqa: F401
     PREEMPTED,
     TRANSIENT,
     AttemptTimeout,
+    HostLost,
     NumericsFailureError,
     Preempted,
     SimulatedDeviceLoss,
@@ -59,3 +67,11 @@ from .supervisor import (  # noqa: F401
 )
 from . import faults  # noqa: F401
 from .faults import FaultScript  # noqa: F401
+from . import manifest  # noqa: F401
+from .distributed import (  # noqa: F401
+    DistributedCheckpointer,
+    HeartbeatWriter,
+    HostMonitor,
+    LoadedDistCheckpoint,
+    load_for_topology,
+)

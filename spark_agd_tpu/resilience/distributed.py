@@ -1,0 +1,552 @@
+"""Multi-host resilience: barrier-committed checkpoints, heartbeats,
+host-loss detection, and elastic resume onto a changed topology.
+
+The reference's distributed fault tolerance is Spark's: a dead executor
+costs its partitions (recomputed from lineage) and ``treeAggregate``
+survives because the driver reschedules.  The SPMD port has no driver —
+every host runs the same program, and PR 3's resilience layer (retry /
+rollback / ``AutoCheckpointer``) is single-process.  This module is the
+multi-host completion, in three pieces:
+
+**Commit-barrier checkpointing** (:class:`DistributedCheckpointer`).
+Each host atomically writes its own generation-stamped shard file
+(``utils.checkpoint.atomic_savez`` — tempfile+rename, per-entry CRC32);
+then all hosts exchange ``(generation, file CRC32, size, warm-state
+CRC32)`` through one small allgather — which is also the BARRIER: the
+exchange returns only once every shard is on disk — and the primary
+host alone writes the ``manifest.json`` commit record
+(``resilience.manifest``).  A generation without its manifest does not
+exist; a manifest whose shards are missing/torn/mixed-generation is
+refused and the loader falls back one generation — the multi-host
+extension of the single-host ``.bak`` chain.  The exchange additionally
+refuses a MIXED-GENERATION commit (two hosts trying to commit different
+generations = a partitioned job) and a replica-divergence commit (hosts
+disagreeing on the supposedly-replicated warm state).
+
+**Host health** (:class:`HeartbeatWriter` / :class:`HostMonitor`).
+Every host atomically rewrites a small ``heartbeat.hNNN.json`` at each
+segment boundary and emits a ``heartbeat`` record through the obs event
+bus.  A monitor (any process with filesystem access — the surviving
+hosts, or an external supervisor) reads staleness from the files and
+raises :class:`~spark_agd_tpu.resilience.errors.HostLost` — classified
+TRANSIENT by ``errors.classify_failure``: the work is retryable, just
+possibly on a smaller topology.
+
+**Elastic resume** (:func:`load_for_topology`).  Resuming on the SAME
+process count reads back exactly this host's own shard bytes —
+bit-identical by construction.  Resuming on a DIFFERENT count (a host
+died; capacity grew back) gathers what was sharded to the host level —
+the data-partition assignment and any row-sharded extras — re-splits
+them for the new topology (partitions round-robin like
+``data.ingest.local_partitions``; rows by ``parallel.multihost.
+local_rows_slice``), and takes the replicated ``AGDWarmState`` from the
+primary shard (the commit barrier proved all replicas byte-equal).  The
+math is unaffected: AGD's carry is replicated, so a 2→1 resume
+continues the SAME trajectory on re-assembled data.
+
+Proof harness: ``tools/dist_fault_drill.py`` (SIGKILL one of two real
+processes mid-run, elastic resume on one) and
+``tests/test_dist_resilience.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import checkpoint as ckpt
+from . import manifest as manifest_lib
+from .autockpt import AutoCheckpointer
+from .errors import HostLost
+
+logger = logging.getLogger("spark_agd_tpu")
+
+# npz entry prefix for row-sharded extras in a shard payload
+ROWSTATE_PREFIX = "rowstate::"
+
+_HEARTBEAT_RE = re.compile(r"^heartbeat\.h(\d{3})\.json$")
+
+
+def _process_defaults(process_index, process_count) -> Tuple[int, int]:
+    if process_index is None or process_count is None:
+        import jax
+
+        if process_index is None:
+            process_index = jax.process_index()
+        if process_count is None:
+            process_count = jax.process_count()
+    if not 0 <= int(process_index) < int(process_count):
+        raise ValueError(
+            f"process_index {process_index} out of range for "
+            f"process_count {process_count}")
+    return int(process_index), int(process_count)
+
+
+def _default_exchange(row: np.ndarray) -> np.ndarray:
+    from ..parallel import multihost
+
+    return multihost.process_allgather_int64(row)
+
+
+def _warm_crc(warm) -> int:
+    """CRC32 over the warm state's leaf bytes + scalars — the replica-
+    divergence check exchanged at commit (every host's supposedly-
+    replicated carry must be byte-equal)."""
+    import zlib
+
+    crc = 0
+    payload = ckpt.warm_payload(warm)
+    for name in sorted(payload):
+        if name == "loss_history":
+            continue  # histories may legitimately be rank-0-only
+        crc = zlib.crc32(np.ascontiguousarray(payload[name]).tobytes(),
+                         crc)
+    return crc
+
+
+class LoadedDistCheckpoint(NamedTuple):
+    """What :func:`load_for_topology` returns — a superset of
+    ``utils.checkpoint.LoadedCheckpoint`` (the supervisor reads the
+    first five fields), plus the distributed bookkeeping."""
+
+    warm: Any
+    loss_history: np.ndarray
+    converged: bool
+    aborted: bool
+    fingerprint: Optional[str]
+    generation: int
+    saved_process_count: int
+    elastic: bool                       # topology changed on resume
+    partitions: Optional[Tuple[str, ...]]  # THIS host's re-split files
+    row_state: Dict[str, np.ndarray]    # THIS host's re-split rows
+
+
+def _check_embedded_generation(path: str, entries: Dict[str, np.ndarray],
+                               expect: int) -> None:
+    if "generation" not in entries:
+        raise ckpt.CheckpointCorruptError(
+            path, KeyError("shard carries no generation id"))
+    got = int(entries["generation"])
+    if got != expect:
+        raise ckpt.CheckpointCorruptError(
+            path, ValueError(
+                f"shard embeds generation {got}, manifest says "
+                f"{expect} (mixed-generation set refused)"))
+
+
+def _shard_partitions(entries: Dict[str, np.ndarray]) -> Optional[List[str]]:
+    if "partitions" not in entries:
+        return None
+    return [str(x) for x in np.atleast_1d(entries["partitions"])]
+
+
+def _shard_row_state(entries: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    return {k[len(ROWSTATE_PREFIX):]: entries[k]
+            for k in entries if k.startswith(ROWSTATE_PREFIX)}
+
+
+def reshard_partitions(saved: Sequence[Sequence[str]],
+                       process_index: int,
+                       process_count: int) -> Tuple[str, ...]:
+    """Re-split saved per-host partition assignments for a new topology
+    — union, then the SAME sorted round-robin rule as
+    ``data.ingest.local_partitions``, so an unchanged topology gets its
+    original assignment back and a changed one gets the assignment a
+    fresh ingest would compute."""
+    union = sorted({p for host in saved for p in host})
+    return tuple(union[process_index::process_count])
+
+
+def load_for_topology(
+    directory: str,
+    template: Any,
+    *,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    fingerprint: Optional[str] = None,
+    telemetry=None,
+) -> Optional[LoadedDistCheckpoint]:
+    """Load the newest VERIFIABLE generation for the given topology —
+    see the module docstring.  Walks committed generations newest →
+    oldest, refusing (with one ``checkpoint_fallback`` recovery record
+    each) any whose manifest is unreadable, whose shard files fail the
+    manifest's size/CRC32, whose npz entries fail their per-entry CRCs,
+    or whose shards embed a different generation than the manifest.
+    Returns None when nothing survives (every refusal was recorded).
+    A fingerprint mismatch RAISES ``ValueError`` — that is the wrong
+    problem at a reused path, not corruption to fall back from."""
+    process_index, process_count = _process_defaults(process_index,
+                                                     process_count)
+    gens = manifest_lib.committed_generations(directory)
+    for gen in gens:
+        try:
+            m = manifest_lib.load_manifest(directory, gen)
+        except (ValueError, OSError) as e:
+            _fallback(telemetry, directory, gen, f"manifest unreadable: {e}")
+            continue
+        problems = manifest_lib.verify_manifest(m, directory)
+        if problems:
+            _fallback(telemetry, directory, gen, "; ".join(problems))
+            continue
+        try:
+            return _load_generation(directory, m, template,
+                                    process_index, process_count,
+                                    fingerprint, telemetry)
+        except ckpt.CheckpointCorruptError as e:
+            _fallback(telemetry, directory, gen, str(e))
+            continue
+    if gens:
+        logger.warning(
+            "every committed generation under %r failed verification; "
+            "resuming from scratch", directory)
+    return None
+
+
+def _fallback(telemetry, directory: str, generation: int,
+              reason: str) -> None:
+    logger.warning("refusing checkpoint generation %d under %r: %s",
+                   generation, directory, reason)
+    if telemetry is not None:
+        telemetry.recovery(action="checkpoint_fallback", path=directory,
+                           generation=generation, reason=reason,
+                           source="dist_ckpt")
+
+
+def _load_generation(directory, m, template, process_index,
+                     process_count, fingerprint, telemetry):
+    elastic = (m.process_count != process_count)
+    if not elastic:
+        # unchanged topology: this host reads back exactly its own
+        # shard's bytes — bit-identical resume by construction
+        path = m.shard_path(directory, process_index)
+        entries = ckpt.read_npz_entries(path)
+        _check_embedded_generation(path, entries, m.generation)
+        lc = ckpt.checkpoint_from_entries(
+            path, ckpt._Entries(path, entries), template, fingerprint)
+        return LoadedDistCheckpoint(
+            *lc, generation=m.generation,
+            saved_process_count=m.process_count, elastic=False,
+            partitions=(tuple(p) if (p := _shard_partitions(entries))
+                        is not None else None),
+            row_state=_shard_row_state(entries))
+
+    # changed topology: gather every host's shard, re-split
+    per_host = []
+    for s in sorted(m.shards, key=lambda s: s.process):
+        path = os.path.join(directory, s.path)
+        entries = ckpt.read_npz_entries(path)
+        _check_embedded_generation(path, entries, m.generation)
+        per_host.append((path, entries))
+    path0, e0 = per_host[0]
+    # the warm carry is replicated (byte-equality across hosts was
+    # verified by the commit exchange): the primary's copy is canonical
+    lc = ckpt.checkpoint_from_entries(
+        path0, ckpt._Entries(path0, e0), template, fingerprint)
+
+    saved_parts = [p for _, e in per_host
+                   if (p := _shard_partitions(e)) is not None]
+    partitions = (reshard_partitions(saved_parts, process_index,
+                                     process_count)
+                  if saved_parts else None)
+
+    from ..parallel import multihost as mh
+
+    names = sorted({k for _, e in per_host
+                    for k in e if k.startswith(ROWSTATE_PREFIX)})
+    row_state = {}
+    for k in names:
+        whole = np.concatenate(
+            [e[k] for _, e in per_host if k in e], axis=0)
+        row_state[k[len(ROWSTATE_PREFIX):]] = whole[
+            mh.local_rows_slice(whole.shape[0], process_index,
+                                process_count)]
+
+    if telemetry is not None:
+        telemetry.recovery(
+            action="elastic_resume", path=directory,
+            generation=m.generation,
+            saved_process_count=m.process_count,
+            process_count=process_count, process=process_index,
+            to_iter=int(lc.warm.prior_iters), source="dist_ckpt")
+    logger.warning(
+        "elastic resume: generation %d was saved by %d processes, "
+        "resuming as process %d/%d from iteration %d",
+        m.generation, m.process_count, process_index, process_count,
+        int(lc.warm.prior_iters))
+    return LoadedDistCheckpoint(
+        *lc, generation=m.generation,
+        saved_process_count=m.process_count, elastic=True,
+        partitions=partitions, row_state=row_state)
+
+
+class DistributedCheckpointer(AutoCheckpointer):
+    """The multi-host :class:`~spark_agd_tpu.resilience.autockpt.
+    AutoCheckpointer`: same cadence knobs (``every_iters`` /
+    ``every_seconds``), same supervisor interface (``load`` / ``update``
+    / signal handlers), but each save is a barrier-committed GENERATION
+    (see module docstring) in ``directory`` instead of a ``.bak`` chain
+    at one path, and ``load`` is topology-elastic.
+
+    ``partitions`` (this host's data-partition file list, from
+    ``data.ingest.local_partitions``) and ``row_state`` (row-sharded
+    per-host arrays) ride in every shard so a resume on a different
+    process count can re-assign them.  ``mesh_shape`` is stamped into
+    the manifest for post-mortems.
+
+    ``exchange`` (tests/drills) replaces the allgather barrier — it
+    receives this host's int64 ``(generation, crc32, size, warm_crc)``
+    row and must return the ``(process_count, 4)`` all-host stack only
+    after every host has contributed.  The default uses
+    ``parallel.multihost.process_allgather_int64`` (gloo on CPU, ICI/DCN
+    on pods) and degrades to identity on a single process.
+
+    Caveat shared with every collective checkpoint (orbax included):
+    saves are COLLECTIVE.  All hosts must call ``update``/``flush`` the
+    same number of times with the same cadence state, or the exchange
+    deadlocks — which is why the supervisor only checkpoints at segment
+    boundaries, where SPMD hosts are in lockstep, and why the
+    preemption flush assumes the signal hit every host (the norm for
+    maintenance events)."""
+
+    def __init__(self, directory: str, *,
+                 every_iters: Optional[int] = None,
+                 every_seconds: Optional[float] = None,
+                 keep: int = 2,
+                 fingerprint: Optional[str] = None,
+                 telemetry=None,
+                 mesh_shape: Optional[Dict[str, int]] = None,
+                 partitions: Optional[Sequence[str]] = None,
+                 row_state: Optional[Dict[str, np.ndarray]] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 exchange: Optional[Callable] = None,
+                 clock=time.monotonic):
+        super().__init__(directory, every_iters=every_iters,
+                         every_seconds=every_seconds, keep=keep,
+                         fingerprint=fingerprint, telemetry=telemetry,
+                         clock=clock)
+        self.directory = directory
+        self.mesh_shape = dict(mesh_shape) if mesh_shape else None
+        self.partitions = (None if partitions is None
+                           else [str(p) for p in partitions])
+        self.row_state = dict(row_state or {})
+        self.process_index, self.process_count = _process_defaults(
+            process_index, process_count)
+        self._exchange = exchange or _default_exchange
+        latest = manifest_lib.committed_generations(directory)
+        self._next_generation = (latest[0] + 1) if latest else 0
+
+    # -- save: shard write → barrier → primary commit ---------------------
+    def _save(self, warm, hist, converged, aborted, *,
+              action: str = "checkpoint") -> None:
+        gen = self._next_generation
+        payload = ckpt.warm_payload(
+            warm, None if hist is None else np.asarray(hist),
+            converged=converged, aborted=aborted,
+            fingerprint=self.fingerprint)
+        payload["generation"] = np.asarray(gen)
+        payload["process_index"] = np.asarray(self.process_index)
+        payload["process_count"] = np.asarray(self.process_count)
+        if self.partitions is not None:
+            payload["partitions"] = np.asarray(self.partitions)
+        for name, arr in self.row_state.items():
+            payload[ROWSTATE_PREFIX + name] = np.asarray(arr)
+        shard = manifest_lib.shard_name(gen, self.process_index)
+        shard_path = os.path.join(self.directory, shard)
+        ckpt.atomic_savez(shard_path, payload)
+
+        row = np.asarray(
+            [gen, manifest_lib.crc32_file(shard_path),
+             os.path.getsize(shard_path), _warm_crc(warm)], np.int64)
+        gathered = self._exchange(row)  # the commit barrier
+        gathered = np.asarray(gathered, np.int64).reshape(
+            self.process_count, row.size)
+        gens = gathered[:, 0]
+        if not (gens == gen).all():
+            raise RuntimeError(
+                "mixed-generation commit refused: hosts are trying to "
+                f"commit generations {sorted(set(int(g) for g in gens))} "
+                "— the job is out of lockstep; restart from the last "
+                "committed manifest")
+        warm_crcs = gathered[:, 3]
+        if not (warm_crcs == warm_crcs[0]).all():
+            raise RuntimeError(
+                "replica divergence at checkpoint: the supposedly-"
+                "replicated AGDWarmState differs across hosts "
+                f"(CRC32s {[hex(int(c)) for c in warm_crcs]}); refusing "
+                "to commit a checkpoint that would hide it")
+
+        if self.process_index == 0:
+            shards = [manifest_lib.ShardEntry(
+                path=manifest_lib.shard_name(gen, p), process=p,
+                crc32=int(gathered[p, 1]), size=int(gathered[p, 2]))
+                for p in range(self.process_count)]
+            manifest_lib.write_manifest(self.directory, manifest_lib.Manifest(
+                generation=gen, process_count=self.process_count,
+                shards=shards, mesh_shape=self.mesh_shape,
+                fingerprint=self.fingerprint, converged=bool(converged),
+                aborted=bool(aborted),
+                prior_iters=int(warm.prior_iters)))
+            manifest_lib.gc_generations(self.directory, self.keep)
+        self._next_generation = gen + 1
+        self._last_saved_iters = int(warm.prior_iters)
+        self._last_saved_t = self._clock()
+        self.saves += 1
+        if self.telemetry is not None:
+            self.telemetry.recovery(
+                action=action, path=self.directory, generation=gen,
+                to_iter=int(warm.prior_iters),
+                process=self.process_index,
+                process_count=self.process_count, source="dist_ckpt")
+
+    # -- load: newest verifiable generation, topology-elastic -------------
+    def load(self, template: Any) -> Optional[LoadedDistCheckpoint]:
+        loaded = load_for_topology(
+            self.directory, template,
+            process_index=self.process_index,
+            process_count=self.process_count,
+            fingerprint=self.fingerprint, telemetry=self.telemetry)
+        if loaded is not None:
+            self._next_generation = loaded.generation + 1
+            self._last_saved_iters = int(loaded.warm.prior_iters)
+            self._last_saved_t = self._clock()
+            if loaded.elastic and loaded.partitions is not None \
+                    and self.partitions is None:
+                # adopt the re-split assignment so the NEXT generation
+                # records the topology we actually resumed onto
+                self.partitions = list(loaded.partitions)
+            if self.telemetry is not None and not loaded.elastic:
+                self.telemetry.recovery(
+                    action="resume", path=self.directory,
+                    generation=loaded.generation,
+                    to_iter=int(loaded.warm.prior_iters),
+                    process=self.process_index, source="dist_ckpt")
+        return loaded
+
+
+# ---------------------------------------------------------------------------
+# Host health: heartbeat files + the obs event stream, and the monitor
+# that turns staleness into HostLost.
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_name(process: int) -> str:
+    return f"heartbeat.h{process:03d}.json"
+
+
+class HeartbeatWriter:
+    """One host's liveness beacon: :meth:`beat` atomically rewrites
+    ``heartbeat.hNNN.json`` in ``directory`` (tiny: timestamp, pid,
+    iteration, phase) and emits a ``heartbeat`` record through the
+    telemetry bus when one is attached.  Call it at segment boundaries
+    (the supervisor does, via ``heartbeat=``) — often enough for a
+    monitor to notice death within a segment, cheap enough to never
+    show up in a profile."""
+
+    def __init__(self, directory: str, *,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 telemetry=None, clock=time.time):
+        self.directory = directory
+        self.process_index, self.process_count = _process_defaults(
+            process_index, process_count)
+        self.telemetry = telemetry
+        self._clock = clock
+        self.beats = 0
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory,
+                            heartbeat_name(self.process_index))
+
+    def beat(self, *, iter: Optional[int] = None,
+             phase: Optional[str] = None) -> dict:
+        rec = {"process": self.process_index,
+               "process_count": self.process_count,
+               "pid": os.getpid(), "time": round(self._clock(), 3)}
+        if iter is not None:
+            rec["iter"] = int(iter)
+        if phase is not None:
+            rec["phase"] = str(phase)
+        manifest_lib._atomic_write_text(self.path, json.dumps(rec))
+        self.beats += 1
+        if self.telemetry is not None:
+            fields = {k: rec[k] for k in ("process_count", "pid",
+                                          "iter", "phase") if k in rec}
+            self.telemetry.heartbeat(process=self.process_index,
+                                     **fields)
+        return rec
+
+
+class HostMonitor:
+    """Reads the heartbeat files and turns staleness into
+    :class:`~spark_agd_tpu.resilience.errors.HostLost`.
+
+    A host counts as LOST when it has beaten at least once and its file
+    is older than ``stale_after_s``; a host that never appeared is
+    "unseen" (still starting — not a loss).  ``expected`` (process
+    indices) scopes the check; default: whatever files exist.  Usable
+    from any process that sees the directory: a surviving peer (pass
+    ``monitor=`` to the supervisor) or an external babysitter (the
+    drill's parent process)."""
+
+    def __init__(self, directory: str, *, stale_after_s: float = 30.0,
+                 expected: Optional[Sequence[int]] = None,
+                 telemetry=None, clock=time.time):
+        if stale_after_s <= 0:
+            raise ValueError("stale_after_s must be > 0")
+        self.directory = directory
+        self.stale_after_s = float(stale_after_s)
+        self.expected = None if expected is None else sorted(
+            int(p) for p in expected)
+        self.telemetry = telemetry
+        self._clock = clock
+        self._reported: set = set()
+
+    def poll(self) -> Dict[int, dict]:
+        """Per-host last-known beat (the parsed file + ``age_s``)."""
+        out: Dict[int, dict] = {}
+        if not os.path.isdir(self.directory):
+            return out
+        now = self._clock()
+        for name in sorted(os.listdir(self.directory)):
+            m = _HEARTBEAT_RE.match(name)
+            if not m:
+                continue
+            p = int(m.group(1))
+            if self.expected is not None and p not in self.expected:
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    rec = json.load(f)
+            except (ValueError, OSError):
+                continue  # mid-rewrite / garbage: treat as unseen
+            rec["age_s"] = max(0.0, now - float(rec.get("time", 0.0)))
+            out[p] = rec
+        return out
+
+    def lost_hosts(self) -> List[int]:
+        return [p for p, rec in self.poll().items()
+                if rec["age_s"] > self.stale_after_s]
+
+    def check(self) -> None:
+        """Raise :class:`HostLost` for the first newly-stale host (one
+        ``host_lost`` recovery record per host per monitor, so a retry
+        loop does not spam the stream)."""
+        for p, rec in sorted(self.poll().items()):
+            if rec["age_s"] <= self.stale_after_s:
+                continue
+            if self.telemetry is not None and p not in self._reported:
+                self.telemetry.recovery(
+                    action="host_lost", process=p,
+                    reason=f"no heartbeat for {rec['age_s']:.1f}s "
+                           f"(last at iter {rec.get('iter')})",
+                    source="host_monitor")
+            self._reported.add(p)
+            raise HostLost(p, stale_for_s=rec["age_s"])

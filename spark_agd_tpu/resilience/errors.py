@@ -9,8 +9,10 @@ retrying IO helper (``resilience.retry``), the sanitizer
 (``utils.debug.report_numerics_failure``), and the fault-injection
 harness (``resilience.faults``) all speak these kinds:
 
-- ``TRANSIENT`` — worth retrying as-is: simulated/real device loss,
-  runtime/IO errors, attempt timeouts.  The supervisor retries with
+- ``TRANSIENT`` — worth retrying: simulated/real device loss, runtime/
+  IO errors, attempt timeouts, and a lost peer host (``HostLost`` —
+  retryable, but possibly on a CHANGED topology via the distributed
+  checkpoint's elastic resume).  The supervisor retries with
   exponential backoff; the same attempt is expected to succeed.
 - ``NUMERIC`` — the math went non-finite: retrying the identical
   attempt would fail identically.  The supervisor rolls back to the
@@ -41,6 +43,28 @@ class SimulatedDeviceLoss(RuntimeError):
     """A fault-injected stand-in for the runtime losing a device
     mid-run (TPU preemption sibling: the XLA ``DATA_LOSS`` /
     ``UNAVAILABLE`` RuntimeErrors).  Classified TRANSIENT."""
+
+
+class HostLost(RuntimeError):
+    """A PEER process of the SPMD job died or stopped heartbeating
+    (``resilience.distributed.HostMonitor``) — the multi-host sibling of
+    device loss.  Classified TRANSIENT: the work is retryable, but
+    unlike a plain transient the retry may have to happen on a CHANGED
+    topology (the dead host is gone), which is exactly what
+    ``DistributedCheckpointer.load_for_topology`` resumes onto.  Spark's
+    equivalent is a lost executor: the scheduler reruns its partitions
+    elsewhere rather than failing the job."""
+
+    def __init__(self, process_index: int, detail: str = "",
+                 stale_for_s: Optional[float] = None):
+        extra = f" ({detail})" if detail else ""
+        if stale_for_s is not None:
+            extra += f"; no heartbeat for {stale_for_s:.1f}s"
+        super().__init__(
+            f"host {process_index} lost{extra}; resume on the surviving "
+            "topology via DistributedCheckpointer.load_for_topology")
+        self.process_index = int(process_index)
+        self.stale_for_s = stale_for_s
 
 
 class NumericsFailureError(FloatingPointError):
@@ -108,8 +132,8 @@ def classify_failure(exc: BaseException) -> str:
     if isinstance(exc, (NumericsFailureError, FloatingPointError,
                         ZeroDivisionError)):
         return NUMERIC
-    if isinstance(exc, (SimulatedDeviceLoss, TimeoutError, OSError,
-                        ConnectionError, BrokenPipeError)):
+    if isinstance(exc, (SimulatedDeviceLoss, HostLost, TimeoutError,
+                        OSError, ConnectionError, BrokenPipeError)):
         return TRANSIENT
     if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError,
                         AssertionError, NotImplementedError)):

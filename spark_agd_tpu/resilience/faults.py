@@ -16,8 +16,10 @@ Fault kinds:
 - :class:`FaultScript` — iteration-scripted faults the supervisor
   consults at segment boundaries: simulated device loss
   (``device_loss_at_iter``), NaN poisoning of the next segment
-  (``nan_at_iter``), and a self-delivered SIGTERM
-  (``sigterm_at_iter``) that exercises the preemption flush.
+  (``nan_at_iter``), a self-delivered SIGTERM (``sigterm_at_iter``)
+  that exercises the preemption flush, and a self-delivered SIGKILL
+  (``sigkill_at_iter``) — uncatchable, no flush — that plays the DEAD
+  HOST in the multi-host drill (``tools/dist_fault_drill.py``).
 - :func:`truncate_file` / :func:`scramble_file` — corrupt a checkpoint
   on disk: drives the ``.bak``-generation fallback.
 - :func:`flaky` — a callable that fails its first N calls with an IO
@@ -75,10 +77,12 @@ class FaultScript:
     def __init__(self, *, device_loss_at_iter: Optional[int] = None,
                  nan_at_iter: Optional[int] = None,
                  sigterm_at_iter: Optional[int] = None,
+                 sigkill_at_iter: Optional[int] = None,
                  signum: int = signal_lib.SIGTERM):
         self._device_loss_at = device_loss_at_iter
         self._nan_at = nan_at_iter
         self._sigterm_at = sigterm_at_iter
+        self._sigkill_at = sigkill_at_iter
         self._signum = signum
         self.fired: list = []  # (fault_name, global_iter) in fire order
 
@@ -93,6 +97,14 @@ class FaultScript:
     def before_segment(self, global_iter: int) -> None:
         """May raise / signal.  Called before each segment launches with
         the iterations completed so far."""
+        if self._take("_sigkill_at", global_iter):
+            # the HOST-DEATH fault: SIGKILL cannot be caught, so there
+            # is no preemption flush, no unwind, no goodbye — exactly
+            # the artifact a dead peer leaves behind (stale heartbeat,
+            # possibly an uncommitted shard).  fired is appended first
+            # only for the (untestable) case the kill fails.
+            self.fired.append(("sigkill", global_iter))
+            os.kill(os.getpid(), signal_lib.SIGKILL)
         if self._take("_sigterm_at", global_iter):
             self.fired.append(("sigterm", global_iter))
             signal_lib.raise_signal(self._signum)
@@ -116,7 +128,8 @@ class FaultScript:
     @property
     def exhausted(self) -> bool:
         return (self._device_loss_at is None and self._nan_at is None
-                and self._sigterm_at is None)
+                and self._sigterm_at is None
+                and self._sigkill_at is None)
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5,

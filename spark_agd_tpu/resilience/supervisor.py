@@ -60,11 +60,21 @@ class ResiliencePolicy(retry_lib.RetryPolicy):
     the last checkpoint).  Smaller segments bound how much work one
     fault can destroy — and set the granularity of auto-checkpoints,
     fault injection, and preemption points.
+
+    ``max_wall_seconds`` (None = unbounded) is the run's total
+    wall-clock budget: once exceeded, the supervisor STOPS retrying —
+    a DEADLINE-tagged entry lands in the ledger and
+    :class:`~spark_agd_tpu.resilience.errors.SupervisorGivingUp` is
+    raised — instead of backing off forever against a fault that is
+    never going to clear.  Checked at segment boundaries (a compiled
+    segment cannot be interrupted mid-flight; bound single-attempt
+    time with ``attempt_timeout``).
     """
 
     max_rollbacks: int = 3
     rollback_l_factor: float = 4.0
     segment_iters: Optional[int] = None
+    max_wall_seconds: Optional[float] = None
 
     def __post_init__(self):
         super().__post_init__()
@@ -76,6 +86,8 @@ class ResiliencePolicy(retry_lib.RetryPolicy):
                 "the step, or the retried segment fails identically)")
         if self.segment_iters is not None and self.segment_iters < 1:
             raise ValueError("segment_iters must be >= 1")
+        if self.max_wall_seconds is not None and self.max_wall_seconds <= 0:
+            raise ValueError("max_wall_seconds must be > 0")
 
 
 class SupervisedResult(NamedTuple):
@@ -115,7 +127,10 @@ def run_agd_supervised(
     smooth_loss: Optional[Callable] = None,
     faults: Optional["faults_lib.FaultScript"] = None,
     place_w: Optional[Callable] = None,
+    heartbeat=None,
+    monitor=None,
     sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
 ) -> SupervisedResult:
     """Run one AGD fit to completion under the supervision policy.
 
@@ -135,6 +150,15 @@ def run_agd_supervised(
 
     ``faults`` (a :class:`~spark_agd_tpu.resilience.faults.
     FaultScript`): consulted at segment boundaries — test/drill only.
+
+    ``heartbeat`` (a :class:`~spark_agd_tpu.resilience.distributed.
+    HeartbeatWriter`): beaten at every segment boundary and once at
+    exit, so a peer/babysitter can detect this host's death within one
+    segment.  ``monitor`` (a :class:`~spark_agd_tpu.resilience.
+    distributed.HostMonitor`): checked before each segment; a stale
+    peer raises ``HostLost``, which classifies TRANSIENT — retried with
+    backoff here, and resumable onto a changed topology by a relaunch
+    (``DistributedCheckpointer.load_for_topology``).
     """
     if w0 is None or config is None:
         raise ValueError("w0 and config are required")
@@ -214,6 +238,7 @@ def run_agd_supervised(
     retries = rollbacks = 0
     converged = aborted = False
     total = int(config.num_iterations)
+    t_run0 = clock()
 
     def record_attempt(outcome: str, start_iter: int, iters: int,
                        seconds: float, error: Optional[str] = None,
@@ -247,9 +272,29 @@ def run_agd_supervised(
         while int(warm.prior_iters) < total:
             start = int(warm.prior_iters)
             k = min(policy.segment_iters or total, total - start)
-            if faults is not None:
+            if policy.max_wall_seconds is not None:
+                elapsed = clock() - t_run0
+                if elapsed > policy.max_wall_seconds:
+                    attempt_no += 1
+                    record_attempt(
+                        "deadline", start, 0, elapsed,
+                        error=(f"wall-clock budget "
+                               f"{policy.max_wall_seconds:g}s exceeded"),
+                        failure_kind="deadline")
+                    raise errors.SupervisorGivingUp(
+                        f"DEADLINE: wall-clock budget "
+                        f"{policy.max_wall_seconds:g}s exhausted after "
+                        f"{elapsed:.3f}s at iteration {start} "
+                        f"({retries} retries, {rollbacks} rollbacks so "
+                        "far); not retrying further", ledger)
+            if heartbeat is not None:
+                heartbeat.beat(iter=start, phase="segment")
+            if faults is not None or monitor is not None:
                 try:
-                    faults.before_segment(start)
+                    if faults is not None:
+                        faults.before_segment(start)
+                    if monitor is not None:
+                        monitor.check()
                 except Exception as e:  # noqa: BLE001 — classified below
                     attempt_no += 1
                     kind = errors.classify_failure(e)
@@ -337,6 +382,11 @@ def run_agd_supervised(
             checkpointer.update(warm, hist, converged=converged,
                                 aborted=aborted, force=True)
             checkpointer.uninstall_signal_handlers()
+        if heartbeat is not None:
+            try:
+                heartbeat.beat(iter=int(warm.prior_iters), phase="exit")
+            except OSError:  # a dying filesystem must not mask the
+                pass         # real exit path
 
     return SupervisedResult(
         weights=warm.x, loss_history=np.asarray(hist),

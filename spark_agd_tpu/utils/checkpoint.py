@@ -26,10 +26,12 @@ zero-step gate via ``prior_iters``), pinned by the parity tests in
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import tempfile
 import zipfile
+import zlib
 from typing import Any, Dict, NamedTuple, Optional
 
 import jax
@@ -63,19 +65,51 @@ def _flat(tree):
     return jax.tree_util.tree_leaves(tree)
 
 
+# the npz entry holding the per-entry CRC32 map (JSON: name -> crc);
+# written by atomic_savez, verified and stripped by read_npz_entries
+CRC_ENTRY = "__crc32__"
+
+
+def _entry_crc32(value: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(value).tobytes())
+
+
 def read_npz_entries(path: str) -> Dict[str, np.ndarray]:
     """Materialize EVERY entry of an npz into host arrays, converting
     any parse failure — bad zip directory, truncated member, zlib
     garbage — into one typed :class:`CheckpointCorruptError`.  Forcing
     the full read up front is the point: ``np.load`` is lazy, so a
     truncated member would otherwise explode only at first access,
-    midway through rebuilding a pytree."""
+    midway through rebuilding a pytree.
+
+    When the file carries a ``__crc32__`` entry (every npz written by
+    :func:`atomic_savez` does), each listed entry's bytes are verified
+    against its stored CRC32 — so a SILENT bit-flip (bad sector,
+    bit-rot, a tool rewriting the archive) raises the same typed error
+    as an unparseable file, instead of resuming from corrupt state.
+    Files without the entry (pre-upgrade checkpoints) load unchecked."""
     try:
         with np.load(path) as data:
-            return {k: np.asarray(data[k]) for k in data.files}
+            entries = {k: np.asarray(data[k]) for k in data.files}
     except (zipfile.BadZipFile, EOFError, OSError, KeyError,
             ValueError) as e:
         raise CheckpointCorruptError(path, e) from e
+    crc_entry = entries.pop(CRC_ENTRY, None)
+    if crc_entry is not None:
+        try:
+            crcs = json.loads(str(crc_entry))
+        except ValueError as e:
+            raise CheckpointCorruptError(path, e) from e
+        for name, expect in crcs.items():
+            if name not in entries:
+                raise CheckpointCorruptError(
+                    path, KeyError(f"checksummed entry {name!r} missing"))
+            if _entry_crc32(entries[name]) != int(expect):
+                raise CheckpointCorruptError(
+                    path, ValueError(
+                        f"entry {name!r} fails its CRC32 (silent "
+                        "bit-flip or partial rewrite)"))
+    return entries
 
 
 class _Entries:
@@ -120,14 +154,13 @@ def problem_fingerprint(w0: Any, config: AGDConfig) -> str:
     return f"{treedef}|{shapes}|{sorted(cfg.items())}"
 
 
-def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
-                    *, converged: bool = False, aborted: bool = False,
-                    fingerprint: Optional[str] = None) -> None:
-    """Atomically write the continuation carry (+ cumulative loss history).
-
-    ``converged``/``aborted`` mark a *terminal* checkpoint: the run stopped
-    by its own criteria, and resuming must be a no-op rather than extra
-    iterations (or, for abort, a resume from non-finite weights)."""
+def warm_payload(warm: AGDWarmState, loss_history=None, *,
+                 converged: bool = False, aborted: bool = False,
+                 fingerprint: Optional[str] = None) -> dict:
+    """The npz payload of one ``AGDWarmState`` checkpoint — the ONE
+    encoding :func:`save_checkpoint` and the multi-host shard writer
+    (``resilience.distributed``) share, so a distributed shard is a
+    superset of a single-host checkpoint and the loaders never fork."""
     payload = {}
     for name, tree in (("x", warm.x), ("z", warm.z)):
         for i, leaf in enumerate(_flat(tree)):
@@ -142,13 +175,33 @@ def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
         payload["fingerprint"] = np.asarray(fingerprint)
     payload["loss_history"] = (np.zeros(0) if loss_history is None
                                else np.asarray(loss_history))
-    atomic_savez(path, payload)
+    return payload
+
+
+def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
+                    *, converged: bool = False, aborted: bool = False,
+                    fingerprint: Optional[str] = None) -> None:
+    """Atomically write the continuation carry (+ cumulative loss history).
+
+    ``converged``/``aborted`` mark a *terminal* checkpoint: the run stopped
+    by its own criteria, and resuming must be a no-op rather than extra
+    iterations (or, for abort, a resume from non-finite weights)."""
+    atomic_savez(path, warm_payload(
+        warm, loss_history, converged=converged, aborted=aborted,
+        fingerprint=fingerprint))
 
 
 def atomic_savez(path: str, payload: dict):
     """Write an npz atomically (tempfile in the target dir + rename), so
     a kill mid-write can never leave a torn file.  Creates the directory
-    if needed.  Shared by checkpoints and model persistence."""
+    if needed.  Shared by checkpoints and model persistence.
+
+    Every write carries a ``__crc32__`` entry mapping each payload entry
+    to the CRC32 of its bytes; ``read_npz_entries`` verifies it on load,
+    so silent bit-flips are caught, not just unparseable files."""
+    payload = dict(payload)
+    payload[CRC_ENTRY] = np.asarray(json.dumps(
+        {k: _entry_crc32(np.asarray(v)) for k, v in payload.items()}))
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -168,6 +221,45 @@ class LoadedCheckpoint(NamedTuple):
     converged: bool
     aborted: bool
     fingerprint: Optional[str]
+
+
+def checkpoint_from_entries(path: str, data: "_Entries", template: Any,
+                            expect_fingerprint: Optional[str] = None,
+                            ) -> LoadedCheckpoint:
+    """Rebuild one ``AGDWarmState`` checkpoint from already-read npz
+    entries — the parsing half of :func:`load_checkpoint`, shared with
+    the multi-host shard loader (``resilience.distributed``), whose
+    shard files carry the same payload plus manifest bookkeeping."""
+    treedef = jax.tree_util.tree_structure(template)
+    n = treedef.num_leaves
+    fp = str(data["fingerprint"]) if "fingerprint" in data else None
+    if (expect_fingerprint is not None and fp is not None
+            and fp != expect_fingerprint):
+        raise ValueError(
+            f"checkpoint at {path!r} belongs to a different problem "
+            "(weight structure or config changed); delete it or use "
+            "a different path")
+    if "multi" in data:
+        raise ValueError(
+            f"checkpoint at {path!r} is a MULTI-lane checkpoint "
+            "(run_agd_multi_checkpointed); load it with "
+            "load_multi_checkpoint / resume it with the multi "
+            "driver")
+    if "lbfgs" in data:
+        raise ValueError(
+            f"checkpoint at {path!r} is an L-BFGS checkpoint "
+            "(run_lbfgs_checkpointed); load it with "
+            "load_lbfgs_checkpoint")
+    tree = lambda name: _load_tree(data, treedef, n, name)
+
+    warm = AGDWarmState(
+        x=tree("x"), z=tree("z"),
+        theta=float(data["theta"]), big_l=float(data["big_l"]),
+        bts=bool(data["bts"]), prior_iters=int(data["prior_iters"]))
+    hist = np.asarray(data["loss_history"])
+    converged = bool(data["converged"]) if "converged" in data else False
+    aborted = bool(data["aborted"]) if "aborted" in data else False
+    return LoadedCheckpoint(warm, hist, converged, aborted, fp)
 
 
 def load_checkpoint(path: str, template: Any,
@@ -190,36 +282,8 @@ def load_checkpoint(path: str, template: Any,
         return None
     try:
         data = _Entries(path, read_npz_entries(path))
-        treedef = jax.tree_util.tree_structure(template)
-        n = treedef.num_leaves
-        fp = str(data["fingerprint"]) if "fingerprint" in data else None
-        if (expect_fingerprint is not None and fp is not None
-                and fp != expect_fingerprint):
-            raise ValueError(
-                f"checkpoint at {path!r} belongs to a different problem "
-                "(weight structure or config changed); delete it or use "
-                "a different path")
-        if "multi" in data:
-            raise ValueError(
-                f"checkpoint at {path!r} is a MULTI-lane checkpoint "
-                "(run_agd_multi_checkpointed); load it with "
-                "load_multi_checkpoint / resume it with the multi "
-                "driver")
-        if "lbfgs" in data:
-            raise ValueError(
-                f"checkpoint at {path!r} is an L-BFGS checkpoint "
-                "(run_lbfgs_checkpointed); load it with "
-                "load_lbfgs_checkpoint")
-        tree = lambda name: _load_tree(data, treedef, n, name)
-
-        warm = AGDWarmState(
-            x=tree("x"), z=tree("z"),
-            theta=float(data["theta"]), big_l=float(data["big_l"]),
-            bts=bool(data["bts"]), prior_iters=int(data["prior_iters"]))
-        hist = np.asarray(data["loss_history"])
-        converged = bool(data["converged"]) if "converged" in data else False
-        aborted = bool(data["aborted"]) if "aborted" in data else False
-        return LoadedCheckpoint(warm, hist, converged, aborted, fp)
+        return checkpoint_from_entries(path, data, template,
+                                       expect_fingerprint)
     except CheckpointCorruptError:
         bak = path + ".bak"
         if fallback_to_bak and os.path.exists(bak):

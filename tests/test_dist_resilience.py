@@ -1,0 +1,685 @@
+"""Distributed resilience (resilience/manifest.py + distributed.py):
+barrier-committed multi-host checkpoints, checksummed manifests,
+host-loss detection, and elastic resume onto a changed topology — plus
+this PR's satellites (supervisor wall-clock deadline, ingest
+validation, per-entry checkpoint CRCs).
+
+Single-process tests simulate the SPMD hosts with explicit
+``process_index``/``process_count`` and a thread-barrier ``exchange``
+(the real allgather path runs in ``tests/test_multihost.py``'s
+2-process child and the ``dist_fault``-marked drill test below).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu.core import agd
+from spark_agd_tpu.core.agd import AGDConfig, AGDWarmState
+from spark_agd_tpu.data import ingest, libsvm
+from spark_agd_tpu.obs import Telemetry, schema
+from spark_agd_tpu.parallel import multihost as mh
+from spark_agd_tpu.resilience import (
+    DistributedCheckpointer,
+    HeartbeatWriter,
+    HostLost,
+    HostMonitor,
+    ResiliencePolicy,
+    SupervisorGivingUp,
+    classify_failure,
+    errors,
+    faults,
+    load_for_topology,
+    manifest,
+    run_agd_supervised,
+)
+from spark_agd_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.fault
+
+
+def _warm(prior_iters=3, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    cfg = AGDConfig(num_iterations=10)
+    w = rng.standard_normal(d).astype(np.float32)
+    return AGDWarmState.initial(w, cfg)._replace(
+        prior_iters=prior_iters), w
+
+
+class ThreadExchange:
+    """A real (threading.Barrier) stand-in for the allgather barrier:
+    N simulated hosts block until all have contributed their row."""
+
+    def __init__(self, n):
+        self.n = n
+        self._barrier = threading.Barrier(n, timeout=30)
+        self._rows = {}
+
+    def for_process(self, p):
+        def exchange(row):
+            self._rows[p] = np.asarray(row)
+            self._barrier.wait()
+            out = np.stack([self._rows[i] for i in range(self.n)])
+            self._barrier.wait()  # hold rows until everyone copied
+            return out
+
+        return exchange
+
+
+def _two_host_save(tmp_path, warm, hist=(0.5, 0.4), *, keep=3,
+                   generations=1, fingerprint=None, telemetry=None,
+                   row_len=4):
+    """Run a REAL concurrent 2-host barrier commit (threads) for
+    ``generations`` saves; returns the checkpointers."""
+    ex = ThreadExchange(2)
+    cks = [DistributedCheckpointer(
+        str(tmp_path), every_iters=1, keep=keep,
+        fingerprint=fingerprint, telemetry=telemetry,
+        mesh_shape={"data": 2},
+        partitions=[f"part-{p}", f"part-{p + 2}"],
+        row_state={"rows": np.arange(p * row_len, (p + 1) * row_len)},
+        process_index=p, process_count=2,
+        exchange=ex.for_process(p)) for p in (0, 1)]
+
+    errs = []
+
+    def run(p):
+        try:
+            w = warm
+            for g in range(generations):
+                cks[p]._save(w._replace(prior_iters=int(w.prior_iters)
+                                        + g), list(hist), False, False)
+        except Exception as e:  # noqa: BLE001 — surfaced to the test
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    return cks
+
+
+class TestManifest:
+    def test_roundtrip(self, tmp_path):
+        m = manifest.Manifest(
+            generation=7, process_count=2,
+            shards=[manifest.ShardEntry(manifest.shard_name(7, p), p,
+                                        123 + p, 456) for p in (0, 1)],
+            mesh_shape={"data": 4}, fingerprint="fp",
+            prior_iters=12)
+        manifest.write_manifest(str(tmp_path), m)
+        # HEAD and the per-generation manifest both parse to the same
+        head = manifest.load_manifest(str(tmp_path))
+        by_gen = manifest.load_manifest(str(tmp_path), 7)
+        assert head.generation == by_gen.generation == 7
+        assert head.shards == m.shards
+        assert head.mesh_shape == {"data": 4}
+        assert manifest.committed_generations(str(tmp_path)) == [7]
+
+    def test_verify_catches_missing_torn_and_corrupt(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm)
+        m = manifest.load_manifest(str(tmp_path))
+        assert manifest.verify_manifest(m, str(tmp_path)) == []
+        shard1 = m.shard_path(str(tmp_path), 1)
+        faults.truncate_file(shard1, keep_fraction=0.5)
+        assert any("torn" in p
+                   for p in manifest.verify_manifest(m, str(tmp_path)))
+        faults.scramble_file(shard1, seed=3)  # same length, bad bytes
+        os.truncate(shard1, m.shards[1].size)
+        problems = manifest.verify_manifest(m, str(tmp_path))
+        assert any("CRC32" in p for p in problems), problems
+        os.unlink(shard1)
+        assert any("missing" in p
+                   for p in manifest.verify_manifest(m, str(tmp_path)))
+
+    def test_head_fallback_when_head_torn(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm)
+        head = os.path.join(str(tmp_path), manifest.HEAD_NAME)
+        with open(head, "w") as f:
+            f.write("{not json")
+        m = manifest.load_manifest(str(tmp_path))
+        assert m is not None and m.generation == 0
+
+    def test_gc_keeps_newest_and_spares_inflight(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm, generations=4, keep=2)
+        gens = manifest.committed_generations(str(tmp_path))
+        assert gens == [3, 2]
+        # an orphan shard NEWER than the newest commit (a commit in
+        # flight) must survive gc; a dead old orphan must not
+        inflight = os.path.join(str(tmp_path), manifest.shard_name(9, 0))
+        ckpt.atomic_savez(inflight, {"generation": np.asarray(9)})
+        manifest.gc_generations(str(tmp_path), keep=2)
+        assert os.path.exists(inflight)
+
+
+class TestDistributedCheckpointer:
+    def test_unchanged_topology_roundtrip_bit_identical(self, tmp_path):
+        warm, w0 = _warm(prior_iters=5)
+        tel = Telemetry()
+        _two_host_save(tmp_path, warm, fingerprint="fp", telemetry=tel)
+        for p in (0, 1):
+            loaded = load_for_topology(str(tmp_path), w0,
+                                       process_index=p, process_count=2,
+                                       fingerprint="fp")
+            assert loaded is not None and not loaded.elastic
+            assert loaded.generation == 0
+            assert loaded.saved_process_count == 2
+            # bit-identical: the host reads back its own shard's bytes
+            for a, b in ((loaded.warm.x, warm.x), (loaded.warm.z, warm.z)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+                assert np.asarray(a).dtype == np.asarray(b).dtype
+            assert float(loaded.warm.big_l) == float(warm.big_l)
+            assert int(loaded.warm.prior_iters) == 5
+            assert loaded.partitions == (f"part-{p}", f"part-{p + 2}")
+            np.testing.assert_array_equal(loaded.row_state["rows"],
+                                          np.arange(p * 4, (p + 1) * 4))
+
+    def test_elastic_2_to_1_gathers_everything(self, tmp_path):
+        warm, w0 = _warm()
+        tel = Telemetry()
+        _two_host_save(tmp_path, warm, fingerprint="fp")
+        loaded = load_for_topology(str(tmp_path), w0, process_index=0,
+                                   process_count=1, fingerprint="fp",
+                                   telemetry=tel)
+        assert loaded.elastic and loaded.saved_process_count == 2
+        # partitions: sorted union, round-robin for ONE process = all
+        assert loaded.partitions == ("part-0", "part-1", "part-2",
+                                     "part-3")
+        np.testing.assert_array_equal(loaded.row_state["rows"],
+                                      np.arange(8))
+        np.testing.assert_array_equal(np.asarray(loaded.warm.x),
+                                      np.asarray(warm.x))
+        recs = [r for r in tel.records
+                if r.get("action") == "elastic_resume"]
+        assert len(recs) == 1 and recs[0]["saved_process_count"] == 2
+
+    def test_elastic_1_to_2_resplits(self, tmp_path):
+        """Growth works too: a 1-process save resumes on 2 processes
+        with the partition list and rows re-split per host."""
+        warm, w0 = _warm()
+        ck = DistributedCheckpointer(
+            str(tmp_path), every_iters=1,
+            partitions=["part-0", "part-1", "part-2"],
+            row_state={"rows": np.arange(6)},
+            process_index=0, process_count=1)
+        ck._save(warm, [0.5], False, False)
+        for p, (parts, rows) in enumerate(
+                [(("part-0", "part-2"), np.arange(3)),
+                 (("part-1",), np.arange(3, 6))]):
+            loaded = load_for_topology(str(tmp_path), w0,
+                                       process_index=p, process_count=2)
+            assert loaded.elastic
+            assert loaded.partitions == parts
+            np.testing.assert_array_equal(loaded.row_state["rows"], rows)
+
+    def test_torn_newest_generation_falls_back(self, tmp_path):
+        warm, w0 = _warm(prior_iters=2)
+        tel = Telemetry()
+        _two_host_save(tmp_path, warm, generations=3, telemetry=tel)
+        m = manifest.load_manifest(str(tmp_path))
+        assert m.generation == 2
+        faults.truncate_file(m.shard_path(str(tmp_path), 0),
+                             keep_fraction=0.4)
+        loaded = load_for_topology(str(tmp_path), w0, process_index=0,
+                                   process_count=2, telemetry=tel)
+        assert loaded is not None and loaded.generation == 1
+        assert int(loaded.warm.prior_iters) == 3  # gen1 = prior + 1
+        fb = [r for r in tel.records
+              if r.get("action") == "checkpoint_fallback"]
+        assert fb and fb[0]["generation"] == 2
+
+    def test_uncommitted_shard_is_invisible(self, tmp_path):
+        """The commit-barrier contract: a shard WITHOUT its manifest —
+        a host died between shard write and barrier — must not be
+        loadable, while the previous committed generation is."""
+        warm, w0 = _warm(prior_iters=4)
+        _two_host_save(tmp_path, warm)
+        orphan = os.path.join(str(tmp_path), manifest.shard_name(1, 0))
+        ckpt.atomic_savez(orphan, ckpt.warm_payload(
+            warm._replace(prior_iters=99)) | {
+                "generation": np.asarray(1),
+                "process_index": np.asarray(0),
+                "process_count": np.asarray(2)})
+        loaded = load_for_topology(str(tmp_path), w0, process_index=0,
+                                   process_count=2)
+        assert loaded.generation == 0
+        assert int(loaded.warm.prior_iters) == 4  # not the orphan's 99
+
+    def test_mixed_generation_commit_refused(self, tmp_path):
+        warm, w0 = _warm()
+        ex = ThreadExchange(2)
+        cks = [DistributedCheckpointer(
+            str(tmp_path), every_iters=1, process_index=p,
+            process_count=2, exchange=ex.for_process(p))
+            for p in (0, 1)]
+        cks[1]._next_generation = 5  # host 1 lost lockstep
+        errs = {}
+
+        def run(p):
+            try:
+                cks[p]._save(warm, [0.5], False, False)
+            except Exception as e:  # noqa: BLE001
+                errs[p] = e
+
+        ts = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(errs) == 2
+        assert all("mixed-generation" in str(e) for e in errs.values())
+        # and nothing was committed
+        assert manifest.committed_generations(str(tmp_path)) == []
+
+    def test_replica_divergence_refused(self, tmp_path):
+        warm, w0 = _warm()
+        ex = ThreadExchange(2)
+        cks = [DistributedCheckpointer(
+            str(tmp_path), every_iters=1, process_index=p,
+            process_count=2, exchange=ex.for_process(p))
+            for p in (0, 1)]
+        warms = [warm, warm._replace(big_l=999.0)]  # host 1 diverged
+        errs = {}
+
+        def run(p):
+            try:
+                cks[p]._save(warms[p], [0.5], False, False)
+            except Exception as e:  # noqa: BLE001
+                errs[p] = e
+
+        ts = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(errs) == 2
+        assert all("divergence" in str(e) for e in errs.values())
+
+    def test_fingerprint_mismatch_raises_not_falls_back(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm, fingerprint="problem-A")
+        with pytest.raises(ValueError, match="different problem"):
+            load_for_topology(str(tmp_path), w0, process_index=0,
+                              process_count=2,
+                              fingerprint="problem-B")
+
+    def test_all_generations_corrupt_returns_none(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm, generations=2)
+        for gen in (0, 1):
+            m = manifest.load_manifest(str(tmp_path), gen)
+            faults.truncate_file(m.shard_path(str(tmp_path), 1),
+                                 keep_fraction=0.3)
+        assert load_for_topology(str(tmp_path), w0, process_index=0,
+                                 process_count=2) is None
+
+    def test_single_process_supervised_resume_matches_plain(
+            self, tmp_path):
+        """The DistributedCheckpointer drops into the supervisor's
+        ``checkpointer=`` seat: on ONE process a kill-free save/resume
+        cycle must reproduce the plain supervised run exactly."""
+        from spark_agd_tpu.core import smooth as smooth_lib
+        from spark_agd_tpu.data import synthetic
+        from spark_agd_tpu.ops.losses import LogisticGradient
+        from spark_agd_tpu.ops.prox import L2Prox
+        import jax.numpy as jnp
+
+        X, y = synthetic.generate_gd_input(2.0, -1.5, 200, 11)
+        X = synthetic.with_intercept_column(X).astype(np.float32)
+        build, dargs = smooth_lib.make_smooth_staged(
+            LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        w0 = jnp.zeros(2, jnp.float32)
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=12)
+        pol = ResiliencePolicy(max_attempts=2, backoff_base=0.0,
+                               jitter=0.0, seed=0, segment_iters=4)
+        plain = run_agd_supervised(prox=px, reg_value=rv, w0=w0,
+                                   config=cfg, policy=pol,
+                                   staged=(build, dargs))
+        fp = ckpt.problem_fingerprint(w0, cfg)
+
+        # first launch: run only 8 of 12 iterations, then "die"
+        import dataclasses
+
+        ck = DistributedCheckpointer(str(tmp_path), every_iters=4,
+                                     fingerprint=fp, process_index=0,
+                                     process_count=1)
+        run_agd_supervised(
+            prox=px, reg_value=rv, w0=w0,
+            config=dataclasses.replace(cfg, num_iterations=8),
+            policy=pol, staged=(build, dargs), checkpointer=ck)
+        # relaunch with the full budget: resumes at 8, finishes at 12
+        ck2 = DistributedCheckpointer(str(tmp_path), every_iters=4,
+                                      fingerprint=fp, process_index=0,
+                                      process_count=1)
+        res = run_agd_supervised(prox=px, reg_value=rv, w0=w0,
+                                 config=cfg, policy=pol,
+                                 staged=(build, dargs),
+                                 checkpointer=ck2)
+        assert res.resumed_from == 8
+        assert res.num_iters == plain.num_iters
+        np.testing.assert_array_equal(np.asarray(res.weights),
+                                      np.asarray(plain.weights))
+        np.testing.assert_allclose(res.loss_history,
+                                   plain.loss_history, rtol=0, atol=0)
+
+
+class TestHeartbeats:
+    def test_writer_emits_file_and_record(self, tmp_path):
+        tel = Telemetry()
+        hb = HeartbeatWriter(str(tmp_path), process_index=1,
+                             process_count=2, telemetry=tel)
+        hb.beat(iter=7, phase="segment")
+        with open(hb.path) as f:
+            rec = json.load(f)
+        assert rec["process"] == 1 and rec["iter"] == 7
+        hbs = [r for r in tel.records if r["kind"] == "heartbeat"]
+        assert len(hbs) == 1 and hbs[0]["process"] == 1
+        assert hbs[0]["phase"] == "segment"
+        assert not schema.validate_record(
+            json.loads(json.dumps(hbs[0])))
+
+    def test_monitor_detects_stale_host(self, tmp_path):
+        t = [100.0]
+        tel = Telemetry()
+        hb = HeartbeatWriter(str(tmp_path), process_index=1,
+                             process_count=2, clock=lambda: t[0])
+        hb.beat(iter=3)
+        mon = HostMonitor(str(tmp_path), stale_after_s=5.0,
+                          telemetry=tel, clock=lambda: t[0])
+        mon.check()  # fresh: no raise
+        t[0] += 10.0
+        with pytest.raises(HostLost) as ei:
+            mon.check()
+        assert ei.value.process_index == 1
+        assert classify_failure(ei.value) == errors.TRANSIENT
+        lost = [r for r in tel.records if r.get("action") == "host_lost"]
+        assert len(lost) == 1 and lost[0]["process"] == 1
+        # repeated checks raise again but do not re-emit the record
+        with pytest.raises(HostLost):
+            mon.check()
+        assert len([r for r in tel.records
+                    if r.get("action") == "host_lost"]) == 1
+
+    def test_unseen_host_is_not_lost(self, tmp_path):
+        mon = HostMonitor(str(tmp_path), stale_after_s=0.01,
+                          expected=[0, 1])
+        assert mon.lost_hosts() == []
+        mon.check()
+
+    def test_supervisor_beats_and_monitor_retry(self, tmp_path):
+        """Wiring: the supervisor beats at every segment boundary, and a
+        HostLost from the monitor is retried as TRANSIENT (the peer came
+        back / was replaced) rather than treated FATAL."""
+        from spark_agd_tpu.core import smooth as smooth_lib
+        from spark_agd_tpu.data import synthetic
+        from spark_agd_tpu.ops.losses import LogisticGradient
+        from spark_agd_tpu.ops.prox import L2Prox
+        import jax.numpy as jnp
+
+        X, y = synthetic.generate_gd_input(2.0, -1.5, 200, 5)
+        X = synthetic.with_intercept_column(X).astype(np.float32)
+        build, dargs = smooth_lib.make_smooth_staged(
+            LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        w0 = jnp.zeros(2, jnp.float32)
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=8)
+        tel = Telemetry()
+        hb = HeartbeatWriter(str(tmp_path), process_index=0,
+                             process_count=1, telemetry=tel)
+
+        class OneShotLostMonitor:
+            calls = 0
+
+            def check(self):
+                self.calls += 1
+                if self.calls == 2:  # lost once, at the second segment
+                    raise HostLost(1, "peer gone")
+
+        res = run_agd_supervised(
+            prox=px, reg_value=rv, w0=w0, config=cfg,
+            policy=ResiliencePolicy(max_attempts=3, backoff_base=0.0,
+                                    jitter=0.0, seed=0,
+                                    segment_iters=4),
+            staged=(build, dargs), telemetry=tel, heartbeat=hb,
+            monitor=OneShotLostMonitor())
+        assert res.num_iters == 8 and res.retries == 1
+        beats = [r for r in tel.records if r["kind"] == "heartbeat"]
+        assert len(beats) >= 3  # two segments + retry + exit
+        assert beats[-1]["phase"] == "exit"
+        lost_attempts = [r for r in tel.records
+                         if r.get("kind") == "attempt"
+                         and r.get("outcome") == "failed"]
+        assert lost_attempts and \
+            lost_attempts[0]["failure_kind"] == "transient"
+        assert "HostLost" in lost_attempts[0]["error"]
+
+
+class TestSupervisorDeadline:
+    """Satellite: ``max_wall_seconds`` turns an endless retry spiral
+    into a DEADLINE-tagged SupervisorGivingUp."""
+
+    def _problem(self):
+        from spark_agd_tpu.core import smooth as smooth_lib
+        from spark_agd_tpu.data import synthetic
+        from spark_agd_tpu.ops.losses import LogisticGradient
+        from spark_agd_tpu.ops.prox import L2Prox
+        import jax.numpy as jnp
+
+        X, y = synthetic.generate_gd_input(2.0, -1.5, 200, 3)
+        X = synthetic.with_intercept_column(X).astype(np.float32)
+        build, dargs = smooth_lib.make_smooth_staged(
+            LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        return build, dargs, px, rv, jnp.zeros(2, jnp.float32)
+
+    def test_deadline_raises_with_tagged_ledger(self):
+        build, dargs, px, rv, w0 = self._problem()
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=40)
+        t = [0.0]
+
+        def clock():
+            t[0] += 2.0  # every boundary costs 2 "seconds"
+            return t[0]
+
+        tel = Telemetry()
+        with pytest.raises(SupervisorGivingUp, match="DEADLINE") as ei:
+            run_agd_supervised(
+                prox=px, reg_value=rv, w0=w0, config=cfg,
+                policy=ResiliencePolicy(
+                    max_attempts=3, backoff_base=0.0, jitter=0.0,
+                    seed=0, segment_iters=5, max_wall_seconds=5.0),
+                staged=(build, dargs), telemetry=tel, clock=clock)
+        ledger = ei.value.ledger
+        assert ledger and ledger[-1]["outcome"] == "deadline"
+        assert ledger[-1]["failure_kind"] == "deadline"
+        # the deadline attempt landed on the telemetry stream too,
+        # schema-valid
+        dl = [r for r in tel.records if r.get("kind") == "attempt"
+              and r.get("outcome") == "deadline"]
+        assert len(dl) == 1
+        assert not schema.validate_record(json.loads(json.dumps(dl[0])))
+
+    def test_no_deadline_when_budget_sufficient(self):
+        build, dargs, px, rv, w0 = self._problem()
+        cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=8)
+        res = run_agd_supervised(
+            prox=px, reg_value=rv, w0=w0, config=cfg,
+            policy=ResiliencePolicy(
+                max_attempts=3, backoff_base=0.0, jitter=0.0, seed=0,
+                segment_iters=4, max_wall_seconds=3600.0),
+            staged=(build, dargs))
+        assert res.num_iters == 8
+
+    def test_policy_validates_budget(self):
+        with pytest.raises(ValueError, match="max_wall_seconds"):
+            ResiliencePolicy(max_wall_seconds=0.0)
+
+
+class TestIngestValidation:
+    """Satellite: typed rejection of non-finite/out-of-range data."""
+
+    def _parts(self, tmp_path):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((6, 4)).astype(np.float32)
+        y = np.where(rng.random(6) < 0.5, 1.0, -1.0)
+        good = str(tmp_path / "good.libsvm")
+        libsvm.save_libsvm(good, X, y)
+        bad = str(tmp_path / "bad.libsvm")
+        with open(good) as f:
+            lines = f.read().splitlines()
+        lines[1] = "1 2:nan 3:0.5"        # non-finite feature
+        lines[3] = "nan 1:0.25"           # non-finite label
+        lines[4] = "-1 9:1.5"             # index 9 > n_features=4
+        with open(bad, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return good, bad
+
+    def test_load_libsvm_validate_raises(self, tmp_path):
+        good, bad = self._parts(tmp_path)
+        libsvm.load_libsvm(good, n_features=4, validate=True)  # clean
+        with pytest.raises(libsvm.DataValidationError) as ei:
+            libsvm.load_libsvm(bad, n_features=4, validate=True)
+        msg = str(ei.value)
+        assert "non-finite" in msg
+        assert classify_failure(ei.value) == errors.FATAL
+
+    def test_default_is_permissive(self, tmp_path):
+        _, bad = self._parts(tmp_path)
+        data = libsvm.load_libsvm(bad, n_features=9)
+        assert data.n_rows == 6  # historical behavior: reads garbage
+
+    def test_ingest_raise_mode(self, tmp_path, cpu_devices):
+        good, bad = self._parts(tmp_path)
+        with pytest.raises(libsvm.DataValidationError):
+            ingest.from_partitioned_files([good, bad], n_features=4,
+                                          validate="raise")
+
+    def test_ingest_drop_mode_counts(self, tmp_path, cpu_devices):
+        good, bad = self._parts(tmp_path)
+        tel = Telemetry()
+        batch = ingest.from_partitioned_files(
+            [good, bad], n_features=4, validate="drop", telemetry=tel)
+        # 12 rows total, 3 invalid dropped
+        assert int(np.asarray(batch.mask).sum()) == 9
+        assert tel.registry.counter("data.invalid_records").value == 3
+        assert np.isfinite(np.asarray(batch.X)).all()
+        assert np.isfinite(np.asarray(batch.y)).all()
+
+    def test_ingest_csr_drop_mode(self, tmp_path, cpu_devices):
+        good, bad = self._parts(tmp_path)
+        tel = Telemetry()
+        batch = ingest.from_partitioned_files_csr(
+            [good, bad], n_features=4, validate="drop", telemetry=tel)
+        assert int(np.asarray(batch.mask).sum()) == 9
+        assert tel.registry.counter("data.invalid_records").value == 3
+
+    def test_ingest_rejects_unknown_mode(self, tmp_path, cpu_devices):
+        good, _ = self._parts(tmp_path)
+        with pytest.raises(ValueError, match="validate"):
+            ingest.from_partitioned_files([good], n_features=4,
+                                          validate="maybe")
+
+    def test_drop_rows_repacks_csr(self):
+        data = libsvm.CSRData(
+            labels=np.array([1.0, np.nan, 0.0]),
+            indptr=np.array([0, 2, 3, 5]),
+            indices=np.array([0, 2, 1, 0, 3], np.int32),
+            values=np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32),
+            n_features=4)
+        mask = libsvm.invalid_row_mask(data)
+        np.testing.assert_array_equal(mask, [False, True, False])
+        out = libsvm.drop_rows(data, mask)
+        assert out.n_rows == 2
+        np.testing.assert_array_equal(out.indptr, [0, 2, 4])
+        np.testing.assert_array_equal(out.values, [1, 2, 4, 5])
+
+
+class TestEntryChecksums:
+    """Satellite: per-entry CRC32 inside every npz — silent bit-flips
+    raise CheckpointCorruptError, not just unparseable zips."""
+
+    def test_roundtrip_carries_and_verifies_crcs(self, tmp_path):
+        warm, w0 = _warm(prior_iters=2)
+        path = str(tmp_path / "c.npz")
+        ckpt.save_checkpoint(path, warm, [0.5, 0.4], fingerprint="fp")
+        with np.load(path) as data:
+            assert ckpt.CRC_ENTRY in data.files
+        entries = ckpt.read_npz_entries(path)
+        assert ckpt.CRC_ENTRY not in entries  # stripped after verify
+        loaded = ckpt.load_checkpoint(path, w0)
+        assert int(loaded.warm.prior_iters) == 2
+
+    def test_silent_bit_flip_detected(self, tmp_path):
+        """Rewrite the npz with one entry's VALUES changed but the OLD
+        crc map kept — a zip-consistent archive whose payload lies
+        (what a bad sector or a buggy rewriting tool produces)."""
+        warm, w0 = _warm(prior_iters=2)
+        path = str(tmp_path / "c.npz")
+        ckpt.save_checkpoint(path, warm, [0.5, 0.4])
+        with np.load(path) as data:
+            entries = {k: np.asarray(data[k]) for k in data.files}
+        entries["big_l"] = np.asarray(12345.0)  # flipped payload
+        with open(path, "wb") as f:
+            np.savez(f, **entries)  # old __crc32__ map rides along
+        with pytest.raises(ckpt.CheckpointCorruptError,
+                           match="CRC32"):
+            ckpt.read_npz_entries(path)
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_checkpoint(path, w0, fallback_to_bak=False)
+
+    def test_bit_flip_falls_back_to_bak(self, tmp_path):
+        warm, w0 = _warm(prior_iters=2)
+        path = str(tmp_path / "c.npz")
+        ckpt.save_checkpoint(path + ".bak", warm, [0.5])
+        ckpt.save_checkpoint(path, warm._replace(prior_iters=7),
+                             [0.5, 0.4])
+        with np.load(path) as data:
+            entries = {k: np.asarray(data[k]) for k in data.files}
+        entries["theta"] = np.asarray(-1.0)
+        with open(path, "wb") as f:
+            np.savez(f, **entries)
+        loaded = ckpt.load_checkpoint(path, w0)  # falls back
+        assert int(loaded.warm.prior_iters) == 2
+
+    def test_legacy_file_without_crcs_loads(self, tmp_path):
+        warm, w0 = _warm(prior_iters=3)
+        path = str(tmp_path / "legacy.npz")
+        payload = ckpt.warm_payload(warm, [0.5])
+        with open(path, "wb") as f:
+            np.savez(f, **payload)  # no __crc32__ entry
+        loaded = ckpt.load_checkpoint(path, w0)
+        assert int(loaded.warm.prior_iters) == 3
+
+
+@pytest.mark.dist_fault
+class TestDistFaultDrill:
+    """The 2-process SIGKILL + elastic-resume drill as a gate: real
+    separate interpreters, real gloo collectives, real host death."""
+
+    def test_drill_passes(self, tmp_path):
+        tool = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "dist_fault_drill.py")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(tool))] +
+            env.get("PYTHONPATH", "").split(os.pathsep))
+        proc = subprocess.run(
+            [sys.executable, tool, "--out", str(tmp_path / "drill")],
+            capture_output=True, text=True, timeout=420, env=env)
+        assert proc.returncode == 0, \
+            f"drill failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}"
+        assert "DIST FAULT DRILL PASSED" in proc.stdout

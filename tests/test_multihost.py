@@ -127,6 +127,7 @@ class TestTwoProcess:
             assert "SPARSE_INGEST_OK" in out, out
             assert "GRID_OK" in out, out
             assert "LBFGS_OK" in out, out
+            assert "DISTCKPT_OK" in out, out
         assert "pid=0" in outs[0][1] and "pid=1" in outs[1][1]
 
 

@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""The DISTRIBUTED kill-and-resume drill — CI proof that the multi-host
+resilience layer (``resilience.distributed``) actually recovers.
+
+Three phases, all on CPU (gloo collectives), all in one command:
+
+1. **baseline** — an uninterrupted 2-process supervised AGD fit over
+   partitioned-file ingest (each host reads its own partitions, one
+   global mesh, real cross-process psums).  Records the final loss.
+2. **killed run** — the same fit with a :class:`DistributedCheckpointer`
+   (barrier-committed generations every segment) and heartbeats; one
+   process delivers itself **SIGKILL** at ``--kill-at`` — uncatchable,
+   no flush: a genuinely dead host.  The parent detects the death from
+   heartbeat staleness (:class:`HostMonitor` → ``HostLost``, emitted as
+   a ``host_lost`` recovery record) and reaps the blocked survivor.
+3. **elastic resume** — the parent then byte-TRUNCATES the newest
+   committed generation's shard (a torn write) and resumes the run as
+   ONE process: the loader must refuse the torn generation
+   (``checkpoint_fallback``), fall back one generation, re-assemble the
+   dead hosts' data-partition assignment (``elastic_resume``), and run
+   to completion.
+
+PASS (exit 0) requires: the killed process died by SIGKILL; the host
+loss was detected from heartbeats; at least two generations were
+committed by the barrier; the torn generation was refused and the run
+resumed from a non-zero iteration; the resumed 1-process final loss
+matches the uninterrupted 2-process baseline within ``--tol`` (default
+1e-6 — the drill runs in float64, so topology-induced reduction-order
+noise is ~1e-12); and EVERY record in every drill JSONL (per-host and
+parent) validates against the canonical ``obs.schema``, with
+``heartbeat``, failed/ok ``attempt``, and the expected ``recovery``
+actions all present.  Any miss prints the reason and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/dist_fault_drill.py [-v] [--out DIR]
+
+Internally re-invokes itself with ``--child`` for the two SPMD
+processes (same init sequence as ``tests/multihost_child.py``).
+See ``docs/ROBUSTNESS.md`` §distributed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_FEATURES = 6
+REG = 0.1
+
+
+def _configure_jax(n_devices: int = 1, gloo: bool = True):
+    """Platform + precision config, BEFORE any backend use (same
+    ordering contract as tests/multihost_child.py).  ``gloo`` only in
+    the SPMD children — the parent's 1-process resume has no
+    distributed client for the transport to attach to."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}")
+    if gloo:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — newer jax: default works
+            pass
+    return jax
+
+
+def _problem(args, mesh):
+    """The staged smooth/prox over partitioned-file ingest — shared by
+    both child phases (2-process mesh) and the parent's 1-process
+    resume (mesh over the local devices)."""
+    import numpy as np
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.data import ingest
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.parallel import dist_smooth
+
+    paths = sorted(glob.glob(os.path.join(args.workdir, "parts",
+                                          "part-*.libsvm")))
+    assert len(paths) >= 2, paths
+    batch = ingest.from_partitioned_files(
+        paths, mesh, n_features=N_FEATURES, dtype=np.float64,
+        validate="raise")
+    build, dargs = dist_smooth.make_dist_smooth_staged(
+        LogisticGradient(), batch, mesh=mesh)
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    w0 = np.zeros(N_FEATURES, np.float64)
+    cfg = agd.AGDConfig(convergence_tol=0.0,
+                        num_iterations=args.iters)
+    return paths, (build, dargs), px, rv, w0, cfg
+
+
+def child_main(args) -> int:
+    """One SPMD process of phase ``baseline`` or ``killed``."""
+    jax = _configure_jax(1)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_agd_tpu.obs import JSONLSink, Telemetry
+    from spark_agd_tpu.parallel import mesh as mesh_lib, multihost as mh
+    from spark_agd_tpu.resilience import (DistributedCheckpointer,
+                                          FaultScript, HeartbeatWriter,
+                                          ResiliencePolicy,
+                                          run_agd_supervised)
+    from spark_agd_tpu.data import ingest
+    from spark_agd_tpu.utils import checkpoint as ckpt
+
+    mh.initialize(args.addr, args.nproc, args.pid)
+    assert jax.process_count() == args.nproc
+    mesh = mesh_lib.make_mesh({"data": len(jax.devices())})
+
+    paths, staged, px, rv, w0, cfg = _problem(args, mesh)
+    policy = ResiliencePolicy(
+        max_attempts=2, backoff_base=0.01, backoff_max=0.05, jitter=0.0,
+        seed=0, segment_iters=args.segment)
+    jsonl = mh.host_suffixed(os.path.join(
+        args.workdir, f"drill-{args.phase}.jsonl"))
+    tel = Telemetry([JSONLSink(jsonl)])
+    hb = HeartbeatWriter(os.path.join(args.workdir, "hb", args.phase),
+                         telemetry=tel)
+
+    def place_w(w):
+        return mesh_lib.replicate(
+            jax.tree_util.tree_map(jnp.asarray, w), mesh)
+
+    kwargs = dict(prox=px, reg_value=rv, w0=w0, config=cfg,
+                  policy=policy, staged=staged, telemetry=tel,
+                  heartbeat=hb, place_w=place_w)
+    if args.phase == "killed":
+        fp = ckpt.problem_fingerprint(w0, cfg)
+        kwargs["checkpointer"] = DistributedCheckpointer(
+            os.path.join(args.workdir, "ckpt"),
+            every_iters=args.segment, keep=4, fingerprint=fp,
+            telemetry=tel, mesh_shape=dict(mesh.shape),
+            partitions=ingest.local_partitions(paths))
+        if args.pid == args.kill_pid:
+            kwargs["faults"] = FaultScript(sigkill_at_iter=args.kill_at)
+
+    res = run_agd_supervised(**kwargs)
+    tel.flush()
+    if args.phase == "baseline" and args.pid == 0:
+        with open(os.path.join(args.workdir, "baseline.json"), "w") as f:
+            json.dump({"final_loss": float(res.loss_history[-1]),
+                       "num_iters": int(res.num_iters)}, f)
+    print(f"DRILL_CHILD_OK phase={args.phase} pid={args.pid} "
+          f"iters={res.num_iters} "
+          f"loss={float(res.loss_history[-1]):.12f}", flush=True)
+    return 0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_children(args, phase: str, port: int):
+    me = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(me))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return [
+        subprocess.Popen(
+            [sys.executable, me, "--child", "--phase", phase,
+             "--addr", f"localhost:{port}", "--nproc", "2",
+             "--pid", str(i), "--workdir", args.workdir,
+             "--iters", str(args.iters), "--segment", str(args.segment),
+             "--kill-at", str(args.kill_at),
+             "--kill-pid", str(args.kill_pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        for i in range(2)
+    ]
+
+
+def parent_main(args) -> int:
+    import tempfile
+
+    failures: list = []
+
+    def check(ok: bool, what: str):
+        tag = "ok" if ok else "FAIL"
+        if not ok:
+            failures.append(what)
+        if args.verbose or not ok:
+            print(f"{tag}: {what}")
+
+    args.workdir = args.out or tempfile.mkdtemp(prefix="dist_drill_")
+    os.makedirs(os.path.join(args.workdir, "parts"), exist_ok=True)
+    for stale in glob.glob(os.path.join(args.workdir, "*.json*")) \
+            + glob.glob(os.path.join(args.workdir, "ckpt", "*")) \
+            + glob.glob(os.path.join(args.workdir, "hb", "*", "*")):
+        os.unlink(stale)
+
+    # partition files: 4 equal parts (no inter-host padding, so the
+    # 2-process and 1-process assemblies hold the same logical rows)
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    from spark_agd_tpu.data import libsvm  # jax-free import
+
+    n_per, d = 25, N_FEATURES
+    w_true = np.linspace(-1.0, 1.0, d)
+    for k in range(4):
+        X = rng.standard_normal((n_per, d)).astype(np.float32)
+        y = np.where(X @ w_true + 0.3 * rng.standard_normal(n_per) > 0,
+                     1.0, -1.0)
+        libsvm.save_libsvm(
+            os.path.join(args.workdir, "parts", f"part-{k}.libsvm"),
+            X, y)
+
+    # -- phase 1: uninterrupted 2-process baseline ------------------------
+    procs = _spawn_children(args, "baseline", _free_port())
+    outs = _reap(procs, timeout=420)
+    for i, (rc, out, err) in enumerate(outs):
+        check(rc == 0 and "DRILL_CHILD_OK" in out,
+              f"baseline child {i} completed (rc={rc})"
+              + ("" if rc == 0 else f"\n{err[-2000:]}"))
+    base_path = os.path.join(args.workdir, "baseline.json")
+    if not os.path.exists(base_path):
+        check(False, "baseline.json written by process 0")
+        return _verdict(failures, args)
+    with open(base_path) as f:
+        base_loss = float(json.load(f)["final_loss"])
+    if args.verbose:
+        print(f"baseline (2 processes): final loss {base_loss:.12f}")
+
+    # -- phase 2: the killed run ------------------------------------------
+    procs = _spawn_children(args, "killed", _free_port())
+    killed_rc = procs[args.kill_pid].wait(timeout=420)
+    check(killed_rc == -signal.SIGKILL,
+          f"process {args.kill_pid} died by SIGKILL at iteration "
+          f"{args.kill_at} (rc={killed_rc})")
+
+    # host-loss detection: the dead host's heartbeat file goes stale
+    from spark_agd_tpu.obs import JSONLSink, Telemetry, schema
+    from spark_agd_tpu.resilience import HostLost, HostMonitor
+
+    parent_jsonl = os.path.join(args.workdir, "drill-parent.jsonl")
+    tel = Telemetry([JSONLSink(parent_jsonl)])
+    monitor = HostMonitor(
+        os.path.join(args.workdir, "hb", "killed"),
+        expected=[args.kill_pid], stale_after_s=2.0, telemetry=tel)
+    lost = None
+    deadline = time.monotonic() + 60
+    while lost is None and time.monotonic() < deadline:
+        try:
+            monitor.check()
+            time.sleep(0.25)
+        except HostLost as e:
+            lost = e
+    check(lost is not None and lost.process_index == args.kill_pid,
+          f"heartbeat monitor detected the lost host ({lost})")
+
+    # reap the survivor (blocked in a collective against a dead peer —
+    # on real capacity the relaunch replaces the whole job the same way)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait(timeout=60)
+
+    # -- phase 3: torn write, then elastic 1-process resume ---------------
+    from spark_agd_tpu.resilience import (DistributedCheckpointer,
+                                          ResiliencePolicy, faults,
+                                          manifest, run_agd_supervised)
+
+    ckpt_dir = os.path.join(args.workdir, "ckpt")
+    gens = manifest.committed_generations(ckpt_dir)
+    check(len(gens) >= 2,
+          f"the barrier committed >= 2 generations before the kill "
+          f"(found {gens})")
+    if not gens:
+        return _verdict(failures, args)
+    newest = manifest.load_manifest(ckpt_dir, gens[0])
+    shard0 = newest.shard_path(ckpt_dir, 0)
+    faults.truncate_file(shard0, keep_fraction=0.4)
+    if args.verbose:
+        print(f"truncated {os.path.basename(shard0)} (generation "
+              f"{newest.generation}, saved at iter {newest.prior_iters})")
+
+    jax = _configure_jax(1, gloo=False)
+    from spark_agd_tpu.parallel import mesh as mesh_lib
+    from spark_agd_tpu.utils import checkpoint as ckpt_lib
+
+    mesh = mesh_lib.make_mesh({"data": len(jax.devices())})
+    paths, staged, px, rv, w0, cfg = _problem(args, mesh)
+    fp = ckpt_lib.problem_fingerprint(w0, cfg)
+    ck = DistributedCheckpointer(
+        ckpt_dir, every_iters=args.segment, keep=4, fingerprint=fp,
+        telemetry=tel, mesh_shape=dict(mesh.shape),
+        process_index=0, process_count=1)
+    policy = ResiliencePolicy(
+        max_attempts=2, backoff_base=0.01, backoff_max=0.05, jitter=0.0,
+        seed=0, segment_iters=args.segment)
+    res = run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                             policy=policy, staged=staged,
+                             telemetry=tel, checkpointer=ck)
+    tel.flush()
+    check(res.resumed_from > 0,
+          f"elastic resume continued from iteration {res.resumed_from} "
+          "(the surviving generation), not from scratch")
+    final_loss = float(res.loss_history[-1])
+    diff = abs(final_loss - base_loss)
+    check(diff <= args.tol,
+          f"resumed 1-process final loss {final_loss:.12f} matches the "
+          f"2-process baseline {base_loss:.12f} "
+          f"(|diff| = {diff:.2e} <= {args.tol:g})")
+
+    # -- the JSONL evidence, across every host's stream -------------------
+    jsonls = sorted(glob.glob(os.path.join(args.workdir, "drill-*.jsonl*")))
+    records = []
+    for path in jsonls:
+        records.extend(schema.read_jsonl(path))
+    invalid = [(i, errs) for i, rec in enumerate(records, 1)
+               if (errs := schema.validate_record(
+                   json.loads(json.dumps(rec, default=str))))]
+    check(not invalid,
+          f"all {len(records)} records across {len(jsonls)} streams are "
+          "schema-valid"
+          + (f" (first bad: {invalid[0]})" if invalid else ""))
+    kinds = {r.get("kind") for r in records}
+    check("heartbeat" in kinds, "heartbeat records present")
+    actions = {}
+    for rec in records:
+        if rec.get("kind") == "recovery":
+            actions[rec["action"]] = actions.get(rec["action"], 0) + 1
+    for action in ("checkpoint", "checkpoint_fallback", "elastic_resume",
+                   "host_lost"):
+        check(actions.get(action, 0) >= 1,
+              f"recovery action {action!r} recorded "
+              f"(x{actions.get(action, 0)})")
+    outcomes = {r.get("outcome") for r in records
+                if r.get("kind") == "attempt"}
+    check("ok" in outcomes, f"successful attempts recorded ({outcomes})")
+
+    print(f"drill artifacts under {args.workdir} "
+          f"({len(records)} records in {len(jsonls)} streams)")
+    return _verdict(failures, args, diff=diff)
+
+
+def _reap(procs, timeout):
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def _verdict(failures, args, diff=None) -> int:
+    if failures:
+        print(f"DIST FAULT DRILL FAILED ({len(failures)} checks):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("DIST FAULT DRILL PASSED: SIGKILLed host detected via "
+          "heartbeats, torn generation refused, elastic 1-process "
+          "resume reached the 2-process baseline"
+          + (f" (diff {diff:.2e})" if diff is not None else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/dist_fault_drill.py",
+        description="two-process SIGKILL + elastic-resume drill")
+    p.add_argument("--child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--phase", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--addr", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--nproc", type=int, default=2,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--pid", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--workdir", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--iters", type=int, default=28,
+                   help="iteration budget (default 28)")
+    p.add_argument("--segment", type=int, default=4,
+                   help="segment length = checkpoint cadence (default 4)")
+    p.add_argument("--kill-at", type=int, default=12,
+                   help="SIGKILL the victim at this iteration "
+                        "(default 12; >= 2 generations must have "
+                        "committed by then)")
+    p.add_argument("--kill-pid", type=int, default=1,
+                   help="which of the two processes dies (default 1; "
+                        "0 also works — every generation is already "
+                        "committed)")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="|resumed loss - baseline| bound (default 1e-6)")
+    p.add_argument("--out", default=None,
+                   help="directory for partitions/checkpoints/JSONLs "
+                        "(default: a fresh temp dir)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
