@@ -761,7 +761,9 @@ def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
     item 4): small shape, AOT phase markers, own budgets, failure
     isolated.  Fills ``pallas_iters_per_sec``/``pallas_probe_rows`` on
     success; on any failure the record names the phase
-    (``pallas_failure_phase`` ∈ stage/trace/compile/execute/run) and
+    (``pallas_failure_phase`` ∈ pre-stage/stage/trace/compile/execute/
+    run/post-run — post-run means every device phase completed and the
+    metrics assembly afterwards died) and
     carries the error — so after ONE healthy claim we know whether the
     mosaic lowering and the VMEM-budgeted block choice survive a real
     chip, and if not, exactly where they die."""
@@ -777,29 +779,39 @@ def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
     tag = f"pallas-probe-{rows}r"
     # _time_step_aot owns the AOT phase split and its budgets (shared
     # with the fused rungs — r5 review: no second copy of that timing);
-    # the probe only tracks which marker was last armed so a failure
-    # names its phase.
+    # the probe only tracks which marker is CURRENTLY armed so a
+    # failure names its phase.  done() clears the armed marker (r5
+    # advisor: without that, an exception AFTER a completed phase —
+    # e.g. in the metrics assembly below — was mislabeled as failing
+    # inside the phase that had already finished).
     last = [None]
+    any_done = [False]
 
     def _mark(s, b=None, **kv):
         last[0] = s
         return mark(s, b, **kv)
 
+    def _done(s, **kv):
+        if last[0] == s:
+            last[0] = None
+        any_done[0] = True
+        return done(s, **kv)
+
     try:
-        # _device_data also goes through _mark: its own data-NNNr marker
-        # must be the one the except arm closes if generation dies (r5
-        # review: a mismatched done() left a wedged-looking inflight
-        # entry in the probe file)
-        Xd, yd = _device_data(rows, data_cache, _mark, done)
+        # _device_data also goes through _mark/_done: its own data-NNNr
+        # marker must be the one the except arm closes if generation
+        # dies (r5 review: a mismatched done() left a wedged-looking
+        # inflight entry in the probe file)
+        Xd, yd = _device_data(rows, data_cache, _mark, _done)
         _mark(f"{tag}-stage", 240)
         w0 = jnp.zeros(N_FEATURES, jnp.float32)
         interpret = device.platform != "tpu"
         step = _make_step(
             PallasLogisticGradient(interpret=interpret), Xd, yd,
             NUM_ITERS_TPU)
-        done(f"{tag}-stage")
+        _done(f"{tag}-stage")
         res, run_s, compile_s, _, _ = _time_step_aot(
-            step, w0, tag, _mark, done)
+            step, w0, tag, _mark, _done)
         rec["pallas_compile_s"] = round(compile_s, 2)
         iters = int(res.num_iters)
         rec["pallas_iters_per_sec"] = round(iters / run_s, 2)
@@ -818,11 +830,17 @@ def pallas_probe(rec, rows, device, oracle_cache, data_cache, mark,
             f"drift={rec.get('pallas_drift_rel')}")
     except Exception as e:  # noqa: BLE001 — the probe must never kill
         # the banked record it annotates
-        if last[0] is not None:
-            done(last[0])
-        phase = "pre-stage" if last[0] is None else (
-            last[0][len(tag) + 1:] if last[0].startswith(tag)
-            else last[0])
+        inflight = last[0]
+        if inflight is not None:
+            done(inflight)
+        if inflight is None:
+            # nothing armed: either the probe died before its first
+            # marker, or every armed phase had completed — the failure
+            # sits in the post-run bookkeeping, not in a device phase
+            phase = "post-run" if any_done[0] else "pre-stage"
+        else:
+            phase = (inflight[len(tag) + 1:] if inflight.startswith(tag)
+                     else inflight)
         rec["pallas_failure_phase"] = phase
         rec["pallas_probe_error"] = f"{type(e).__name__}: {e}"[:250]
         log(f"pallas probe died in {phase}: {rec['pallas_probe_error']}")

@@ -144,14 +144,17 @@ def gd_iters_to_match(config: BenchConfig, data, w0, target_loss: float,
             data, config.gradient(), config.updater(),
             step_size=config.gd_step_size, num_iterations=cur,
             reg_param=config.reg_param, initial_weights=w0)
-        # gd.py history semantics: hist[k] is the loss at the PRE-update
-        # weights of iteration k+1, i.e. the loss achieved after k
-        # updates — so the first index meeting the target IS the update
-        # count (0 if w0 already meets it).
+        # Index convention (file-wide, r5 advisor): history index k
+        # maps to ITERATION COUNT k+1 — the same +1 offset wall_to_eps
+        # and lbfgs_iters_to_match_agd apply.  gd.py's hist[k] is the
+        # loss at the pre-update weights of MLlib's 1-based iteration
+        # k+1, so the iteration at which the oracle's own published
+        # lossHistory first reports the target is hits[0] + 1 (1 when
+        # w0 already meets it — MLlib never reports an iteration 0).
         hits = np.nonzero(np.asarray(hist)
                           <= target_loss * (1 + 1e-6))[0]
         if len(hits):
-            return int(hits[0]), True, np.asarray(hist)
+            return int(hits[0]) + 1, True, np.asarray(hist)
         if cur >= cap_max:
             return cur, False, np.asarray(hist)
         cur = min(cap_max, cur * 4)
@@ -163,12 +166,15 @@ def gd_hits_target(gd_hist: np.ndarray, target_loss: float, bound: int):
     """Resolve an EASIER (or equal) companion target against an
     escalation's final history instead of re-running the oracle from
     scratch (r5 review: the ref-budget ratio was doubling the most
-    expensive sub-benchmark).  Same index semantics as
-    :func:`gd_iters_to_match`; ``bound`` is the lower-bound iteration
-    count to report when the history never meets the target."""
+    expensive sub-benchmark).  Same index convention as
+    :func:`gd_iters_to_match` — history index k ↦ iteration count
+    k+1 (the r5 advisor caught this returning the bare index, one
+    iteration short of the file's own convention); ``bound`` is the
+    lower-bound iteration count to report when the history never meets
+    the target."""
     hits = np.nonzero(gd_hist <= target_loss * (1 + 1e-6))[0]
     if len(hits):
-        return int(hits[0]), True
+        return int(hits[0]) + 1, True
     return bound, False
 
 
@@ -469,6 +475,10 @@ def run_config(config: BenchConfig, scale: float, iters: int,
         "wall_to_eps_capped": (None if converged
                                else (round(w2e, 4) if w2e is not None
                                      else None)),
+        # BOTH ratios count GD iterations 1-based: history index k ↦
+        # iteration k+1 (gd_iters_to_match / gd_hits_target), the same
+        # convention wall_to_eps and lbfgs_iters_to_match_agd use —
+        # r5 advisor caught the bare-index off-by-one here
         "agd_vs_gd_iters": None if ratio is None else round(ratio, 1),
         "agd_vs_gd_is_lower_bound": ratio_is_lb,
         # the suite-framing companion ratio + the oracle's published

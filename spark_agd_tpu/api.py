@@ -20,6 +20,7 @@ reference's executor parallelism with the driver round-trips deleted; pass
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, NamedTuple, Tuple, Union
 
 import jax
@@ -313,6 +314,7 @@ def run(
     verbose: bool = False,
     resilience=None,
     checkpointer=None,
+    journal=None,
 ):
     """Functional entry point, signature-parity with reference ``run``
     (``:177-189``).  Returns ``(weights, loss_history)`` where
@@ -345,7 +347,18 @@ def run(
     shards exchanged through one allgather, elastic resume onto a
     changed process count — see ``docs/ROBUSTNESS.md`` §distributed).
     ``return_result=True`` then returns the ``SupervisedResult`` as the
-    third element.  See ``docs/ROBUSTNESS.md``."""
+    third element.  See ``docs/ROBUSTNESS.md``.
+
+    ``journal`` (supervised path only; a path or an open
+    ``resilience.Journal``): every recovery DECISION of the run
+    (``attempt``/``recovery``/``chaos``/``degraded`` records) is also
+    appended to the crash-safe recovery journal — an append-only,
+    CRC-per-record WAL that tolerates a torn tail and replays
+    bit-identically for post-mortems and exactly-once segment
+    accounting (``resilience.journal``, docs/ROBUSTNESS.md
+    §recovery-journal).  A path is opened (replaying + repairing any
+    torn tail from a previous crash of the same run) and closed by this
+    call; an open ``Journal`` is shared and left open."""
     if initial_weights is None:
         raise ValueError("initial_weights is required")
     if resilience is not None:
@@ -353,10 +366,10 @@ def run(
             data, gradient, updater, convergence_tol, num_iterations,
             reg_param, initial_weights, l0, l_exact, beta, alpha,
             may_restart, mesh, dist_mode, loss_mode, return_result,
-            telemetry, verbose, resilience, checkpointer)
-    if checkpointer is not None:
+            telemetry, verbose, resilience, checkpointer, journal)
+    if checkpointer is not None or journal is not None:
         raise ValueError(
-            "checkpointer= requires the supervised path; pass "
+            "checkpointer=/journal= require the supervised path; pass "
             "resilience=True (or a ResiliencePolicy) as well")
     fit = make_runner(
         data, gradient, updater, convergence_tol=convergence_tol,
@@ -389,7 +402,7 @@ def _run_supervised(data, gradient, updater, convergence_tol,
                     num_iterations, reg_param, initial_weights, l0,
                     l_exact, beta, alpha, may_restart, mesh, dist_mode,
                     loss_mode, return_result, telemetry, verbose,
-                    resilience, checkpointer):
+                    resilience, checkpointer, journal=None):
     """The ``resilience=`` branch of :func:`run`: the same data staging
     and mesh resolution as :func:`make_runner`, driven by
     ``resilience.supervisor.run_agd_supervised`` (segmented fused
@@ -410,10 +423,43 @@ def _run_supervised(data, gradient, updater, convergence_tol,
         w0 = jax.tree_util.tree_map(jnp.asarray, w)
         return w0 if m is None else mesh_lib.replicate(w0, m)
 
-    sres = supervisor_lib.run_agd_supervised(
-        prox=px, reg_value=rv, w0=initial_weights, config=cfg,
-        policy=policy, telemetry=telemetry, checkpointer=checkpointer,
-        staged=(build, dargs), place_w=_place_w)
+    # journal= wiring: a JournalSink rides the run's event bus for the
+    # duration of this call.  A bare journal (telemetry=None) gets a
+    # decision-records-only Telemetry with the in-loop iteration stream
+    # OFF — the compiled program stays identical to the plain path.
+    jrnl = sink = None
+    own_journal = False
+    stream_iterations = telemetry is not None
+    if journal is not None:
+        from .obs import Telemetry
+        from .resilience import journal as journal_lib
+
+        if isinstance(journal, journal_lib.Journal):
+            jrnl = journal
+        else:
+            jrnl = journal_lib.Journal(os.fspath(journal))
+            own_journal = True
+        sink = journal_lib.JournalSink(jrnl)
+        if telemetry is None:
+            telemetry = Telemetry([sink])
+        else:
+            telemetry.bus.sinks.append(sink)
+        telemetry.journal_replay(**jrnl.replay_summary)
+
+    try:
+        sres = supervisor_lib.run_agd_supervised(
+            prox=px, reg_value=rv, w0=initial_weights, config=cfg,
+            policy=policy, telemetry=telemetry,
+            checkpointer=checkpointer, staged=(build, dargs),
+            place_w=_place_w, stream_iterations=stream_iterations)
+    finally:
+        if sink is not None:
+            if sink in telemetry.bus.sinks:
+                telemetry.bus.sinks.remove(sink)
+            if own_journal:
+                jrnl.close()
+            else:
+                jrnl.flush()
     loss_history = np.asarray(sres.loss_history)
     if telemetry is not None:
         telemetry.run_summary(

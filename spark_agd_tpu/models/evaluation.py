@@ -202,25 +202,35 @@ def cv_validation_scores(cv, X, y, *, score_fn, predict_fn=None,
         base_mask = getattr(cv, "base_mask", None)
     base = (jnp.ones(y.shape[0], jnp.float32) if base_mask is None
             else jnp.asarray(base_mask, jnp.float32))
-    if predict_fn is None:
-        predict_fn = lambda w: sparse.matvec(X, w)  # noqa: E731
     W = cv.train_result.weights
     flat_w = jax.tree_util.tree_map(
         lambda a: a.reshape((F * R,) + a.shape[2:]), W)
     fold_lane = jnp.repeat(jnp.arange(F, dtype=jnp.int32), R)
 
-    def one(w, fold_k, da):
-        ya, basea, fids = da
-        val_mask = basea * (fids == fold_k)
-        return score_fn(predict_fn(w), ya, val_mask)
-
     # labels/masks/fold ids ride as jit arguments (lane-invariant), not
     # closure constants — constant-embedded data scales compile time
-    # with the dataset (core.smooth.make_smooth_staged).  predict_fn
-    # still closes over X by API contract; the default matvec dominates
-    # neither lowering nor compile for a one-pass scoring program.
+    # with the dataset (core.smooth.make_smooth_staged).  The default
+    # linear-margin path threads X through the same argument tuple (r5
+    # advisor: the old default closed over X, embedding the feature
+    # matrix as a program constant — the exact defect class the staged
+    # split removed everywhere else); a custom predict_fn still closes
+    # over whatever it needs by API contract.
+    if predict_fn is None:
+        def one(w, fold_k, da):
+            Xa, ya, basea, fids = da
+            val_mask = basea * (fids == fold_k)
+            return score_fn(sparse.matvec(Xa, w), ya, val_mask)
+
+        dargs = (X, y, base, cv.fold_ids)
+    else:
+        def one(w, fold_k, da):
+            ya, basea, fids = da
+            val_mask = basea * (fids == fold_k)
+            return score_fn(predict_fn(w), ya, val_mask)
+
+        dargs = (y, base, cv.fold_ids)
     per_lane = jax.jit(jax.vmap(one, in_axes=(0, 0, None)))(
-        flat_w, fold_lane, (y, base, cv.fold_ids)).reshape(F, R)
+        flat_w, fold_lane, dargs).reshape(F, R)
     return per_lane, jnp.nanmean(per_lane, axis=0)
 
 

@@ -36,14 +36,15 @@ from typing import Dict, Iterable, List, Optional, Tuple
 SCHEMA_VERSION = 1
 
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
-         "numerics_failure", "attempt", "recovery", "heartbeat")
+         "numerics_failure", "attempt", "recovery", "heartbeat",
+         "chaos", "journal_replay", "degraded")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
 # canonical set for consumers
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
-                    "host_lost", "elastic_resume")
+                    "host_lost", "elastic_resume", "degraded_continue")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -72,6 +73,15 @@ _REQUIRED: Dict[str, dict] = {
     # HeartbeatWriter); ``process`` is the jax process index — the
     # host-loss monitor reads staleness from these
     "heartbeat": {"run_id": str, "process": int},
+    # one injected fault of a chaos campaign (resilience.chaos);
+    # ``fault`` is the kind (chaos.FAULT_KINDS — open set)
+    "chaos": {"run_id": str, "fault": str},
+    # one recovery-journal replay/repair (resilience.journal.Journal):
+    # ``records`` committed records recovered from the WAL
+    "journal_replay": {"run_id": str, "records": int},
+    # one quorum-gated degraded continuation (resilience.degrade):
+    # ``surviving`` processes keep training without their dead peers
+    "degraded": {"run_id": str, "surviving": int},
 }
 
 _OPTIONAL: Dict[str, dict] = {
@@ -124,6 +134,23 @@ _OPTIONAL: Dict[str, dict] = {
     "heartbeat": {
         "process_count": int, "iter": int, "phase": str, "pid": int,
         "algorithm": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "chaos": {
+        "at_iter": int, "fired_iter": int,
+        "process": (int, type(None)), "seed": int,
+        "campaign": (int, str), "payload": _NUM, "outcome": str,
+        "algorithm": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "journal_replay": {
+        "path": str, "torn_bytes": int, "last_seq": int,
+        "repaired": bool, "reason": (str, type(None)),
+        "tool": str, "timestamp_unix": _NUM,
+    },
+    "degraded": {
+        "saved_process_count": int, "lost": list, "quorum": _NUM,
+        "min_quorum": _NUM, "generation": int, "to_iter": int,
+        "process": int, "dropped_partitions": int, "source": str,
+        "tool": str, "timestamp_unix": _NUM,
     },
 }
 
@@ -272,6 +299,32 @@ def heartbeat_record(run_id: str, process: int, **fields) -> dict:
             "run_id": run_id, "process": int(process), **fields}
 
 
+def chaos_record(run_id: str, fault: str, **fields) -> dict:
+    """One injected fault of a chaos campaign (``resilience.chaos``) —
+    ``fault`` names the kind, ``at_iter``/``fired_iter`` locate the
+    scripted vs actual firing boundary, ``seed`` ties the record to its
+    deterministic campaign."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "chaos",
+            "run_id": run_id, "fault": str(fault), **fields}
+
+
+def journal_replay_record(run_id: str, records: int, **fields) -> dict:
+    """One recovery-journal replay (``resilience.journal``): how many
+    committed records were recovered, ``torn_bytes`` dropped from the
+    tail, and whether the file was repaired in place."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "journal_replay",
+            "run_id": run_id, "records": int(records), **fields}
+
+
+def degraded_record(run_id: str, surviving: int, **fields) -> dict:
+    """One quorum-gated degraded continuation (``resilience.degrade``):
+    ``surviving`` of ``saved_process_count`` processes keep training on
+    the surviving data partitions (``dropped_partitions`` lost with the
+    dead hosts)."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "degraded",
+            "run_id": run_id, "surviving": int(surviving), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -351,6 +404,27 @@ EXAMPLE_HEARTBEAT_RECORD = {
     "timestamp_unix": 1754000000.0,
 }
 
+EXAMPLE_CHAOS_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "chaos",
+    "run_id": "r18c2d3e4-1a2b-0", "fault": "device_loss",
+    "at_iter": 8, "fired_iter": 8, "process": None, "seed": 17,
+}
+
+EXAMPLE_JOURNAL_REPLAY_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "journal_replay",
+    "run_id": "r18c2d3e4-1a2b-0", "records": 23,
+    "path": "run.journal", "torn_bytes": 11, "last_seq": 22,
+    "repaired": True, "reason": "torn payload at byte 2048",
+}
+
+EXAMPLE_DEGRADED_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "degraded",
+    "run_id": "r18c2d3e4-1a2b-0", "surviving": 1,
+    "saved_process_count": 2, "lost": [1], "quorum": 0.5,
+    "min_quorum": 0.5, "generation": 3, "to_iter": 12, "process": 0,
+    "dropped_partitions": 2, "source": "degrade",
+}
+
 
 def selfcheck() -> Tuple[bool, List[str]]:
     """Validate the example records, a JSON round-trip, and a negative
@@ -366,7 +440,10 @@ def selfcheck() -> Tuple[bool, List[str]]:
                        EXAMPLE_NUMERICS_FAILURE_RECORD),
                       ("attempt", EXAMPLE_ATTEMPT_RECORD),
                       ("recovery", EXAMPLE_RECOVERY_RECORD),
-                      ("heartbeat", EXAMPLE_HEARTBEAT_RECORD)):
+                      ("heartbeat", EXAMPLE_HEARTBEAT_RECORD),
+                      ("chaos", EXAMPLE_CHAOS_RECORD),
+                      ("journal_replay", EXAMPLE_JOURNAL_REPLAY_RECORD),
+                      ("degraded", EXAMPLE_DEGRADED_RECORD)):
         errs = validate_record(json.loads(json.dumps(rec)))
         if errs:
             ok = False
@@ -407,6 +484,24 @@ def selfcheck() -> Tuple[bool, List[str]]:
     else:
         ok = False
         msgs.append("FAIL: heartbeat record missing process passed "
+                    "validation")
+    bad_chaos = dict(EXAMPLE_CHAOS_RECORD)
+    del bad_chaos["fault"]
+    if validate_record(bad_chaos):
+        msgs.append("ok: negative control (chaos missing fault) "
+                    "rejected")
+    else:
+        ok = False
+        msgs.append("FAIL: chaos record missing fault passed "
+                    "validation")
+    bad_deg = dict(EXAMPLE_DEGRADED_RECORD)
+    del bad_deg["surviving"]
+    if validate_record(bad_deg):
+        msgs.append("ok: negative control (degraded missing surviving) "
+                    "rejected")
+    else:
+        ok = False
+        msgs.append("FAIL: degraded record missing surviving passed "
                     "validation")
     stamped = stamp({"value": 1.0}, tool="selfcheck")
     errs = validate_record(stamped)

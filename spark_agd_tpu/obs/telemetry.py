@@ -214,6 +214,36 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def chaos(self, *, fault: str, **fields) -> dict:
+        """Emit (and return) a ``chaos`` record — one injected fault of
+        a chaos campaign (``resilience.chaos``) — counted per kind
+        (``chaos.<fault>``) so the campaign census rides the metrics
+        snapshot."""
+        self.registry.counter(f"chaos.{fault}").inc()
+        rec = schema.chaos_record(self.run_id, fault, **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def journal_replay(self, *, records: int, **fields) -> dict:
+        """Emit (and return) a ``journal_replay`` record — one
+        recovery-journal replay/repair (``resilience.journal``) — and
+        count it (``resilience.journal_replays``)."""
+        self.registry.counter("resilience.journal_replays").inc()
+        rec = schema.journal_replay_record(self.run_id, records,
+                                           **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def degraded(self, *, surviving: int, **fields) -> dict:
+        """Emit (and return) a ``degraded`` record — one quorum-gated
+        degraded continuation (``resilience.degrade``) — and count it
+        (``resilience.degraded``), so a degraded tail is visible in
+        every run summary."""
+        self.registry.counter("resilience.degraded").inc()
+        rec = schema.degraded_record(self.run_id, surviving, **fields)
+        self.bus.emit(rec)
+        return rec
+
     def recovery(self, *, action: str, **fields) -> dict:
         """Emit (and return) a ``recovery`` record — one resilience
         action (retry / rollback / preemption_flush / checkpoint /

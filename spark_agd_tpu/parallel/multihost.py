@@ -203,6 +203,22 @@ def local_rows_slice(n_rows: int, process_index: int,
                  min((process_index + 1) * per, n_rows))
 
 
+def rank_among(members, process_index: int) -> int:
+    """This process's rank within an explicit member list (sorted
+    original process indices) — the re-indexing a DEGRADED continuation
+    needs: the survivors of a host loss keep their original indices for
+    shard lookup but act as ranks ``0..len(members)-1`` for the
+    re-split (``resilience.degrade``).  Pure function, same contract as
+    :func:`local_rows_slice`: computable for a topology that is not
+    this process's."""
+    members = sorted(int(p) for p in members)
+    try:
+        return members.index(int(process_index))
+    except ValueError:
+        raise ValueError(
+            f"process {process_index} is not among {members}") from None
+
+
 def process_local_rows(n_rows: int) -> slice:
     """The row range this host should load — the data-loading side of
     multi-host DP (each host feeds only its local shard; ``jax.make_array_

@@ -29,7 +29,20 @@ pytrees plus three scalars.  Four modules (guide:
   detection (``HeartbeatWriter``/``HostMonitor``), and elastic resume
   onto a changed topology (``load_for_topology``); drilled by
   ``tools/dist_fault_drill.py`` (SIGKILL one of two real processes,
-  resume on one).
+  resume on one);
+- ``chaos`` — seeded multi-fault campaigns (``ChaosSchedule``
+  generalizing ``FaultScript`` to fault SEQUENCES, ``ChaosCampaign.
+  generate(seed)`` for whole deterministic scenarios) and the campaign
+  executor behind ``tools/chaos_drill.py``'s randomized soak;
+- ``journal`` — the crash-safe recovery journal: an append-only,
+  CRC-per-record, torn-tail-tolerant WAL of every recovery decision
+  (attach ``JournalSink`` to the run's telemetry), replayable for
+  post-mortems and exactly-once segment accounting across resumes;
+- ``degrade`` — quorum-based graceful degradation: on a lost peer,
+  ``DegradePolicy`` decides whether the survivors may keep training on
+  the surviving data partitions (``load_degraded`` /
+  ``DegradedCheckpointer``; below quorum → typed ``QuorumLost``)
+  instead of a mandatory full restart.
 
 Every retry, rollback, preemption flush, and checkpoint fallback lands
 as an ``attempt`` / ``recovery`` record in the canonical ``obs.schema``
@@ -48,6 +61,7 @@ from .errors import (  # noqa: F401
     HostLost,
     NumericsFailureError,
     Preempted,
+    QuorumLost,
     SimulatedDeviceLoss,
     SupervisorGivingUp,
     classify_failure,
@@ -74,4 +88,19 @@ from .distributed import (  # noqa: F401
     HostMonitor,
     LoadedDistCheckpoint,
     load_for_topology,
+)
+from . import chaos  # noqa: F401
+from .chaos import (  # noqa: F401
+    ChaosCampaign,
+    ChaosSchedule,
+    ScheduledFault,
+    run_campaign,
+)
+from . import journal  # noqa: F401
+from .journal import Journal, JournalSink  # noqa: F401
+from . import degrade  # noqa: F401
+from .degrade import (  # noqa: F401
+    DegradePolicy,
+    DegradedCheckpointer,
+    load_degraded,
 )

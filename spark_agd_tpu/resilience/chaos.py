@@ -1,0 +1,363 @@
+"""Chaos campaigns: seeded, composable MULTI-fault schedules and the
+campaign executor that proves recovery against fault *sequences*.
+
+``resilience.faults.FaultScript`` arms one fault per kind and fires it
+once — enough to prove each recovery path in isolation, nothing like a
+real pod's day: a straggler, then a preemption, then a torn write, all
+against one run.  This module generalizes the harness:
+
+- :class:`ScheduledFault` — one scripted fault: a kind, the iteration
+  it arms at, the process it targets (``None`` = every process, which
+  is MANDATORY for numeric faults in SPMD runs: a poison on one host
+  would break collective lockstep), and a kind-specific payload.
+- :class:`ChaosSchedule` — an ordered SEQUENCE of one-shot faults
+  behind the exact supervisor interface ``FaultScript`` established
+  (``before_segment`` / ``take_poison`` / ``fired`` / ``exhausted``),
+  so it drops into ``run_agd_supervised(faults=...)`` unchanged.  Each
+  fired fault is also emitted as a ``chaos`` telemetry record (and so
+  lands in the recovery journal when a ``JournalSink`` is attached).
+- :class:`ChaosCampaign` — a whole scenario, fully deterministic from
+  one seed: the in-run faults per process plus the FILE faults
+  (checkpoint truncation/scrambling) the driver applies at relaunch
+  boundaries.  ``ChaosCampaign.generate(seed, ...)`` draws a
+  normalized random campaign (file faults are always paired with an
+  earlier preemption so a relaunch exists to apply them at; numeric
+  faults are capped so the run can still re-converge).
+- :func:`run_campaign` — the single-process campaign executor the soak
+  driver (``tools/chaos_drill.py``) and the tier-1 tests share: run
+  the supervised fit under the schedule, relaunch on preemption
+  (applying due file faults to the checkpoint chain first), and
+  classify the terminal outcome — ``converged`` (baseline-matching),
+  ``gave_up`` (typed ``SupervisorGivingUp``), or the failure modes the
+  drill treats as bugs (``mismatch``, ``stalled``).
+
+Fault kinds (:data:`FAULT_KINDS`):
+
+``nan``            poison the next segment (NUMERIC → rollback)
+``device_loss``    raise ``SimulatedDeviceLoss`` (TRANSIENT → retry)
+``slow_host``      sleep ``payload`` seconds at the boundary (a
+                   straggler; peers just wait at the collective)
+``sigterm``        self-deliver SIGTERM (preemption flush → relaunch)
+``sigkill``        self-deliver SIGKILL (dead host; two-process drills)
+``fatal``          raise :class:`InjectedFatalError` (FATAL → typed
+                   ``SupervisorGivingUp`` — the give-up leg)
+``truncate_ckpt``  byte-truncate the newest checkpoint (driver-applied
+                   at the next relaunch; ``.bak``/generation fallback)
+``scramble_ckpt``  overwrite checkpoint bytes in place (same seat)
+
+Everything is deterministic: iterations, targets, payloads, and the
+corruption bytes all derive from the campaign seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal as signal_lib
+import time
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import faults as faults_lib
+from .autockpt import AutoCheckpointer, generation_paths
+from .errors import Preempted, SimulatedDeviceLoss, SupervisorGivingUp
+
+IN_RUN_KINDS = ("nan", "device_loss", "slow_host", "sigterm", "sigkill",
+                "fatal")
+FILE_KINDS = ("truncate_ckpt", "scramble_ckpt")
+FAULT_KINDS = IN_RUN_KINDS + FILE_KINDS
+
+
+class InjectedFatalError(ValueError):
+    """A scripted configuration-class error (classified FATAL): the
+    chaos pool's give-up leg — the supervisor must answer with a typed
+    ``SupervisorGivingUp``, never a retry loop or a bare traceback."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduledFault:
+    """One scripted fault of a campaign — see the module docstring."""
+
+    kind: str
+    at_iter: int
+    process: Optional[int] = None  # None = every process
+    payload: float = 0.0           # slow_host: seconds; truncate_ckpt:
+    #                                keep fraction; scramble_ckpt: bytes
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"one of {FAULT_KINDS}")
+        if self.at_iter < 0:
+            raise ValueError("at_iter must be >= 0")
+
+
+class ChaosSchedule:
+    """A sequence of one-shot in-run faults behind the ``FaultScript``
+    supervisor interface.  Faults fire in ``at_iter`` order at the
+    first segment boundary at or past their iteration; one
+    interrupting fault fires per boundary visit (the supervisor comes
+    back after handling it, and the next due fault fires then).
+    ``telemetry`` (optional): one ``chaos`` record per fired fault —
+    flushed BEFORE a sigkill is delivered, so the kill itself is on
+    record in the journal."""
+
+    def __init__(self, faults: Sequence[ScheduledFault], *,
+                 telemetry=None, seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        for f in faults:
+            if f.kind in FILE_KINDS:
+                raise ValueError(
+                    f"{f.kind!r} is a FILE fault — applied by the "
+                    "campaign driver at relaunch boundaries, not by "
+                    "the in-run schedule (ChaosCampaign.file_faults)")
+        ordered = sorted(faults, key=lambda f: (f.at_iter,
+                                                FAULT_KINDS.index(f.kind)))
+        self._poison = [f for f in ordered if f.kind == "nan"]
+        self._pending = [f for f in ordered if f.kind != "nan"]
+        self._telemetry = telemetry
+        self._seed = seed
+        self._sleep = sleep
+        self.fired: List[Tuple[str, int]] = []  # (kind, boundary iter)
+
+    def _emit(self, fault: ScheduledFault, global_iter: int) -> None:
+        self.fired.append((fault.kind, global_iter))
+        if self._telemetry is not None:
+            fields = {"at_iter": int(fault.at_iter),
+                      "fired_iter": int(global_iter)}
+            if fault.process is not None:
+                fields["process"] = int(fault.process)
+            if fault.payload:
+                fields["payload"] = float(fault.payload)
+            if self._seed is not None:
+                fields["seed"] = int(self._seed)
+            self._telemetry.chaos(fault=fault.kind, **fields)
+
+    # -- the supervisor hooks (FaultScript interface) ---------------------
+    def before_segment(self, global_iter: int) -> None:
+        while self._pending and self._pending[0].at_iter <= global_iter:
+            f = self._pending.pop(0)
+            self._emit(f, global_iter)
+            if f.kind == "slow_host":
+                self._sleep(float(f.payload) or 0.25)
+                continue  # a straggler interrupts nothing
+            if f.kind == "sigkill":
+                if self._telemetry is not None:
+                    self._telemetry.flush()  # the kill must be on record
+                os.kill(os.getpid(), signal_lib.SIGKILL)
+            if f.kind == "sigterm":
+                signal_lib.raise_signal(signal_lib.SIGTERM)
+                time.sleep(0)  # let the Python-level handler run
+                return
+            if f.kind == "device_loss":
+                raise SimulatedDeviceLoss(
+                    f"injected device loss at iteration {global_iter}")
+            if f.kind == "fatal":
+                raise InjectedFatalError(
+                    f"injected fatal config error at iteration "
+                    f"{global_iter}")
+
+    def take_poison(self, global_iter: int) -> bool:
+        if self._poison and self._poison[0].at_iter <= global_iter:
+            f = self._poison.pop(0)
+            self._emit(f, global_iter)
+            return True
+        return False
+
+    @property
+    def exhausted(self) -> bool:
+        return not self._pending and not self._poison
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosCampaign:
+    """One whole chaos scenario — a seed, its fault set, and the run
+    shape it was drawn for.  Pure data: :meth:`schedule_for` builds the
+    per-process in-run schedule, :meth:`file_faults` lists the
+    driver-applied corruption faults."""
+
+    seed: int
+    faults: Tuple[ScheduledFault, ...]
+    iters: int
+    process_count: int = 1
+
+    @classmethod
+    def generate(cls, seed: int, *, iters: int = 48,
+                 process_count: int = 1, max_faults: int = 4,
+                 p_fatal: float = 0.15) -> "ChaosCampaign":
+        """Draw one normalized random campaign, deterministic in
+        ``seed``.  Normalization rules (so every campaign is a FAIR
+        drill, not a guaranteed wedge): faults arm in the first ~70% of
+        the budget (a late rollback must still have room to
+        re-converge); at most two ``nan`` faults; file faults only ride
+        along with an earlier ``sigterm`` (the relaunch they are
+        applied at); in multi-process campaigns numeric/transient
+        faults target every process (collective lockstep) while
+        kill-class faults pick one victim; with probability ``p_fatal``
+        the last fault becomes ``fatal`` — the typed give-up leg."""
+        rng = np.random.default_rng(int(seed))
+        pool = ["nan", "device_loss", "slow_host", "sigterm",
+                "truncate_ckpt", "scramble_ckpt"]
+        n = int(rng.integers(1, max(2, max_faults + 1)))
+        hi = max(3, int(iters * 0.7))
+        iters_at = sorted(rng.choice(
+            np.arange(2, hi), size=min(n, hi - 2), replace=False))
+        kinds = [str(pool[int(rng.integers(0, len(pool)))])
+                 for _ in iters_at]
+        # cap numeric faults at two (each costs a rollback's worth of
+        # re-convergence headroom)
+        while kinds.count("nan") > 2:
+            kinds[kinds.index("nan")] = "device_loss"
+        # file faults need a relaunch to be applied at: ensure a
+        # sigterm precedes the first one
+        file_idx = [i for i, k in enumerate(kinds) if k in FILE_KINDS]
+        if file_idx and "sigterm" not in kinds[:file_idx[0]]:
+            if file_idx[0] == 0:
+                kinds[0] = "sigterm"
+                file_idx = [i for i, k in enumerate(kinds)
+                            if k in FILE_KINDS]
+            else:
+                kinds[file_idx[0] - 1] = "sigterm"
+        if float(rng.random()) < p_fatal:
+            kinds[-1] = "fatal"
+        victim = int(rng.integers(0, process_count))
+        out = []
+        for k, at in zip(kinds, iters_at):
+            payload = 0.0
+            process: Optional[int] = None
+            if k == "slow_host":
+                payload = float(rng.uniform(0.02, 0.08))
+                if process_count > 1:
+                    process = int(rng.integers(0, process_count))
+            elif k == "truncate_ckpt":
+                payload = float(rng.uniform(0.2, 0.7))
+            elif k == "scramble_ckpt":
+                payload = float(rng.integers(16, 128))
+            elif k in ("sigterm", "sigkill", "fatal") \
+                    and process_count > 1:
+                process = victim
+            out.append(ScheduledFault(kind=k, at_iter=int(at),
+                                      process=process, payload=payload))
+        return cls(seed=int(seed), faults=tuple(out), iters=int(iters),
+                   process_count=int(process_count))
+
+    @property
+    def expects_giveup(self) -> bool:
+        return any(f.kind == "fatal" for f in self.faults)
+
+    def schedule_for(self, process: int = 0, *, telemetry=None,
+                     sleep: Callable[[float], None] = time.sleep,
+                     ) -> ChaosSchedule:
+        mine = [f for f in self.faults if f.kind in IN_RUN_KINDS
+                and (f.process is None or f.process == int(process))]
+        return ChaosSchedule(mine, telemetry=telemetry, seed=self.seed,
+                             sleep=sleep)
+
+    def file_faults(self) -> Tuple[ScheduledFault, ...]:
+        return tuple(f for f in self.faults if f.kind in FILE_KINDS)
+
+    def describe(self) -> str:
+        return (f"seed={self.seed} "
+                + " ".join(f"{f.kind}@{f.at_iter}"
+                           + (f"/p{f.process}" if f.process is not None
+                              else "")
+                           for f in self.faults))
+
+
+class CampaignResult(NamedTuple):
+    outcome: str              # converged | gave_up | mismatch | stalled
+    final_loss: Optional[float]
+    diff: Optional[float]     # |final - baseline| (converged/mismatch)
+    relaunches: int
+    fired: List[Tuple[str, int]]   # every in-run fault that fired
+    file_applied: List[str]        # file faults applied at relaunches
+    giveup_message: Optional[str]  # SupervisorGivingUp text
+    num_iters: int = 0        # iterations that COUNT at exit — the
+    #                           journal's exactly-once census must match
+
+
+def _apply_file_fault(fault: ScheduledFault, ckpt_path: str, keep: int,
+                      seed: int, telemetry=None) -> Optional[str]:
+    """Corrupt the newest EXISTING generation of the checkpoint chain
+    per the fault's kind/payload; returns what was done (None when no
+    checkpoint file exists yet to corrupt)."""
+    target = next((p for p in generation_paths(ckpt_path, keep)
+                   if os.path.exists(p)), None)
+    if target is None:
+        return None
+    if fault.kind == "truncate_ckpt":
+        kept = faults_lib.truncate_file(
+            target, keep_fraction=float(fault.payload) or 0.4)
+        what = f"truncate_ckpt:{os.path.basename(target)}:{kept}B"
+    else:
+        n = int(fault.payload) or 64
+        faults_lib.scramble_file(target, seed=seed ^ fault.at_iter,
+                                 n_bytes=n)
+        what = f"scramble_ckpt:{os.path.basename(target)}:{n}B"
+    if telemetry is not None:
+        telemetry.chaos(fault=fault.kind, at_iter=int(fault.at_iter),
+                        outcome=what, seed=int(seed))
+    return what
+
+
+def run_campaign(
+    campaign: ChaosCampaign,
+    *,
+    staged,
+    prox,
+    reg_value,
+    w0,
+    config,
+    policy,
+    workdir: str,
+    baseline_loss: float,
+    telemetry=None,
+    seg_cache: Optional[dict] = None,
+    tol: float = 1e-6,
+    keep: int = 4,
+) -> CampaignResult:
+    """Execute one SINGLE-process campaign to its terminal outcome —
+    see the module docstring.  The relaunch loop is bounded by the
+    fault count (every in-run fault is one-shot), so a campaign can
+    never spin: exceeding the bound is reported as ``stalled``, which
+    the drill counts as a failure (it would have been a hang)."""
+    from .supervisor import run_agd_supervised
+
+    ckpt_path = os.path.join(workdir, "chaos_ckpt.npz")
+    schedule = campaign.schedule_for(0, telemetry=telemetry)
+    file_queue = list(campaign.file_faults())
+    file_applied: List[str] = []
+    relaunches = 0
+    max_relaunches = len(campaign.faults) + 2
+    while True:
+        ck = AutoCheckpointer(ckpt_path,
+                              every_iters=policy.segment_iters,
+                              keep=keep, telemetry=telemetry)
+        try:
+            res = run_agd_supervised(
+                prox=prox, reg_value=reg_value, w0=w0, config=config,
+                policy=policy, staged=staged, telemetry=telemetry,
+                checkpointer=ck, faults=schedule,
+                seg_cache=seg_cache, stream_iterations=False)
+        except Preempted:
+            relaunches += 1
+            if relaunches > max_relaunches:
+                return CampaignResult("stalled", None, None, relaunches,
+                                      schedule.fired, file_applied, None)
+            if file_queue:
+                what = _apply_file_fault(
+                    file_queue.pop(0), ckpt_path, keep, campaign.seed,
+                    telemetry=telemetry)
+                if what is not None:
+                    file_applied.append(what)
+            continue
+        except SupervisorGivingUp as e:
+            return CampaignResult("gave_up", None, None, relaunches,
+                                  schedule.fired, file_applied, str(e))
+        final = float(res.loss_history[-1])
+        diff = abs(final - float(baseline_loss))
+        outcome = "converged" if diff <= tol else "mismatch"
+        return CampaignResult(outcome, final, diff, relaunches,
+                              schedule.fired, file_applied, None,
+                              num_iters=int(res.num_iters))

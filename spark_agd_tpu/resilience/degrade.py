@@ -1,0 +1,375 @@
+"""Quorum-based graceful degradation: keep training on the survivors
+instead of restarting the world.
+
+Spark's answer to a lost executor is recompute-and-continue; PR 4's
+answer was a full elastic restart from the last committed generation —
+correct, but it re-assembles the DEAD host's data partitions, which is
+only possible when the storage outlives the host.  This module adds the
+middle path real pods use: when a peer dies (``HostLost``) and a
+**quorum** of processes survives, the run continues DEGRADED — the
+surviving processes resume from the last committed generation using
+only the SURVIVING shards, drop the dead hosts' data partitions, and
+keep training on what remains.
+
+The math: the distributed smooth is the reference's ``treeAggregate``
+contract — ``(Σloss, Σgrad, n)`` summed over partitions, divided by the
+valid-row count AFTER the reduction (``parallel.dist_smooth``).
+Dropping partitions therefore *re-weights automatically*: the gradient
+becomes the exact mean over the surviving rows — a smaller-sample
+estimate of the same objective, not a biased sum.  The trajectory is
+the one an uninterrupted run over the surviving partitions would have
+taken from the same iterate (the chaos drill pins this to 1e-6 in f64).
+
+Pieces:
+
+- :class:`DegradePolicy` — the quorum knob: ``min_quorum`` (fraction of
+  the saving topology that must survive) and ``min_processes``.
+  :meth:`DegradePolicy.decide` returns a :class:`QuorumDecision`;
+  below quorum the answer is :class:`~spark_agd_tpu.resilience.errors.
+  QuorumLost` (classified FATAL — retrying cannot resurrect hosts;
+  a full elastic restart or operator action is required).
+- :func:`load_degraded` — the surviving-shards loader: newest committed
+  generation whose SURVIVING shards verify (manifest size/CRC32 +
+  per-entry npz CRCs), warm state from the lowest surviving process's
+  shard (the commit barrier proved all replicas byte-equal), the
+  surviving hosts' partition lists re-split round-robin among the
+  survivors, row-sharded extras re-split likewise.  Emits one
+  ``degraded`` record and a ``degraded_continue`` recovery action.
+- :class:`DegradedCheckpointer` — drops into the supervisor's
+  ``checkpointer=`` seat for the degraded continuation: ``load`` is
+  :func:`load_degraded`; saves proceed as a normal (smaller-topology)
+  barrier commit, so the degraded run's own generations chain on.
+
+Telemetry: every degraded continuation carries the ``degraded`` flag
+in its records (`kind="degraded"` entry + the recovery action), so a
+post-mortem can tell a degraded tail from a full-strength run.
+
+Quorum matrix (``min_quorum=0.5``, ``min_processes=1``):
+
+======  =========  ========
+saved   surviving  decision
+======  =========  ========
+2       1          degrade (0.50 >= 0.50)
+4       2          degrade
+4       1          refuse (QuorumLost)
+8       3          refuse
+======  =========  ========
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import checkpoint as ckpt
+from . import manifest as manifest_lib
+from .distributed import (
+    ROWSTATE_PREFIX,
+    DistributedCheckpointer,
+    LoadedDistCheckpoint,
+    _check_embedded_generation,
+    _shard_partitions,
+    _shard_row_state,
+    reshard_partitions,
+)
+from .errors import QuorumLost
+
+logger = logging.getLogger("spark_agd_tpu")
+
+
+class QuorumDecision(NamedTuple):
+    """One quorum evaluation — kept whole so the decision itself can be
+    journaled/asserted, not just its boolean."""
+
+    allowed: bool
+    surviving: int
+    saved: int
+    quorum: float          # surviving / saved
+    required: float        # the policy's min_quorum
+    reason: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradePolicy:
+    """When may a run continue without its dead peers?
+
+    ``min_quorum``: the fraction of the SAVING topology that must
+    survive (0 < q <= 1); ``min_processes``: an absolute floor (a
+    999-host job at q=0.001 still needs at least this many).  The
+    default (0.5, 1) is the classic majority-or-half rule: a 2-host
+    job degrades to 1, a 4-host job to 2, below that the sample loss
+    is judged too far from the objective to keep training silently.
+    """
+
+    min_quorum: float = 0.5
+    min_processes: int = 1
+
+    def __post_init__(self):
+        if not 0.0 < self.min_quorum <= 1.0:
+            raise ValueError("min_quorum must be in (0, 1]")
+        if self.min_processes < 1:
+            raise ValueError("min_processes must be >= 1")
+
+    def decide(self, saved_process_count: int,
+               surviving: int) -> QuorumDecision:
+        saved = int(saved_process_count)
+        alive = int(surviving)
+        if not 0 <= alive <= saved:
+            raise ValueError(
+                f"surviving={alive} out of range for saved topology "
+                f"of {saved}")
+        quorum = alive / saved if saved else 0.0
+        ok = quorum >= self.min_quorum and alive >= self.min_processes
+        reason = (f"{alive}/{saved} processes survive "
+                  f"(quorum {quorum:.2f} "
+                  f"{'>=' if ok else '<'} {self.min_quorum:.2f}"
+                  + ("" if alive >= self.min_processes else
+                     f"; floor {self.min_processes} unmet") + ")")
+        return QuorumDecision(ok, alive, saved, quorum,
+                              self.min_quorum, reason)
+
+
+def _verify_surviving(m: "manifest_lib.Manifest", directory: str,
+                      surviving: Sequence[int]) -> List[str]:
+    """The surviving-shard subset of ``manifest.verify_manifest``: the
+    dead hosts' shards are ALLOWED to be missing or torn (their host
+    may have died mid-write) — only the shards the degraded resume will
+    actually read must verify."""
+    problems = []
+    by_process = {s.process: s for s in m.shards}
+    for p in surviving:
+        s = by_process.get(int(p))
+        if s is None:
+            problems.append(f"manifest g{m.generation} has no shard "
+                            f"for surviving process {p}")
+            continue
+        path = os.path.join(directory, s.path)
+        if not os.path.exists(path):
+            problems.append(f"surviving shard {s.path} missing")
+            continue
+        size = os.path.getsize(path)
+        if size != s.size:
+            problems.append(f"surviving shard {s.path}: size {size} != "
+                            f"manifest {s.size} (torn write)")
+            continue
+        crc = manifest_lib.crc32_file(path)
+        if crc != s.crc32:
+            problems.append(
+                f"surviving shard {s.path}: CRC32 {crc:#010x} != "
+                f"manifest {s.crc32:#010x}")
+    return problems
+
+
+class DegradedResume(NamedTuple):
+    """:func:`load_degraded`'s result: the loaded checkpoint (shaped
+    exactly like an elastic ``LoadedDistCheckpoint`` — the supervisor
+    reads the same first five fields), the quorum decision that allowed
+    it, and the data partitions that were dropped with the dead."""
+
+    loaded: LoadedDistCheckpoint
+    decision: QuorumDecision
+    dropped_partitions: Tuple[str, ...]
+
+
+def load_degraded(
+    directory: str,
+    template: Any,
+    *,
+    surviving: Sequence[int],
+    policy: Optional[DegradePolicy] = None,
+    process_index: Optional[int] = None,
+    fingerprint: Optional[str] = None,
+    telemetry=None,
+) -> Optional[DegradedResume]:
+    """Load the newest committed generation for a DEGRADED continuation
+    on ``surviving`` (sorted original process indices) — see the module
+    docstring.  ``process_index`` is the caller's ORIGINAL index (must
+    be in ``surviving``); its new rank is its position there.  Raises
+    :class:`QuorumLost` when the policy refuses; returns None when no
+    generation survives verification (each refusal recorded)."""
+    from ..parallel import multihost as mh
+
+    policy = policy or DegradePolicy()
+    survivors = sorted(int(p) for p in surviving)
+    if not survivors:
+        raise ValueError("surviving must name at least one process")
+    if process_index is None:
+        process_index = survivors[0]
+    if int(process_index) not in survivors:
+        raise ValueError(f"process_index {process_index} is not in "
+                         f"surviving={survivors}")
+    rank = mh.rank_among(survivors, int(process_index))
+    n_surv = len(survivors)
+
+    gens = manifest_lib.committed_generations(directory)
+    for gen in gens:
+        try:
+            m = manifest_lib.load_manifest(directory, gen)
+        except (ValueError, OSError) as e:
+            _fallback(telemetry, directory, gen,
+                      f"manifest unreadable: {e}")
+            continue
+        decision = policy.decide(m.process_count, n_surv)
+        if not decision.allowed:
+            # quorum is a property of the topology, not of this
+            # generation: no older generation can fix it
+            raise QuorumLost(decision.reason)
+        problems = _verify_surviving(m, directory, survivors)
+        if problems:
+            _fallback(telemetry, directory, gen, "; ".join(problems))
+            continue
+        try:
+            return _load_surviving(directory, m, template, survivors,
+                                   rank, n_surv, decision, fingerprint,
+                                   telemetry)
+        except ckpt.CheckpointCorruptError as e:
+            _fallback(telemetry, directory, gen, str(e))
+            continue
+    if gens:
+        logger.warning(
+            "degraded resume: every committed generation under %r "
+            "failed surviving-shard verification", directory)
+    return None
+
+
+def _fallback(telemetry, directory: str, generation: int,
+              reason: str) -> None:
+    logger.warning("degraded resume refusing generation %d under %r: %s",
+                   generation, directory, reason)
+    if telemetry is not None:
+        telemetry.recovery(action="checkpoint_fallback", path=directory,
+                           generation=generation, reason=reason,
+                           source="degrade")
+
+
+def _load_surviving(directory, m, template, survivors, rank, n_surv,
+                    decision, fingerprint, telemetry):
+    from ..parallel import multihost as mh
+
+    per_host = []
+    for p in survivors:
+        path = m.shard_path(directory, p)
+        entries = ckpt.read_npz_entries(path)
+        _check_embedded_generation(path, entries, m.generation)
+        per_host.append((p, path, entries))
+    _, path0, e0 = per_host[0]
+    # the warm carry is replicated (the commit barrier verified all
+    # replicas byte-equal BEFORE this generation existed) — any
+    # surviving copy is canonical; take the lowest survivor's
+    lc = ckpt.checkpoint_from_entries(
+        path0, ckpt._Entries(path0, e0), template, fingerprint)
+
+    saved_parts = [p for _, _, e in per_host
+                   if (p := _shard_partitions(e)) is not None]
+    partitions = (reshard_partitions(saved_parts, rank, n_surv)
+                  if saved_parts else None)
+    surviving_union = sorted({p for host in saved_parts for p in host})
+    # what died with the dead hosts: everything the manifest's topology
+    # saved minus what the survivors still hold — recoverable only from
+    # the dead shards, which a degraded resume deliberately forgoes
+    dead = sorted(set(range(m.process_count)) - set(survivors))
+    dropped: Tuple[str, ...] = ()
+    if saved_parts:
+        all_parts = set(surviving_union)
+        for p in dead:
+            try:
+                path = m.shard_path(directory, p)
+                if os.path.exists(path):
+                    entries = ckpt.read_npz_entries(path)
+                    lost = _shard_partitions(entries)
+                    if lost is not None:
+                        all_parts |= set(lost)
+            except (ckpt.CheckpointCorruptError, KeyError, OSError):
+                pass  # a dead host's shard owes us nothing
+        dropped = tuple(sorted(all_parts - set(surviving_union)))
+
+    names = sorted({k for _, _, e in per_host
+                    for k in e if k.startswith(ROWSTATE_PREFIX)})
+    row_state = {}
+    for k in names:
+        whole = np.concatenate(
+            [e[k] for _, _, e in per_host if k in e], axis=0)
+        row_state[k[len(ROWSTATE_PREFIX):]] = whole[
+            mh.local_rows_slice(whole.shape[0], rank, n_surv)]
+
+    if telemetry is not None:
+        telemetry.degraded(
+            surviving=n_surv, saved_process_count=m.process_count,
+            lost=dead, quorum=round(decision.quorum, 4),
+            min_quorum=decision.required, generation=m.generation,
+            to_iter=int(lc.warm.prior_iters), process=rank,
+            dropped_partitions=len(dropped), source="degrade")
+        telemetry.recovery(
+            action="degraded_continue", path=directory,
+            generation=m.generation,
+            saved_process_count=m.process_count, process_count=n_surv,
+            process=rank, to_iter=int(lc.warm.prior_iters),
+            reason=decision.reason, source="degrade")
+    logger.warning(
+        "DEGRADED resume: generation %d saved by %d processes, "
+        "continuing on %d survivor(s) (%s); %d data partition(s) "
+        "dropped with the dead hosts",
+        m.generation, m.process_count, n_surv, decision.reason,
+        len(dropped))
+    loaded = LoadedDistCheckpoint(
+        *lc, generation=m.generation,
+        saved_process_count=m.process_count, elastic=True,
+        partitions=partitions, row_state=row_state)
+    return DegradedResume(loaded, decision, dropped)
+
+
+class DegradedCheckpointer(DistributedCheckpointer):
+    """The degraded continuation's checkpointer: ``load`` reads only
+    the surviving shards (:func:`load_degraded`, quorum-gated), and
+    saves chain on as normal barrier commits of the SURVIVING topology
+    (``process_count = len(surviving)``, this process's rank among the
+    survivors) — so the degraded run's own generations are first-class
+    and a later full restart resumes from them elastically."""
+
+    def __init__(self, directory: str, *, surviving: Sequence[int],
+                 original_process_index: Optional[int] = None,
+                 degrade_policy: Optional[DegradePolicy] = None,
+                 **kwargs):
+        from ..parallel import multihost as mh
+
+        self.surviving = sorted(int(p) for p in surviving)
+        if original_process_index is None:
+            original_process_index = self.surviving[0]
+        self.original_process_index = int(original_process_index)
+        self.degrade_policy = degrade_policy or DegradePolicy()
+        rank = mh.rank_among(self.surviving, self.original_process_index)
+        super().__init__(directory, process_index=rank,
+                         process_count=len(self.surviving), **kwargs)
+        self.last_decision: Optional[QuorumDecision] = None
+        self.dropped_partitions: Tuple[str, ...] = ()
+        self._loaded_once: Optional[LoadedDistCheckpoint] = None
+
+    def load(self, template: Any) -> Optional[LoadedDistCheckpoint]:
+        # memoized: the degraded-resume DECISION is made once — the
+        # driver loads first (it needs the surviving partitions to
+        # build the degraded problem), then the supervisor's own load
+        # call reuses the result instead of re-reading shards and
+        # re-emitting the decision records
+        if self._loaded_once is not None:
+            return self._loaded_once
+        resumed = load_degraded(
+            self.directory, template, surviving=self.surviving,
+            policy=self.degrade_policy,
+            process_index=self.original_process_index,
+            fingerprint=self.fingerprint, telemetry=self.telemetry)
+        if resumed is None:
+            return None
+        self.last_decision = resumed.decision
+        self.dropped_partitions = resumed.dropped_partitions
+        loaded = resumed.loaded
+        self._next_generation = loaded.generation + 1
+        self._last_saved_iters = int(loaded.warm.prior_iters)
+        self._last_saved_t = self._clock()
+        if loaded.partitions is not None and self.partitions is None:
+            self.partitions = list(loaded.partitions)
+        self._loaded_once = loaded
+        return loaded

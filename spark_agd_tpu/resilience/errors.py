@@ -20,7 +20,8 @@ harness (``resilience.faults``) all speak these kinds:
 - ``PREEMPTED`` — the host was told to go away (SIGTERM/SIGINT).  The
   auto-checkpointer has already flushed; the supervisor re-raises so
   the process can exit and a NEW process resumes from the checkpoint.
-- ``FATAL`` — a programming/config error (ValueError, TypeError, …):
+- ``FATAL`` — a programming/config error (ValueError, TypeError, …) or
+  a lost quorum (``QuorumLost`` — retrying cannot resurrect hosts):
   retrying is noise; raise immediately with the attempt ledger.
 
 Deliberately stdlib-only (no jax import): ``utils.debug`` and the data
@@ -65,6 +66,21 @@ class HostLost(RuntimeError):
             "topology via DistributedCheckpointer.load_for_topology")
         self.process_index = int(process_index)
         self.stale_for_s = stale_for_s
+
+
+class QuorumLost(RuntimeError):
+    """Too many peers are gone for a DEGRADED continuation
+    (``resilience.degrade.DegradePolicy`` refused): the surviving
+    process count is below quorum.  Classified FATAL — unlike a single
+    ``HostLost``, retrying cannot resurrect the missing hosts; the run
+    needs a full elastic restart on restored capacity (or an operator
+    decision), and a supervisor must give up typed rather than back
+    off forever."""
+
+    def __init__(self, reason: str):
+        super().__init__(
+            f"quorum lost: {reason}; degraded continuation refused — "
+            "restart elastically on restored capacity")
 
 
 class NumericsFailureError(FloatingPointError):
@@ -132,6 +148,10 @@ def classify_failure(exc: BaseException) -> str:
     if isinstance(exc, (NumericsFailureError, FloatingPointError,
                         ZeroDivisionError)):
         return NUMERIC
+    if isinstance(exc, QuorumLost):
+        # unlike HostLost: retrying cannot bring a QUORUM back — must
+        # be checked before the transient isinstance row (RuntimeError)
+        return FATAL
     if isinstance(exc, (SimulatedDeviceLoss, HostLost, TimeoutError,
                         OSError, ConnectionError, BrokenPipeError)):
         return TRANSIENT

@@ -25,6 +25,13 @@ Fault kinds:
 - :func:`flaky` — a callable that fails its first N calls with an IO
   error (optionally sleeping first): drives the ingest retry path.
 
+MULTI-fault sequences (a straggler, then a preemption, then a torn
+write — against one run) are ``resilience.chaos``'s job:
+``ChaosSchedule`` generalizes :class:`FaultScript` behind the same
+supervisor hooks, ``ChaosCampaign.generate(seed)`` draws whole
+deterministic scenarios, and ``tools/chaos_drill.py`` soaks the
+recovery machinery against dozens of them.
+
 Injection granularity note: the fused AGD loop is ONE compiled program,
 so in-loop faults cannot fire at an arbitrary iteration of a running
 segment; ``FaultScript`` fires at the first segment BOUNDARY at or
@@ -147,13 +154,20 @@ def truncate_file(path: str, keep_fraction: float = 0.5,
 
 
 def scramble_file(path: str, seed: int = 0,
-                  n_bytes: Optional[int] = None) -> None:
-    """Overwrite the head of ``path`` with seeded garbage — corruption
-    that keeps the original length (a bad sector, not a truncation)."""
+                  n_bytes: Optional[int] = None,
+                  offset: int = 0) -> None:
+    """Overwrite bytes of ``path`` with seeded garbage — corruption
+    that keeps the original length (a bad sector, not a truncation).
+    ``offset`` places the bad sector (default 0: the head, which kills
+    npz/zip directories outright; a mid-file offset is the journal
+    bit-flip case — everything before it must still replay)."""
     rng = np.random.default_rng(seed)
     size = os.path.getsize(path)
-    n = size if n_bytes is None else min(n_bytes, size)
+    offset = max(0, min(int(offset), size))
+    n = (size - offset) if n_bytes is None else min(n_bytes,
+                                                    size - offset)
     with open(path, "r+b") as f:
+        f.seek(offset)
         f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
 
 

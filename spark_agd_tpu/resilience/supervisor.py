@@ -129,6 +129,8 @@ def run_agd_supervised(
     place_w: Optional[Callable] = None,
     heartbeat=None,
     monitor=None,
+    seg_cache: Optional[dict] = None,
+    stream_iterations: bool = True,
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
 ) -> SupervisedResult:
@@ -148,8 +150,25 @@ def run_agd_supervised(
     cadence save, signal handlers are installed for the duration of
     the run, and terminal states are force-flushed.
 
-    ``faults`` (a :class:`~spark_agd_tpu.resilience.faults.
-    FaultScript`): consulted at segment boundaries — test/drill only.
+    ``faults`` (a :class:`~spark_agd_tpu.resilience.faults.FaultScript`
+    or :class:`~spark_agd_tpu.resilience.chaos.ChaosSchedule` — any
+    object with the same ``before_segment``/``take_poison`` hooks):
+    consulted at segment boundaries — test/drill only.
+
+    ``seg_cache`` (a dict, default private): the jitted-segment cache,
+    keyed ``(segment length, poisoned)``.  Pass ONE dict across
+    repeated calls that share the same ``staged``/``smooth``/``prox``/
+    ``config`` (and the same telemetry-streaming state) to skip
+    re-tracing — the chaos soak driver runs dozens of supervised fits
+    of one problem and pays compilation once.  Never share it across
+    different problems or different in-loop callbacks.
+
+    ``stream_iterations=False`` skips the in-loop per-iteration
+    telemetry callback (the host round-trip per iteration) while
+    keeping every attempt/recovery/heartbeat record — the right mode
+    for drills, and REQUIRED when ``seg_cache`` is shared across runs
+    with different ``Telemetry`` objects (the callback would be baked
+    into the cached program).
 
     ``heartbeat`` (a :class:`~spark_agd_tpu.resilience.distributed.
     HeartbeatWriter`): beaten at every segment boundary and once at
@@ -169,12 +188,12 @@ def run_agd_supervised(
     if place_w is not None:
         w0 = place_w(w0)
 
-    tel_cb = (None if telemetry is None
+    tel_cb = (None if telemetry is None or not stream_iterations
               else telemetry.iteration_callback("agd"))
 
     # one jitted program per (segment length, poisoned); the poisoned
     # variant only ever traces in drills/tests
-    seg_fns = {}
+    seg_fns = {} if seg_cache is None else seg_cache
 
     def run_segment(warm: AGDWarmState, k: int, poisoned: bool):
         cfg_k = dataclasses.replace(config, num_iterations=k)
@@ -301,6 +320,14 @@ def run_agd_supervised(
                     record_attempt("failed", start, 0, 0.0,
                                    error=f"{type(e).__name__}: {e}",
                                    failure_kind=kind)
+                    if kind == errors.FATAL:
+                        # a fatal boundary fault (chaos-injected config
+                        # error, QuorumLost) must give up TYPED, exactly
+                        # like a fatal segment failure — never a bare
+                        # traceback with the ledger lost
+                        raise errors.SupervisorGivingUp(
+                            f"fatal failure at iteration {start}: "
+                            f"{type(e).__name__}: {e}", ledger) from e
                     if kind != errors.TRANSIENT:
                         raise
                     seg_failures += 1

@@ -776,3 +776,24 @@ class TestPallasProbe:
         assert rec["pallas_failure_phase"] == "compile"
         assert "mosaic died" in rec["pallas_probe_error"]
         assert "pallas_iters_per_sec" not in rec
+
+    def test_post_phase_failure_not_misattributed(
+            self, tiny, cpu_devices, monkeypatch):
+        """r5 advisor: an exception AFTER the last phase completed
+        (metrics assembly) must be labeled post-run, not blamed on the
+        already-finished run phase."""
+        monkeypatch.setenv("BENCH_PALLAS_INTERPRET", "1")
+
+        def _boom(*a, **k):
+            raise RuntimeError("drift bookkeeping died")
+
+        monkeypatch.setattr(tiny, "_drift", _boom)
+        rec = {}
+        # a non-None cpu history forces the _drift call after run-done
+        tiny.pallas_probe(rec, 256, cpu_devices[0],
+                          {256: (None, [0.5, 0.4])}, {},
+                          self._noop, self._noop)
+        assert rec["pallas_failure_phase"] == "post-run"
+        assert "drift bookkeeping died" in rec["pallas_probe_error"]
+        # the run itself succeeded — its metrics survive the annotation
+        assert rec["pallas_iters_per_sec"] > 0

@@ -1,0 +1,726 @@
+"""Chaos campaigns, the crash-safe recovery journal, and quorum-based
+graceful degradation (``resilience/chaos.py`` / ``journal.py`` /
+``degrade.py``).
+
+Per-campaign drills here are tier-1-fast (shared seg_cache, tiny
+problem); the full randomized soak and the subprocess drill gate ride
+behind ``-m chaos`` (the soak additionally behind ``slow``).  Journal
+torn-tail coverage uses the existing ``faults.truncate_file`` /
+``faults.scramble_file`` helpers — the satellite contract: replay drops
+ONLY the torn tail and recovers every committed record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu.core.agd import AGDConfig, AGDWarmState
+from spark_agd_tpu.obs import JSONLSink, Telemetry, schema
+from spark_agd_tpu.parallel import multihost as mh
+from spark_agd_tpu.resilience import (
+    ChaosCampaign,
+    ChaosSchedule,
+    DegradePolicy,
+    DegradedCheckpointer,
+    DistributedCheckpointer,
+    Journal,
+    JournalSink,
+    QuorumLost,
+    ResiliencePolicy,
+    ScheduledFault,
+    classify_failure,
+    errors,
+    faults,
+    journal as journal_lib,
+    load_degraded,
+    run_campaign,
+)
+from spark_agd_tpu.resilience.chaos import InjectedFatalError
+from spark_agd_tpu.resilience.errors import SimulatedDeviceLoss
+
+pytestmark = [pytest.mark.fault, pytest.mark.chaos]
+
+
+# ---------------------------------------------------------------------------
+# the recovery journal
+
+
+def _decision(i, kind="attempt", **kw):
+    base = {"schema_version": schema.SCHEMA_VERSION, "kind": kind,
+            "run_id": "jtest", "outcome": "ok", "start_iter": i * 4,
+            "iters": 4}
+    base.update(kw)
+    return base
+
+
+class TestJournal:
+    def test_roundtrip_bit_identical(self, tmp_path):
+        path = str(tmp_path / "run.journal")
+        with Journal(path) as j:
+            stamped = [j.append(_decision(i)) for i in range(5)]
+        rep = journal_lib.replay(path)
+        assert rep.reason is None and rep.torn_bytes == 0
+        assert rep.records == stamped
+        # seq stamped monotonically from 0
+        assert [r["seq"] for r in rep.records] == list(range(5))
+        assert rep.last_seq == 4
+
+    def test_written_mirrors_disk(self, tmp_path):
+        path = str(tmp_path / "run.journal")
+        j = Journal(path)
+        for i in range(3):
+            j.append(_decision(i))
+        j.close()
+        rep = journal_lib.replay(path)
+        assert [bytes(p) for p in rep.payloads] == j.written
+
+    def test_missing_file_replays_clean_empty(self, tmp_path):
+        rep = journal_lib.replay(str(tmp_path / "absent.journal"))
+        assert rep.records == [] and rep.reason is None
+        assert rep.last_seq == -1
+
+    def test_reopen_continues_seq(self, tmp_path):
+        path = str(tmp_path / "run.journal")
+        with Journal(path) as j:
+            j.append(_decision(0))
+            j.append(_decision(1))
+        with Journal(path) as j2:
+            assert j2.next_seq == 2
+            assert [r["seq"] for r in j2.recovered] == [0, 1]
+            j2.append(_decision(2))
+        rep = journal_lib.replay(path)
+        assert [r["seq"] for r in rep.records] == [0, 1, 2]
+
+    @pytest.mark.parametrize("keep_fraction", [0.15, 0.4, 0.65, 0.9])
+    def test_torn_tail_truncation_recovers_committed_prefix(
+            self, tmp_path, keep_fraction):
+        """Satellite: truncate mid-record at several cut points — every
+        record whose frame fits in the kept bytes is recovered, nothing
+        past the cut is, and nothing recovered is altered."""
+        path = str(tmp_path / "run.journal")
+        j = Journal(path)
+        stamped = [j.append(_decision(i, note="x" * (20 + 13 * i)))
+                   for i in range(8)]
+        j.close()
+        kept = faults.truncate_file(path, keep_fraction=keep_fraction)
+        # expected survivors: frames wholly inside the kept bytes
+        off = len(journal_lib.MAGIC)
+        expect = []
+        for rec, payload in zip(stamped, j.written):
+            end = off + journal_lib._FRAME.size + len(payload)
+            if end <= kept:
+                expect.append(rec)
+                off = end
+            else:
+                break
+        rep = journal_lib.replay(path)
+        assert rep.records == expect
+        assert len(rep.records) < len(stamped)  # something WAS torn
+        assert rep.reason is not None
+        assert rep.valid_bytes == off
+        assert rep.torn_bytes == kept - off
+
+    def test_bit_flip_mid_record_drops_only_the_tail(self, tmp_path):
+        """Satellite: scramble bytes INSIDE record k — replay recovers
+        records 0..k-1 intact, stops with a CRC reason, never returns
+        garbage."""
+        path = str(tmp_path / "run.journal")
+        j = Journal(path)
+        stamped = [j.append(_decision(i, note="y" * 40))
+                   for i in range(6)]
+        j.close()
+        # byte offset of record 3's payload
+        off = len(journal_lib.MAGIC)
+        for payload in j.written[:3]:
+            off += journal_lib._FRAME.size + len(payload)
+        faults.scramble_file(path, seed=7, n_bytes=4,
+                             offset=off + journal_lib._FRAME.size + 5)
+        rep = journal_lib.replay(path)
+        assert rep.records == stamped[:3]
+        assert rep.reason is not None and "CRC" in rep.reason
+        assert rep.torn_bytes > 0
+
+    def test_scrambled_header_replays_empty_with_reason(self, tmp_path):
+        path = str(tmp_path / "run.journal")
+        with Journal(path) as j:
+            j.append(_decision(0))
+        faults.scramble_file(path, seed=3, n_bytes=8, offset=0)
+        rep = journal_lib.replay(path)
+        assert rep.records == [] and "magic" in rep.reason
+
+    def test_reopen_repairs_torn_tail_and_appends_cleanly(
+            self, tmp_path):
+        """The resume story: a SIGKILL mid-append leaves a torn tail;
+        the next open truncates it, reports the repair, continues seq
+        from the last COMMITTED record, and new appends replay clean."""
+        path = str(tmp_path / "run.journal")
+        j = Journal(path)
+        for i in range(4):
+            j.append(_decision(i))
+        j.close()
+        size = os.path.getsize(path)
+        faults.truncate_file(path, keep_bytes=size - 3)  # torn tail
+        tel = Telemetry()
+        j2 = Journal(path, telemetry=tel)
+        assert j2.replay_summary["repaired"] is True
+        assert j2.replay_summary["records"] == 3
+        assert j2.replay_summary["torn_bytes"] > 0
+        assert j2.next_seq == 3  # record 3 was torn -> re-issued
+        j2.append(_decision(3))
+        j2.close()
+        rep = journal_lib.replay(path)
+        assert rep.reason is None and rep.torn_bytes == 0
+        assert [r["seq"] for r in rep.records] == [0, 1, 2, 3]
+        # the repair decision itself landed on telemetry, schema-valid
+        jr = [r for r in tel.records if r.get("kind") == "journal_replay"]
+        assert len(jr) == 1 and jr[0]["repaired"] is True
+        assert not schema.validate_record(json.loads(json.dumps(jr[0])))
+
+    def test_repair_false_inspects_without_touching(self, tmp_path):
+        path = str(tmp_path / "run.journal")
+        with Journal(path) as j:
+            for i in range(3):
+                j.append(_decision(i))
+        size = os.path.getsize(path)
+        faults.truncate_file(path, keep_bytes=size - 2)
+        ro = Journal(path, repair=False)
+        ro.close()
+        assert os.path.getsize(path) == size - 2  # bytes untouched
+        assert ro.replay_summary["repaired"] is False
+
+    def test_sink_filters_to_decision_kinds(self, tmp_path):
+        path = str(tmp_path / "run.journal")
+        j = Journal(path)
+        tel = Telemetry([JournalSink(j)], run_id="jt")
+        tel.attempt(attempt=1, outcome="ok", start_iter=0, iters=4)
+        tel.heartbeat(process=0)  # high-rate kind: filtered out
+        tel.chaos(fault="nan", at_iter=3)
+        tel.flush()
+        j.close()
+        rep = journal_lib.replay(path)
+        assert [r["kind"] for r in rep.records] == ["attempt", "chaos"]
+
+    def test_segment_accounting_last_wins(self):
+        recs = [_decision(0), _decision(1),
+                _decision(1, iters=2),          # re-run supersedes
+                _decision(2, outcome="failed"),  # failures don't count
+                {"kind": "recovery", "action": "rollback"}]
+        acct = journal_lib.segment_accounting(recs)
+        assert acct == {0: 4, 4: 2}
+        assert sum(acct.values()) == 6
+
+    def test_decision_sequence_shape(self):
+        recs = [_decision(0),
+                {"kind": "recovery", "action": "rollback", "from_iter": 8,
+                 "to_iter": 4, "generation": None},
+                {"kind": "chaos", "fault": "nan", "at_iter": 3,
+                 "process": None},
+                {"kind": "degraded", "surviving": 1,
+                 "saved_process_count": 2, "to_iter": 12},
+                {"kind": "iteration", "iter": 5}]  # skipped
+        seq = journal_lib.decision_sequence(recs)
+        assert [t[0] for t in seq] == ["attempt", "recovery", "chaos",
+                                       "degraded"]
+
+
+# ---------------------------------------------------------------------------
+# chaos schedules + campaigns
+
+
+class TestChaosSchedule:
+    def test_fault_validation(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            ScheduledFault("meteor", 3)
+        with pytest.raises(ValueError, match="at_iter"):
+            ScheduledFault("nan", -1)
+
+    def test_file_faults_rejected_in_run(self):
+        with pytest.raises(ValueError, match="FILE fault"):
+            ChaosSchedule([ScheduledFault("truncate_ckpt", 4)])
+
+    def test_sequence_fires_in_order_one_per_boundary(self):
+        tel = Telemetry(run_id="sched")
+        sched = ChaosSchedule(
+            [ScheduledFault("device_loss", 8),
+             ScheduledFault("fatal", 4)], telemetry=tel, seed=11)
+        assert not sched.exhausted
+        sched.before_segment(0)  # nothing due
+        assert sched.fired == []
+        with pytest.raises(InjectedFatalError):
+            sched.before_segment(5)  # fatal armed at 4 fires first
+        with pytest.raises(SimulatedDeviceLoss):
+            sched.before_segment(9)
+        assert sched.exhausted
+        assert [f[0] for f in sched.fired] == ["fatal", "device_loss"]
+        recs = [r for r in tel.records if r.get("kind") == "chaos"]
+        assert [r["fault"] for r in recs] == ["fatal", "device_loss"]
+        assert all(r["seed"] == 11 for r in recs)
+        assert recs[0]["at_iter"] == 4 and recs[0]["fired_iter"] == 5
+
+    def test_slow_host_sleeps_without_interrupting(self):
+        naps = []
+        sched = ChaosSchedule(
+            [ScheduledFault("slow_host", 2, payload=0.03)],
+            sleep=naps.append)
+        sched.before_segment(3)  # no exception
+        assert naps == [0.03]
+        assert sched.exhausted
+
+    def test_take_poison_one_shot(self):
+        sched = ChaosSchedule([ScheduledFault("nan", 4)])
+        assert not sched.take_poison(3)
+        assert sched.take_poison(4)
+        assert not sched.take_poison(4)  # one-shot
+        assert sched.exhausted
+
+
+class TestChaosCampaign:
+    def test_generate_deterministic_in_seed(self):
+        a = ChaosCampaign.generate(123, iters=40)
+        b = ChaosCampaign.generate(123, iters=40)
+        assert a == b
+        assert ChaosCampaign.generate(124, iters=40) != a
+
+    def test_generated_campaigns_are_normalized(self):
+        """The fairness invariants over a wide seed sweep: bounded NaN
+        count, file faults always preceded by a sigterm, arming inside
+        the first 70% of the budget."""
+        for seed in range(120):
+            c = ChaosCampaign.generate(seed, iters=48)
+            kinds = [f.kind for f in c.faults]
+            assert 1 <= len(kinds) <= 4
+            assert kinds.count("nan") <= 2
+            for f in c.faults:
+                assert 2 <= f.at_iter < 48 * 0.7 + 1
+            first_file = next((i for i, k in enumerate(kinds)
+                               if k in ("truncate_ckpt",
+                                        "scramble_ckpt")), None)
+            if first_file is not None:
+                assert "sigterm" in kinds[:first_file]
+            if "fatal" in kinds:
+                assert kinds[-1] == "fatal"
+            assert c.expects_giveup == ("fatal" in kinds)
+
+    def test_schedule_for_targets_processes(self):
+        c = ChaosCampaign(
+            seed=1, iters=20, process_count=2,
+            faults=(ScheduledFault("nan", 4),            # everyone
+                    ScheduledFault("sigkill", 8, process=1),
+                    ScheduledFault("truncate_ckpt", 10, payload=0.4)))
+        s0 = c.schedule_for(0)
+        s1 = c.schedule_for(1)
+        # the nan targets every process; only process 1 sees the kill
+        assert s0.take_poison(4) and s1.take_poison(4)
+        assert [f.kind for f in s1._pending] == ["sigkill"]
+        assert s0._pending == []
+        assert s0.exhausted and not s1.exhausted
+        assert [f.kind for f in c.file_faults()] == ["truncate_ckpt"]
+
+
+# ---------------------------------------------------------------------------
+# per-campaign drills (tier-1 fast: shared seg_cache, tiny problem)
+
+
+@pytest.fixture(scope="module")
+def campaign_problem():
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import smooth as smooth_lib
+    from spark_agd_tpu.data import synthetic
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 240, 5)
+    X = synthetic.with_intercept_column(X).astype(np.float64)
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    w0 = jnp.zeros(2, jnp.float64)
+    cfg = AGDConfig(convergence_tol=0.0, num_iterations=32)
+    policy = ResiliencePolicy(max_attempts=3, backoff_base=0.0,
+                              jitter=0.0, seed=0, segment_iters=4)
+    seg_cache: dict = {}
+    from spark_agd_tpu.resilience import run_agd_supervised
+
+    base = run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                              policy=policy, staged=(build, dargs),
+                              seg_cache=seg_cache,
+                              stream_iterations=False)
+    return dict(staged=(build, dargs), prox=px, reg_value=rv, w0=w0,
+                config=cfg, policy=policy, seg_cache=seg_cache,
+                baseline_loss=float(base.loss_history[-1]))
+
+
+def _campaign_run(campaign_problem, campaign, tmp_path, tag="c"):
+    wd = str(tmp_path / tag)
+    os.makedirs(wd, exist_ok=True)
+    journal = Journal(os.path.join(wd, "run.journal"))
+    tel = Telemetry([JSONLSink(os.path.join(wd, "run.jsonl")),
+                     JournalSink(journal)], run_id=f"chaos-{tag}")
+    tel.journal_replay(**journal.replay_summary)
+    res = run_campaign(campaign, workdir=wd, telemetry=tel,
+                       **campaign_problem)
+    tel.flush()
+    journal.close()
+    return res, journal, wd
+
+
+class TestRunCampaign:
+    def test_preemption_and_torn_checkpoint_converges(
+            self, campaign_problem, tmp_path):
+        """sigterm → relaunch applies a checkpoint truncation → the
+        `.bak` chain resumes — final loss matches baseline to 1e-6
+        (f64) and the journal carries the whole story bit-identically."""
+        campaign = ChaosCampaign(
+            seed=901, iters=32,
+            faults=(ScheduledFault("sigterm", 10),
+                    ScheduledFault("truncate_ckpt", 12, payload=0.4)))
+        res, journal, wd = _campaign_run(campaign_problem, campaign,
+                                         tmp_path, "torn")
+        assert res.outcome == "converged", res
+        assert res.diff <= 1e-6
+        assert res.relaunches == 1
+        assert [f[0] for f in res.fired] == ["sigterm"]
+        assert res.file_applied and "truncate_ckpt" in res.file_applied[0]
+        rep = journal_lib.replay(journal.path)
+        assert rep.reason is None
+        assert [bytes(p) for p in rep.payloads] == journal.written
+        # exactly-once census across BOTH attempts equals what counted
+        acct = journal_lib.segment_accounting(rep.records)
+        assert sum(acct.values()) == res.num_iters
+        # decision sequence reconstructs: preemption flush, resume
+        seq = journal_lib.decision_sequence(rep.records)
+        actions = [t[1] for t in seq if t[0] == "recovery"]
+        assert "preemption_flush" in actions and "resume" in actions
+
+    def test_nan_then_device_loss_converges(self, campaign_problem,
+                                            tmp_path):
+        campaign = ChaosCampaign(
+            seed=902, iters=32,
+            faults=(ScheduledFault("nan", 6),
+                    ScheduledFault("device_loss", 14)))
+        res, journal, _ = _campaign_run(campaign_problem, campaign,
+                                        tmp_path, "nanloss")
+        assert res.outcome == "converged", res
+        assert res.diff <= 1e-6
+        seq = journal_lib.decision_sequence(
+            journal_lib.replay(journal.path).records)
+        actions = [t[1] for t in seq if t[0] == "recovery"]
+        assert "rollback" in actions and "retry" in actions
+        chaos_fired = [t[1] for t in seq if t[0] == "chaos"]
+        assert chaos_fired == ["nan", "device_loss"]
+
+    def test_fatal_gives_up_typed(self, campaign_problem, tmp_path):
+        campaign = ChaosCampaign(
+            seed=903, iters=32,
+            faults=(ScheduledFault("fatal", 8),))
+        res, journal, _ = _campaign_run(campaign_problem, campaign,
+                                        tmp_path, "fatal")
+        assert res.outcome == "gave_up"
+        assert "InjectedFatalError" in res.giveup_message
+        # the failed attempt is journaled before the give-up
+        rep = journal_lib.replay(journal.path)
+        fails = [r for r in rep.records if r.get("kind") == "attempt"
+                 and r.get("outcome") == "failed"]
+        assert fails and fails[0]["failure_kind"] == "fatal"
+
+    def test_campaign_replay_is_deterministic(self, campaign_problem,
+                                              tmp_path):
+        """One seeded campaign, run twice in fresh workdirs: identical
+        terminal state and identical journaled decision sequences —
+        the acceptance criterion's bit-identical reconstruction."""
+        campaign = ChaosCampaign.generate(9, iters=32)
+        r1, j1, _ = _campaign_run(campaign_problem, campaign, tmp_path,
+                                  "det1")
+        r2, j2, _ = _campaign_run(campaign_problem, campaign, tmp_path,
+                                  "det2")
+        assert r1.outcome == r2.outcome
+        assert r1.final_loss == r2.final_loss
+        assert r1.fired == r2.fired
+        s1 = journal_lib.decision_sequence(
+            journal_lib.replay(j1.path).records)
+        s2 = journal_lib.decision_sequence(
+            journal_lib.replay(j2.path).records)
+        assert s1 == s2
+
+    def test_all_records_schema_valid(self, campaign_problem, tmp_path):
+        campaign = ChaosCampaign(
+            seed=904, iters=32,
+            faults=(ScheduledFault("nan", 5),
+                    ScheduledFault("sigterm", 12),
+                    ScheduledFault("scramble_ckpt", 14, payload=32)))
+        res, journal, wd = _campaign_run(campaign_problem, campaign,
+                                         tmp_path, "valid")
+        assert res.outcome == "converged", res
+        records = schema.read_jsonl(os.path.join(wd, "run.jsonl"))
+        records += journal_lib.replay(journal.path).records
+        bad = [schema.validate_record(json.loads(json.dumps(r)))
+               for r in records]
+        assert not [b for b in bad if b]
+
+
+# ---------------------------------------------------------------------------
+# quorum-based graceful degradation
+
+
+class _ThreadExchange:
+    """threading.Barrier stand-in for the allgather commit barrier
+    (same shape as tests/test_dist_resilience.py)."""
+
+    def __init__(self, n):
+        self.n = n
+        self._barrier = threading.Barrier(n, timeout=30)
+        self._rows = {}
+
+    def for_process(self, p):
+        def exchange(row):
+            self._rows[p] = np.asarray(row)
+            self._barrier.wait()
+            out = np.stack([self._rows[i] for i in range(self.n)])
+            self._barrier.wait()
+            return out
+
+        return exchange
+
+
+def _warm(prior_iters=3, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    cfg = AGDConfig(num_iterations=10)
+    w = rng.standard_normal(d).astype(np.float32)
+    return AGDWarmState.initial(w, cfg)._replace(
+        prior_iters=prior_iters), w
+
+
+def _two_host_save(tmp_path, warm, *, generations=1, telemetry=None,
+                   fingerprint=None, keep=3, row_len=4):
+    ex = _ThreadExchange(2)
+    cks = [DistributedCheckpointer(
+        str(tmp_path), every_iters=1, keep=keep,
+        fingerprint=fingerprint, telemetry=telemetry,
+        mesh_shape={"data": 2},
+        partitions=[f"part-{p}", f"part-{p + 2}"],
+        row_state={"rows": np.arange(p * row_len, (p + 1) * row_len)},
+        process_index=p, process_count=2,
+        exchange=ex.for_process(p)) for p in (0, 1)]
+    errs_ = []
+
+    def run(p):
+        try:
+            for g in range(generations):
+                cks[p]._save(warm._replace(
+                    prior_iters=int(warm.prior_iters) + g),
+                    [0.5, 0.4], False, False)
+        except Exception as e:  # noqa: BLE001 — surfaced to the test
+            errs_.append(e)
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs_, errs_
+    return cks
+
+
+class TestDegradePolicy:
+    @pytest.mark.parametrize("saved,alive,allowed", [
+        (2, 1, True), (2, 2, True), (4, 2, True),
+        (4, 1, False), (8, 3, False),
+    ])
+    def test_default_quorum_matrix(self, saved, alive, allowed):
+        d = DegradePolicy().decide(saved, alive)
+        assert d.allowed is allowed
+        assert d.surviving == alive and d.saved == saved
+        assert d.quorum == pytest.approx(alive / saved)
+        assert str(d.required) in d.reason or "quorum" in d.reason
+
+    def test_min_processes_floor(self):
+        p = DegradePolicy(min_quorum=0.25, min_processes=2)
+        assert not p.decide(4, 1).allowed  # quorum ok, floor unmet
+        assert p.decide(4, 2).allowed
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DegradePolicy(min_quorum=0.0)
+        with pytest.raises(ValueError):
+            DegradePolicy(min_quorum=1.5)
+        with pytest.raises(ValueError):
+            DegradePolicy(min_processes=0)
+        with pytest.raises(ValueError):
+            DegradePolicy().decide(2, 3)
+
+    def test_quorum_lost_is_fatal(self):
+        assert classify_failure(QuorumLost("1/4 survive")) == errors.FATAL
+
+    def test_rank_among(self):
+        assert mh.rank_among([0, 2, 3], 2) == 1
+        assert mh.rank_among([0, 2, 3], 0) == 0
+        with pytest.raises(ValueError, match="not among"):
+            mh.rank_among([0, 2], 1)
+
+
+class TestLoadDegraded:
+    def test_survivor_resumes_with_dead_partitions_dropped(
+            self, tmp_path):
+        warm, w0 = _warm(prior_iters=5)
+        _two_host_save(tmp_path, warm, fingerprint="fp")
+        tel = Telemetry()
+        resumed = load_degraded(str(tmp_path), w0, surviving=[0],
+                                fingerprint="fp", telemetry=tel)
+        assert resumed is not None
+        loaded, decision, dropped = resumed
+        assert decision.allowed and decision.quorum == 0.5
+        assert loaded.elastic and loaded.saved_process_count == 2
+        # only the survivor's own partitions remain; the dead host's
+        # are reported dropped
+        assert loaded.partitions == ("part-0", "part-2")
+        assert dropped == ("part-1", "part-3")
+        # warm carry is the replicated state — any surviving copy
+        np.testing.assert_array_equal(np.asarray(loaded.warm.x),
+                                      np.asarray(warm.x))
+        assert int(loaded.warm.prior_iters) == 5
+        # row-sharded extras: only the surviving rows, re-split to 1
+        np.testing.assert_array_equal(loaded.row_state["rows"],
+                                      np.arange(4))
+        deg = [r for r in tel.records if r.get("kind") == "degraded"]
+        assert len(deg) == 1 and deg[0]["surviving"] == 1
+        assert deg[0]["lost"] == [1]
+        assert not schema.validate_record(json.loads(json.dumps(
+            deg[0], default=str)))
+        acts = [r for r in tel.records if r.get("kind") == "recovery"]
+        assert any(r["action"] == "degraded_continue" for r in acts)
+
+    def test_below_quorum_raises_typed(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm)
+        with pytest.raises(QuorumLost, match="quorum lost"):
+            load_degraded(str(tmp_path), w0, surviving=[1],
+                          policy=DegradePolicy(min_quorum=1.0))
+
+    def test_dead_shard_corruption_is_tolerated(self, tmp_path):
+        """The dead host may have died mid-write: its torn shard must
+        not block the survivors' resume."""
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm)
+        from spark_agd_tpu.resilience import manifest
+        m = manifest.load_manifest(str(tmp_path))
+        faults.truncate_file(m.shard_path(str(tmp_path), 1),
+                             keep_fraction=0.3)
+        resumed = load_degraded(str(tmp_path), w0, surviving=[0])
+        assert resumed is not None
+        assert resumed.loaded.partitions == ("part-0", "part-2")
+        # the dead shard was unreadable -> its partitions still count
+        # as dropped (known only from the manifest topology, not named)
+        assert resumed.dropped_partitions == ()
+
+    def test_surviving_shard_corruption_falls_back_a_generation(
+            self, tmp_path):
+        warm, w0 = _warm(prior_iters=3)
+        _two_host_save(tmp_path, warm, generations=2)
+        from spark_agd_tpu.resilience import manifest
+        newest = manifest.load_manifest(str(tmp_path))
+        assert newest.generation == 1
+        faults.scramble_file(newest.shard_path(str(tmp_path), 0),
+                             seed=5, n_bytes=64)
+        tel = Telemetry()
+        resumed = load_degraded(str(tmp_path), w0, surviving=[0],
+                                telemetry=tel)
+        assert resumed is not None
+        assert resumed.loaded.generation == 0
+        fb = [r for r in tel.records
+              if r.get("action") == "checkpoint_fallback"]
+        assert fb and fb[0]["generation"] == 1
+
+    def test_nothing_survives_returns_none(self, tmp_path):
+        warm, w0 = _warm()
+        _two_host_save(tmp_path, warm)
+        from spark_agd_tpu.resilience import manifest
+        m = manifest.load_manifest(str(tmp_path))
+        faults.truncate_file(m.shard_path(str(tmp_path), 0),
+                             keep_fraction=0.3)
+        assert load_degraded(str(tmp_path), w0, surviving=[0]) is None
+
+    def test_process_index_must_be_surviving(self, tmp_path):
+        warm, w0 = _warm()
+        with pytest.raises(ValueError, match="not in"):
+            load_degraded(str(tmp_path), w0, surviving=[0],
+                          process_index=1)
+
+
+class TestDegradedCheckpointer:
+    def test_load_memoized_and_saves_chain_on(self, tmp_path):
+        warm, w0 = _warm(prior_iters=5)
+        _two_host_save(tmp_path, warm, fingerprint="fp")
+        tel = Telemetry()
+        ck = DegradedCheckpointer(
+            str(tmp_path), surviving=[1], original_process_index=1,
+            every_iters=1, fingerprint="fp", telemetry=tel,
+            mesh_shape={"data": 1})
+        assert ck.process_index == 0 and ck.process_count == 1
+        loaded = ck.load(w0)
+        assert loaded is not None
+        assert loaded.partitions == ("part-1", "part-3")
+        assert ck.dropped_partitions == ("part-0", "part-2")
+        assert ck.last_decision is not None and ck.last_decision.allowed
+        # second load: memoized — no new degraded record emitted
+        n_deg = sum(1 for r in tel.records
+                    if r.get("kind") == "degraded")
+        assert ck.load(w0) is loaded
+        assert sum(1 for r in tel.records
+                   if r.get("kind") == "degraded") == n_deg
+        # the degraded run's own save is a first-class generation of
+        # the SURVIVING topology, resumable by a normal elastic load
+        ck._save(warm._replace(prior_iters=9), [0.3], False, False)
+        from spark_agd_tpu.resilience import load_for_topology, manifest
+        newest = manifest.load_manifest(str(tmp_path))
+        assert newest.process_count == 1
+        re = load_for_topology(str(tmp_path), w0, process_index=0,
+                               process_count=1, fingerprint="fp")
+        assert re is not None and int(re.warm.prior_iters) == 9
+
+
+# ---------------------------------------------------------------------------
+# the drill tool gate
+
+
+def _drill_cmd(tmp_path, *extra):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_drill.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(tool))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return ([sys.executable, tool, "--out", str(tmp_path / "drill")]
+            + list(extra)), env
+
+
+class TestChaosDrillTool:
+    def test_smoke_soak_exits_zero(self, tmp_path):
+        """exit-0/1 contract (same as the other fault drills): a small
+        randomized soak, single-process, tier-1-budget-friendly."""
+        cmd, env = _drill_cmd(tmp_path, "--campaigns", "3",
+                              "--skip-two-process")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=420, env=env)
+        assert proc.returncode == 0, \
+            f"drill failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}"
+        assert "CHAOS DRILL PASSED" in proc.stdout
+
+    @pytest.mark.slow
+    def test_full_soak_with_two_process_legs(self, tmp_path):
+        """The acceptance-criteria configuration: >= 20 randomized
+        campaigns plus the SIGKILL+torn-write and quorum-degrade
+        two-process legs (behind ``-m chaos``, excluded from tier-1 by
+        the slow marker)."""
+        cmd, env = _drill_cmd(tmp_path, "-v")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=560, env=env)
+        assert proc.returncode == 0, \
+            f"drill failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+        assert "CHAOS DRILL PASSED: 22 campaigns" in proc.stdout

@@ -1,0 +1,717 @@
+#!/usr/bin/env python
+"""The chaos SOAK drill — CI proof that the recovery machinery survives
+fault *sequences*, not just one scripted fault per drill.
+
+Three legs, all on CPU, all in one command (exit 0 = PASS, 1 = FAIL —
+the same contract as ``tools/fault_drill.py`` / ``dist_fault_drill.py``):
+
+1. **Randomized single-process soak** — ``--campaigns`` (default 20)
+   seeded campaigns (``resilience.chaos.ChaosCampaign.generate``), each
+   a deterministic multi-fault sequence (NaN poisons, device losses,
+   stragglers, SIGTERM preemptions, checkpoint truncation/scrambling at
+   relaunch, scripted fatal errors) against a supervised f64 logistic
+   fit.  Every campaign must end in **baseline-matching convergence**
+   (``--tol``, default 1e-6) or a **typed ``SupervisorGivingUp``** —
+   exactly when the campaign scripted a fatal — and never hang (bounded
+   relaunches + per-attempt watchdog + per-campaign wall-clock check).
+2. **Multi-fault two-process campaign** — 2 real gloo processes, a NaN
+   poison on BOTH (collective-lockstep rollback), a straggler sleep,
+   then one process SIGKILLs itself; the parent detects the death from
+   heartbeat staleness, byte-TRUNCATES the newest committed generation
+   (torn write), and resumes elastically as ONE process to the
+   uninterrupted 2-process baseline loss.
+3. **Quorum-degrade campaign** — same 2-process fit, SIGKILL again, but
+   the survivor CONTINUES DEGRADED instead of restarting the world:
+   ``DegradePolicy`` admits the 1-of-2 quorum, ``load_degraded`` reads
+   only the surviving shard, the dead host's data partitions are
+   dropped, and training proceeds on the survivors' rows — pinned to a
+   degraded ORACLE (uninterrupted run: full data to the kill point,
+   surviving partitions after) within ``--tol``.  A ``min_quorum=1.0``
+   policy must refuse with a typed ``QuorumLost``.
+
+Every campaign writes two streams: the JSONL telemetry and the
+CRC-framed recovery journal (``resilience.journal``).  The drill
+replays every journal and asserts (a) the replay is **bit-identical**
+to the payloads the live run appended, (b) the exactly-once segment
+census (``segment_accounting``) equals the iterations that counted,
+and (c) every record in every stream validates against ``obs.schema``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py [-v] [--campaigns N]
+        [--skip-two-process] [--out DIR]
+
+See ``docs/ROBUSTNESS.md`` §chaos-campaigns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_FEATURES = 6
+REG = 0.1
+
+
+def _configure_jax(n_devices: int = 1, gloo: bool = True):
+    """Platform + f64 precision config, BEFORE any backend use (same
+    ordering contract as tools/dist_fault_drill.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}")
+    if gloo:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — newer jax: default works
+            pass
+    return jax
+
+
+def _policy(args):
+    from spark_agd_tpu.resilience import ResiliencePolicy
+
+    return ResiliencePolicy(
+        max_attempts=3, backoff_base=0.01, backoff_max=0.05, jitter=0.0,
+        seed=0, segment_iters=args.segment, attempt_timeout=120.0)
+
+
+def _dist_problem(args, mesh, paths=None):
+    """The staged distributed smooth over partitioned-file ingest —
+    shared by the two-process children, the elastic resume, the
+    degraded continuation, and the degrade oracle (which passes an
+    explicit ``paths`` subset)."""
+    import numpy as np
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.data import ingest
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    if paths is None:
+        paths = sorted(glob.glob(os.path.join(args.workdir, "parts",
+                                              "part-*.libsvm")))
+    assert len(paths) >= 1, paths
+    from spark_agd_tpu.parallel import dist_smooth
+
+    batch = ingest.from_partitioned_files(
+        paths, mesh, n_features=N_FEATURES, dtype=np.float64,
+        validate="raise")
+    build, dargs = dist_smooth.make_dist_smooth_staged(
+        LogisticGradient(), batch, mesh=mesh)
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    w0 = np.zeros(N_FEATURES, np.float64)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=args.iters)
+    return paths, (build, dargs), px, rv, w0, cfg
+
+
+def _two_proc_campaign(args, phase: str):
+    """The scripted faults of the two-process legs — explicit, not
+    generated: numeric faults target EVERY process (lockstep), the
+    kill targets the victim."""
+    from spark_agd_tpu.resilience import ChaosCampaign, ScheduledFault
+
+    if phase == "chaosA":
+        faults = (
+            ScheduledFault("nan", args.nan_at),
+            ScheduledFault("slow_host", args.nan_at + 2,
+                           process=1 - args.kill_pid, payload=0.05),
+            ScheduledFault("sigkill", args.kill_at,
+                           process=args.kill_pid),
+        )
+    else:  # chaosB: a clean kill — the degrade leg
+        faults = (ScheduledFault("sigkill", args.kill_at,
+                                 process=args.kill_pid),)
+    return ChaosCampaign(seed=args.seed, faults=faults,
+                         iters=args.iters, process_count=2)
+
+
+def child_main(args) -> int:
+    """One SPMD process of phase ``baseline`` / ``chaosA`` / ``chaosB``."""
+    jax = _configure_jax(1)
+
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.obs import JSONLSink, Telemetry
+    from spark_agd_tpu.parallel import mesh as mesh_lib, multihost as mh
+    from spark_agd_tpu.data import ingest
+    from spark_agd_tpu.resilience import (DistributedCheckpointer,
+                                          HeartbeatWriter, Journal,
+                                          JournalSink,
+                                          run_agd_supervised)
+    from spark_agd_tpu.utils import checkpoint as ckpt
+
+    mh.initialize(args.addr, args.nproc, args.pid)
+    assert jax.process_count() == args.nproc
+    mesh = mesh_lib.make_mesh({"data": len(jax.devices())})
+
+    paths, staged, px, rv, w0, cfg = _dist_problem(args, mesh)
+    policy = _policy(args)
+    jsonl = mh.host_suffixed(os.path.join(
+        args.workdir, f"drill-{args.phase}.jsonl"))
+    # fsync per append: the journal must survive the SIGKILL
+    journal = Journal(mh.host_suffixed(os.path.join(
+        args.workdir, f"drill-{args.phase}.journal")), fsync=True)
+    tel = Telemetry([JSONLSink(jsonl), JournalSink(journal)])
+    tel.journal_replay(**journal.replay_summary)
+    hb = HeartbeatWriter(os.path.join(args.workdir, "hb", args.phase),
+                         telemetry=tel)
+
+    def place_w(w):
+        return mesh_lib.replicate(
+            jax.tree_util.tree_map(jnp.asarray, w), mesh)
+
+    kwargs = dict(prox=px, reg_value=rv, w0=w0, config=cfg,
+                  policy=policy, staged=staged, telemetry=tel,
+                  heartbeat=hb, place_w=place_w,
+                  stream_iterations=False)
+    if args.phase != "baseline":
+        fp = ckpt.problem_fingerprint(w0, cfg)
+        kwargs["checkpointer"] = DistributedCheckpointer(
+            os.path.join(args.workdir, f"ckpt-{args.phase}"),
+            every_iters=args.segment, keep=6, fingerprint=fp,
+            telemetry=tel, mesh_shape=dict(mesh.shape),
+            partitions=ingest.local_partitions(paths))
+        campaign = _two_proc_campaign(args, args.phase)
+        kwargs["faults"] = campaign.schedule_for(args.pid,
+                                                 telemetry=tel)
+
+    res = run_agd_supervised(**kwargs)
+    tel.flush()
+    if args.phase == "baseline" and args.pid == 0:
+        with open(os.path.join(args.workdir, "baseline.json"), "w") as f:
+            json.dump({"final_loss": float(res.loss_history[-1]),
+                       "num_iters": int(res.num_iters)}, f)
+    print(f"DRILL_CHILD_OK phase={args.phase} pid={args.pid} "
+          f"iters={res.num_iters} "
+          f"loss={float(res.loss_history[-1]):.12f}", flush=True)
+    return 0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_children(args, phase: str, port: int):
+    me = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(me))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return [
+        subprocess.Popen(
+            [sys.executable, me, "--child", "--phase", phase,
+             "--addr", f"localhost:{port}", "--nproc", "2",
+             "--pid", str(i), "--workdir", args.workdir,
+             "--iters", str(args.iters), "--segment", str(args.segment),
+             "--kill-at", str(args.kill_at),
+             "--kill-pid", str(args.kill_pid),
+             "--nan-at", str(args.nan_at), "--seed", str(args.seed)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        for i in range(2)
+    ]
+
+
+def _await_host_loss(args, check, phase: str, tel):
+    """Block until the victim's heartbeat goes stale; reap the blocked
+    survivor (its collective can never complete against a dead peer)."""
+    from spark_agd_tpu.resilience import HostLost, HostMonitor
+
+    monitor = HostMonitor(
+        os.path.join(args.workdir, "hb", phase),
+        expected=[args.kill_pid], stale_after_s=2.0, telemetry=tel)
+    lost = None
+    deadline = time.monotonic() + 60
+    while lost is None and time.monotonic() < deadline:
+        try:
+            monitor.check()
+            time.sleep(0.25)
+        except HostLost as e:
+            lost = e
+    check(lost is not None and lost.process_index == args.kill_pid,
+          f"[{phase}] heartbeat monitor detected the lost host ({lost})")
+    return lost
+
+
+def _validate_streams(args, check, label: str, paths):
+    """Schema-validate every record of every JSONL/journal stream."""
+    from spark_agd_tpu.obs import schema
+    from spark_agd_tpu.resilience import journal as journal_lib
+
+    records = []
+    for path in paths:
+        if path.endswith(".jsonl") or ".jsonl." in os.path.basename(path):
+            records.extend(schema.read_jsonl(path))
+        else:
+            records.extend(journal_lib.replay(path).records)
+    invalid = [(i, errs) for i, rec in enumerate(records, 1)
+               if (errs := schema.validate_record(
+                   json.loads(json.dumps(rec, default=str))))]
+    check(not invalid,
+          f"[{label}] all {len(records)} records across "
+          f"{len(paths)} streams are schema-valid"
+          + (f" (first bad: {invalid[0]})" if invalid else ""))
+    return records
+
+
+def single_process_soak(args, check):
+    """Leg 1: the randomized seeded campaigns, in-process."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.data import synthetic
+    from spark_agd_tpu.obs import JSONLSink, Telemetry
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.resilience import (ChaosCampaign, Journal,
+                                          JournalSink, journal as jl,
+                                          run_agd_supervised,
+                                          run_campaign)
+
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 300, 42)
+    X = synthetic.with_intercept_column(X).astype(np.float64)
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    w0 = jnp.zeros(2, jnp.float64)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=args.iters)
+    policy = _policy(args)
+    seg_cache: dict = {}
+
+    base = run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                              policy=policy, staged=(build, dargs),
+                              seg_cache=seg_cache,
+                              stream_iterations=False)
+    base_loss = float(base.loss_history[-1])
+    jax.block_until_ready(base.weights)
+    if args.verbose:
+        print(f"soak baseline: {base.num_iters} iters, final loss "
+              f"{base_loss:.12f}")
+
+    outcomes = {"converged": 0, "gave_up": 0}
+    for i in range(args.campaigns):
+        seed = args.seed + i
+        campaign = ChaosCampaign.generate(seed, iters=args.iters)
+        wd = os.path.join(args.workdir, f"campaign-{i:03d}")
+        os.makedirs(wd, exist_ok=True)
+        journal = Journal(os.path.join(wd, "run.journal"))
+        tel = Telemetry([JSONLSink(os.path.join(wd, "run.jsonl")),
+                         JournalSink(journal)],
+                        run_id=f"chaos-{seed}")
+        tel.journal_replay(**journal.replay_summary)
+        t0 = time.monotonic()
+        res = run_campaign(
+            campaign, staged=(build, dargs), prox=px, reg_value=rv,
+            w0=w0, config=cfg, policy=policy, workdir=wd,
+            baseline_loss=base_loss, telemetry=tel,
+            seg_cache=seg_cache, tol=args.tol)
+        tel.flush()
+        dt = time.monotonic() - t0
+        tag = f"campaign {i} (seed {seed}: {campaign.describe()})"
+        check(dt < args.campaign_budget_s,
+              f"{tag} finished in {dt:.1f}s < "
+              f"{args.campaign_budget_s:g}s (no hang)")
+        if campaign.expects_giveup:
+            check(res.outcome == "gave_up",
+                  f"{tag} ended in typed SupervisorGivingUp "
+                  f"({res.giveup_message})")
+        else:
+            check(res.outcome == "converged",
+                  f"{tag} converged to baseline "
+                  f"(outcome={res.outcome}, diff={res.diff})")
+        outcomes[res.outcome] = outcomes.get(res.outcome, 0) + 1
+
+        # the journal evidence: bit-identical replay + exactly-once
+        # segment accounting + schema validity
+        rep = jl.replay(journal.path)
+        check(rep.reason is None and
+              [bytes(p) for p in rep.payloads] == journal.written,
+              f"{tag}: journal replay is bit-identical to the live "
+              f"decision sequence ({len(rep.records)} records)")
+        if res.outcome == "converged":
+            accounted = sum(jl.segment_accounting(rep.records).values())
+            check(accounted == res.num_iters,
+                  f"{tag}: exactly-once census {accounted} == "
+                  f"{res.num_iters} iterations that counted")
+        n_chaos = sum(1 for r in rep.records if r.get("kind") == "chaos"
+                      and "fired_iter" in r)
+        check(n_chaos == len(res.fired),
+              f"{tag}: every fired fault journaled "
+              f"({n_chaos} == {len(res.fired)})")
+        _validate_streams(args, check, tag,
+                          [os.path.join(wd, "run.jsonl"), journal.path])
+        journal.close()
+    if args.campaigns >= 10:
+        # a big enough seed range statistically contains both a fatal
+        # campaign and recoverable ones; tiny smoke runs skip the check
+        check(outcomes.get("converged", 0) > 0
+              and outcomes.get("gave_up", 0) > 0,
+              f"the soak exercised both terminal outcomes ({outcomes})")
+    return base_loss
+
+
+def two_process_legs(args, check):
+    """Legs 2+3: the SIGKILL + torn-write campaign and the
+    quorum-degrade campaign, against 2 real gloo processes."""
+    import numpy as np
+
+    from spark_agd_tpu.data import libsvm
+    from spark_agd_tpu.obs import JSONLSink, Telemetry
+    from spark_agd_tpu.resilience import (DegradePolicy, Journal,
+                                          JournalSink, QuorumLost,
+                                          journal as jl, manifest)
+
+    # partition files: 4 equal parts (no inter-host padding)
+    rng = np.random.default_rng(7)
+    os.makedirs(os.path.join(args.workdir, "parts"), exist_ok=True)
+    n_per, d = 25, N_FEATURES
+    w_true = np.linspace(-1.0, 1.0, d)
+    for k in range(4):
+        X = rng.standard_normal((n_per, d)).astype(np.float32)
+        y = np.where(X @ w_true + 0.3 * rng.standard_normal(n_per) > 0,
+                     1.0, -1.0)
+        libsvm.save_libsvm(
+            os.path.join(args.workdir, "parts", f"part-{k}.libsvm"),
+            X, y)
+
+    # -- uninterrupted 2-process baseline ---------------------------------
+    procs = _spawn_children(args, "baseline", _free_port())
+    outs = _reap(procs, timeout=420)
+    for i, (rc, out, err) in enumerate(outs):
+        check(rc == 0 and "DRILL_CHILD_OK" in out,
+              f"[baseline] child {i} completed (rc={rc})"
+              + ("" if rc == 0 else f"\n{err[-2000:]}"))
+    base_path = os.path.join(args.workdir, "baseline.json")
+    if not os.path.exists(base_path):
+        check(False, "[baseline] baseline.json written by process 0")
+        return
+    with open(base_path) as f:
+        base_loss = float(json.load(f)["final_loss"])
+    if args.verbose:
+        print(f"2-process baseline: final loss {base_loss:.12f}")
+
+    parent_jsonl = os.path.join(args.workdir, "drill-parent.jsonl")
+    parent_journal = Journal(os.path.join(args.workdir,
+                                          "drill-parent.journal"))
+    tel = Telemetry([JSONLSink(parent_jsonl),
+                     JournalSink(parent_journal)])
+    tel.journal_replay(**parent_journal.replay_summary)
+
+    # -- leg 2: multi-fault campaign, SIGKILL + torn write ----------------
+    procs = _spawn_children(args, "chaosA", _free_port())
+    killed_rc = procs[args.kill_pid].wait(timeout=420)
+    check(killed_rc == -signal.SIGKILL,
+          f"[chaosA] process {args.kill_pid} died by SIGKILL "
+          f"(rc={killed_rc})")
+    _await_host_loss(args, check, "chaosA", tel)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait(timeout=60)
+
+    ckpt_dir = os.path.join(args.workdir, "ckpt-chaosA")
+    gens = manifest.committed_generations(ckpt_dir)
+    check(len(gens) >= 2,
+          f"[chaosA] the barrier committed >= 2 generations ({gens})")
+    if not gens:
+        return
+    newest = manifest.load_manifest(ckpt_dir, gens[0])
+    shard0 = newest.shard_path(ckpt_dir, 0)
+    from spark_agd_tpu.resilience import faults
+    faults.truncate_file(shard0, keep_fraction=0.4)
+    tel.chaos(fault="truncate_ckpt",
+              outcome=f"torn {os.path.basename(shard0)}",
+              seed=args.seed)
+    if args.verbose:
+        print(f"[chaosA] truncated {os.path.basename(shard0)} "
+              f"(generation {newest.generation})")
+
+    # the victim's fsynced journal must carry the kill decision and the
+    # shared NaN rollback, committed before death
+    from spark_agd_tpu.parallel import multihost as mh  # noqa: F401
+    victim_journal = os.path.join(
+        args.workdir, f"drill-chaosA.h{args.kill_pid:03d}.journal")
+    vrep = jl.replay(victim_journal)
+    vseq = jl.decision_sequence(vrep.records)
+    check(("chaos", "sigkill", args.kill_at, args.kill_pid)
+          in [t[:4] for t in vseq if t[0] == "chaos"],
+          f"[chaosA] the victim's journal committed the sigkill "
+          f"decision before dying ({len(vrep.records)} records)")
+    check(any(t[0] == "recovery" and t[1] == "rollback" for t in vseq),
+          "[chaosA] the victim's journal carries the shared NaN "
+          "rollback decision")
+
+    # elastic 1-process resume over ALL partitions
+    jax = _configure_jax(1, gloo=False)
+    from spark_agd_tpu.parallel import mesh as mesh_lib
+    from spark_agd_tpu.resilience import (DistributedCheckpointer,
+                                          run_agd_supervised)
+    from spark_agd_tpu.utils import checkpoint as ckpt_lib
+
+    mesh = mesh_lib.make_mesh({"data": len(jax.devices())})
+    paths, staged, px, rv, w0, cfg = _dist_problem(args, mesh)
+    fp = ckpt_lib.problem_fingerprint(w0, cfg)
+    ck = DistributedCheckpointer(
+        ckpt_dir, every_iters=args.segment, keep=6, fingerprint=fp,
+        telemetry=tel, mesh_shape=dict(mesh.shape),
+        process_index=0, process_count=1)
+    res = run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg,
+                             policy=_policy(args), staged=staged,
+                             telemetry=tel, checkpointer=ck,
+                             stream_iterations=False)
+    tel.flush()
+    check(res.resumed_from > 0,
+          f"[chaosA] elastic resume continued from iteration "
+          f"{res.resumed_from}, not from scratch")
+    diff = abs(float(res.loss_history[-1]) - base_loss)
+    check(diff <= args.tol,
+          f"[chaosA] resumed 1-process final loss matches the "
+          f"2-process baseline (|diff| = {diff:.2e} <= {args.tol:g})")
+
+    # -- leg 3: quorum-degrade campaign -----------------------------------
+    procs = _spawn_children(args, "chaosB", _free_port())
+    killed_rc = procs[args.kill_pid].wait(timeout=420)
+    check(killed_rc == -signal.SIGKILL,
+          f"[chaosB] process {args.kill_pid} died by SIGKILL "
+          f"(rc={killed_rc})")
+    _await_host_loss(args, check, "chaosB", tel)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait(timeout=60)
+
+    from spark_agd_tpu.resilience import DegradedCheckpointer
+
+    ckpt_dir_b = os.path.join(args.workdir, "ckpt-chaosB")
+    survivor = 1 - args.kill_pid
+    # below-quorum refusal is TYPED, and checked before any shard read
+    try:
+        DegradedCheckpointer(
+            ckpt_dir_b, surviving=[survivor],
+            original_process_index=survivor,
+            degrade_policy=DegradePolicy(min_quorum=1.0),
+            every_iters=args.segment, fingerprint=fp).load(w0)
+        check(False, "[chaosB] min_quorum=1.0 refused the 1-of-2 "
+                     "continuation with QuorumLost")
+    except QuorumLost as e:
+        check(True, f"[chaosB] below-quorum refusal is typed ({e})")
+
+    ck_deg = DegradedCheckpointer(
+        ckpt_dir_b, surviving=[survivor],
+        original_process_index=survivor,
+        degrade_policy=DegradePolicy(min_quorum=0.5),
+        every_iters=args.segment, keep=6, fingerprint=fp,
+        telemetry=tel, mesh_shape=dict(mesh.shape))
+    loaded = ck_deg.load(w0)
+    check(loaded is not None and loaded.partitions is not None,
+          f"[chaosB] degraded load found a generation "
+          f"(gen {getattr(loaded, 'generation', None)})")
+    if loaded is None:
+        return
+    surv_parts = sorted(loaded.partitions)
+    expect_parts = sorted(paths)[survivor::2]
+    check(surv_parts == sorted(expect_parts),
+          f"[chaosB] surviving partitions are the survivor's own "
+          f"({[os.path.basename(p) for p in surv_parts]})")
+    check(len(ck_deg.dropped_partitions) == 2,
+          f"[chaosB] the dead host's 2 partitions were dropped "
+          f"({[os.path.basename(p) for p in ck_deg.dropped_partitions]})")
+    resume_iter = int(loaded.warm.prior_iters)
+    check(resume_iter > 0,
+          f"[chaosB] degraded resume continues from iteration "
+          f"{resume_iter}")
+
+    # degraded continuation: train on the surviving partitions only
+    _, staged_deg, px, rv, w0, cfg = _dist_problem(args, mesh,
+                                                   paths=surv_parts)
+    res_deg = run_agd_supervised(
+        prox=px, reg_value=rv, w0=w0, config=cfg, policy=_policy(args),
+        staged=staged_deg, telemetry=tel, checkpointer=ck_deg,
+        stream_iterations=False)
+    tel.flush()
+    deg_loss = float(res_deg.loss_history[-1])
+
+    # the degraded ORACLE: an uninterrupted run that trains on the full
+    # data to the kill point, then on the surviving partitions — the
+    # trajectory the degraded continuation claims to be on
+    from spark_agd_tpu.resilience import AutoCheckpointer
+    import dataclasses as _dc
+
+    oracle_ckpt = os.path.join(args.workdir, "oracle_ckpt.npz")
+    _, staged_full, px, rv, w0, cfg = _dist_problem(args, mesh)
+    cfg_head = _dc.replace(cfg, num_iterations=resume_iter)
+    run_agd_supervised(prox=px, reg_value=rv, w0=w0, config=cfg_head,
+                       policy=_policy(args), staged=staged_full,
+                       checkpointer=AutoCheckpointer(
+                           oracle_ckpt, every_iters=args.segment),
+                       stream_iterations=False)
+    res_oracle = run_agd_supervised(
+        prox=px, reg_value=rv, w0=w0, config=cfg,
+        policy=_policy(args), staged=staged_deg,
+        checkpointer=AutoCheckpointer(oracle_ckpt,
+                                      every_iters=args.segment),
+        stream_iterations=False)
+    oracle_loss = float(res_oracle.loss_history[-1])
+    diff = abs(deg_loss - oracle_loss)
+    check(diff <= args.tol,
+          f"[chaosB] degraded continuation matches the degraded oracle "
+          f"(|{deg_loss:.12f} - {oracle_loss:.12f}| = {diff:.2e} "
+          f"<= {args.tol:g})")
+    check(abs(oracle_loss - base_loss) > args.tol,
+          "[chaosB] the degraded objective genuinely differs from the "
+          f"full-data baseline (|{oracle_loss:.8f} - {base_loss:.8f}|"
+          " > tol — the re-weighting is real)")
+
+    # every two-process stream (JSONLs + journals, all hosts + parent)
+    streams = sorted(
+        glob.glob(os.path.join(args.workdir, "drill-*.jsonl*"))
+        + glob.glob(os.path.join(args.workdir, "drill-*.journal*")))
+    records = _validate_streams(args, check, "2-process", streams)
+    kinds = {r.get("kind") for r in records}
+    for kind in ("heartbeat", "chaos", "journal_replay", "degraded"):
+        check(kind in kinds, f"[2-process] {kind!r} records present")
+    actions = {r.get("action") for r in records
+               if r.get("kind") == "recovery"}
+    for action in ("checkpoint", "checkpoint_fallback", "elastic_resume",
+                   "host_lost", "rollback", "degraded_continue"):
+        check(action in actions,
+              f"[2-process] recovery action {action!r} recorded")
+    # the parent journal replays bit-identically too
+    prep = jl.replay(parent_journal.path)
+    check(prep.reason is None and
+          [bytes(p) for p in prep.payloads] == parent_journal.written,
+          f"[2-process] parent journal replay is bit-identical "
+          f"({len(prep.records)} records)")
+    parent_journal.close()
+
+
+def _reap(procs, timeout):
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def parent_main(args) -> int:
+    import tempfile
+
+    failures: list = []
+
+    def check(ok: bool, what: str):
+        tag = "ok" if ok else "FAIL"
+        if not ok:
+            failures.append(what)
+        if args.verbose or not ok:
+            print(f"{tag}: {what}")
+
+    args.workdir = args.out or tempfile.mkdtemp(prefix="chaos_drill_")
+    os.makedirs(args.workdir, exist_ok=True)
+    for stale in glob.glob(os.path.join(args.workdir, "*.json*")) \
+            + glob.glob(os.path.join(args.workdir, "*.journal")) \
+            + glob.glob(os.path.join(args.workdir, "*.npz*")) \
+            + glob.glob(os.path.join(args.workdir, "ckpt-*", "*")) \
+            + glob.glob(os.path.join(args.workdir, "campaign-*", "*")) \
+            + glob.glob(os.path.join(args.workdir, "hb", "*", "*")):
+        os.unlink(stale)
+
+    _configure_jax(1, gloo=False)
+    n_campaigns = args.campaigns
+    single_process_soak(args, check)
+    if not args.skip_two_process:
+        two_process_legs(args, check)
+        n_campaigns += 2
+
+    if failures:
+        print(f"CHAOS DRILL FAILED ({len(failures)} checks):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"CHAOS DRILL PASSED: {n_campaigns} campaigns "
+          f"({args.campaigns} randomized"
+          + ("" if args.skip_two_process
+             else " + SIGKILL/torn-write + quorum-degrade")
+          + ") all ended in baseline-matching convergence or typed "
+            "give-up; journals replay bit-identically; artifacts under "
+          + args.workdir)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/chaos_drill.py",
+        description="randomized multi-fault chaos soak "
+                    "(exit 0 = every campaign recovered or gave up "
+                    "typed)")
+    p.add_argument("--child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--phase", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--addr", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--nproc", type=int, default=2,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--pid", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--workdir", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--campaigns", type=int, default=20,
+                   help="randomized single-process campaigns "
+                        "(default 20)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="base seed; campaign i uses seed+i (default 0)")
+    p.add_argument("--iters", type=int, default=48,
+                   help="iteration budget per campaign (default 48)")
+    p.add_argument("--segment", type=int, default=4,
+                   help="segment length = checkpoint cadence (default 4)")
+    p.add_argument("--kill-at", type=int, default=16,
+                   help="two-process legs: SIGKILL the victim at this "
+                        "iteration (default 16)")
+    p.add_argument("--kill-pid", type=int, default=1,
+                   help="which of the two processes dies (default 1)")
+    p.add_argument("--nan-at", type=int, default=6,
+                   help="two-process leg 2: NaN-poison both processes "
+                        "at this iteration (default 6)")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="|final loss - baseline| bound (default 1e-6; "
+                        "the drill runs in f64)")
+    p.add_argument("--campaign-budget-s", type=float, default=120.0,
+                   help="per-campaign wall-clock bound — the no-hang "
+                        "check (default 120)")
+    p.add_argument("--skip-two-process", action="store_true",
+                   help="randomized single-process soak only (fast CI)")
+    p.add_argument("--out", default=None,
+                   help="artifact directory (default: a fresh temp dir)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
