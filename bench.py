@@ -319,6 +319,17 @@ def _make_step(gradient, Xd, yd, num_iterations, loss_mode="x"):
     return _BoundStep(fit.jitted_step, fit.data_args)
 
 
+def _donation_safe(w):
+    """A fresh buffer per call: the runner step DONATES its carry
+    (api.make_runner donate_argnums=0), and the ladder reuses one
+    device-placed ``w0`` across repeated timing calls — handing the
+    program the caller's buffer would delete it after the first call."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), w)
+
+
 class _BoundStep:
     """A jitted ``step(w, data)`` with the data pre-bound as ARGUMENTS —
     call/lower/compile look exactly like the old closure-style
@@ -329,7 +340,7 @@ class _BoundStep:
         self._dargs = dargs
 
     def __call__(self, w):
-        return self._jitted(w, self._dargs)
+        return self._jitted(_donation_safe(w), self._dargs)
 
     def lower(self, w):
         return _BoundLowered(self._jitted.lower(w, self._dargs),
@@ -351,7 +362,7 @@ class _BoundCompiled:
         self._dargs = dargs
 
     def __call__(self, w):
-        return self._compiled(w, self._dargs)
+        return self._compiled(_donation_safe(w), self._dargs)
 
 
 def _time_step(step, w0):

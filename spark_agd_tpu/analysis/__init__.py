@@ -1,0 +1,63 @@
+"""graftlint: JAX-aware static analysis + compiled-program contract pins.
+
+The static half (stdlib-only, no backend) is an AST lint framework with
+repo-specific rules for the hazard classes PR 5 fixed by hand-review:
+
+- ``constant-capture`` — arrays closed over by jit-compiled functions
+  (embedded program constants);
+- ``host-sync`` — ``float()``/``.item()``/``bool()``/``np.asarray()``
+  on device values inside host iteration loops in the hot-path
+  subsystems;
+- ``donation`` — carry-shaped jit arguments without ``donate_argnums``,
+  and reuse of a donated buffer after the call;
+- ``recompile-hazard`` — loop-varying values reaching static argnums, or
+  ``jax.jit`` called inside a host loop;
+- ``np-jnp-mix`` / ``f64-literal`` — numpy ops and f64 dtypes in traced
+  code;
+- ``schema-drift`` — telemetry emit sites vs ``obs/schema.py``.
+
+The dynamic half (``analysis.contracts``) verifies the riskiest static
+claims against the real XLA program: an embedded-constant byte budget,
+donation honored in the input-output aliasing, and a collective census
+matching the checked-in ``pins.json``.
+
+CLI: ``python tools/graft_lint.py [paths...]`` — exit 0/1, text+JSON,
+``# graftlint: disable=<rule>`` inline waivers, baseline grandfathering.
+See ``docs/STATIC_ANALYSIS.md``.
+"""
+
+from . import contracts
+from .framework import (Finding, Module, Rule, apply_baseline,
+                        lint_modules, lint_paths, lint_source,
+                        load_baseline, save_baseline)
+from .rules_host import HostSyncRule
+from .rules_jit import (ConstantCaptureRule, DonationRule,
+                        RecompileHazardRule)
+from .rules_numeric import F64LiteralRule, NpJnpMixRule
+from .rules_schema import SchemaDriftRule
+
+
+def default_rules():
+    """One fresh instance of every shipped rule (fresh because rules may
+    carry per-run caches, e.g. the schema module)."""
+    return [
+        ConstantCaptureRule(),
+        HostSyncRule(),
+        DonationRule(),
+        RecompileHazardRule(),
+        NpJnpMixRule(),
+        F64LiteralRule(),
+        SchemaDriftRule(),
+    ]
+
+
+RULE_NAMES = tuple(r.name for r in default_rules())
+
+__all__ = [
+    "Finding", "Module", "Rule", "apply_baseline", "contracts",
+    "default_rules", "lint_modules", "lint_paths", "lint_source",
+    "load_baseline", "save_baseline", "RULE_NAMES",
+    "ConstantCaptureRule", "HostSyncRule", "DonationRule",
+    "RecompileHazardRule", "NpJnpMixRule", "F64LiteralRule",
+    "SchemaDriftRule",
+]

@@ -1,0 +1,249 @@
+"""Dynamic contract pins: verify the lint's riskiest claims against the
+REAL compiled program.
+
+Static analysis argues about source text; XLA argues back.  The two
+claims graftlint makes that are worth real money — "no dataset rides the
+program as an embedded constant" and "the carry is donated" — are
+verified here against the ``jax.stages.Compiled`` the public runners
+actually execute (via their ``lower_step`` AOT hooks and
+``obs.introspect``), plus a third pin the ROADMAP inner-loop work
+depends on: the per-program **collective census** must match a
+checked-in pin file, so a PR that silently adds an all-reduce to the
+hot loop fails the gate before any TPU time is spent.
+
+Checked-in pins live in ``analysis/pins.json``: per program label, the
+expected collective census, a byte budget for embedded constants, and
+whether donation must be honored in the input-output aliasing.
+
+Violations serialize as the ``contract_pin`` record kind of
+``obs.schema`` so run-record JSONLs carry them next to the metrics and
+``tools/agd_report.py`` can surface them.
+
+Unlike the rest of ``analysis`` this module imports jax (lazily, inside
+the entry points) — it is the opt-in dynamic half
+(``tools/graft_lint.py --contracts``); the static gate stays
+backend-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_PINS_PATH = os.path.join(os.path.dirname(__file__), "pins.json")
+
+# bytes per element for the HLO shape prefixes XLA emits
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `  %x = f32[128,64]{1,0} constant({...})` — the embedded-literal form;
+# scalars print as f32[] (empty dims -> product 1)
+_CONST_RE = re.compile(
+    r"=\s*([a-z][a-z0-9]*)\[([\d,]*)\][^ ]*\s+constant\(")
+
+# a 1 MiB ceiling: orders of magnitude above the scalar/iota constants a
+# staged program legitimately embeds, orders below any real dataset
+DEFAULT_CONSTANT_BUDGET = 1 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractViolation:
+    """One failed pin; ``contract`` is ``constant-bytes`` / ``donation``
+    / ``collective-census``."""
+
+    contract: str
+    label: str
+    message: str
+    observed: Any = None
+    expected: Any = None
+
+    def format(self) -> str:
+        return f"[{self.contract}] {self.label}: {self.message}"
+
+
+def embedded_constant_bytes(hlo_text: str) -> int:
+    """Total bytes of array literals embedded in optimized-HLO text —
+    the quantity the constant-capture rule bounds.  Unknown dtype
+    prefixes count 4 bytes/element (conservative, never zero)."""
+    total = 0
+    for dtype, dims in _CONST_RE.findall(hlo_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def donation_honored(hlo_text: str) -> bool:
+    """Whether the compiled program aliases any input to an output —
+    what ``donate_argnums`` becomes when XLA honors it."""
+    return "input_output_alias" in hlo_text
+
+
+# ---------------------------------------------------------------------------
+# pins
+
+def load_pins(path: Optional[str] = None) -> Dict[str, dict]:
+    """The checked-in pin table: ``label -> {"collectives": {...},
+    "max_constant_bytes": int, "donation": bool}``."""
+    path = path or DEFAULT_PINS_PATH
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    pins = data.get("pins")
+    if not isinstance(pins, dict):
+        raise ValueError(f"{path}: expected an object with a 'pins' map")
+    return pins
+
+
+def check_constant_budget(hlo_text: str, label: str,
+                          budget_bytes: int = DEFAULT_CONSTANT_BUDGET
+                          ) -> List[ContractViolation]:
+    observed = embedded_constant_bytes(hlo_text)
+    if observed > budget_bytes:
+        return [ContractViolation(
+            "constant-bytes", label,
+            f"{observed} bytes of array literals embedded in the "
+            f"compiled program (budget {budget_bytes}) — some array "
+            "is riding as a closure constant instead of an argument",
+            observed=observed, expected=budget_bytes)]
+    return []
+
+
+def check_donation(hlo_text: str, label: str, expect: bool = True
+                   ) -> List[ContractViolation]:
+    honored = donation_honored(hlo_text)
+    if expect and not honored:
+        return [ContractViolation(
+            "donation", label,
+            "no input-output aliasing in the compiled program — the "
+            "carry donation is missing or was not honored",
+            observed=False, expected=True)]
+    return []
+
+
+def check_collective_census(census: Dict[str, int], label: str,
+                            pin: Dict[str, int]
+                            ) -> List[ContractViolation]:
+    """The compiled program's per-collective counts must EQUAL the pin —
+    a new collective in the hot loop is a review event, not a drift."""
+    out: List[ContractViolation] = []
+    for op in sorted(set(pin) | set(census)):
+        want, got = int(pin.get(op, 0)), int(census.get(op, 0))
+        if want != got:
+            out.append(ContractViolation(
+                "collective-census", label,
+                f"{op}: compiled program has {got}, pin says {want}",
+                observed={op: got}, expected={op: want}))
+    return out
+
+
+def check_runner(fit, w0, *, label: str,
+                 pins: Optional[Dict[str, dict]] = None,
+                 budget_bytes: Optional[int] = None,
+                 expect_donation: Optional[bool] = None,
+                 ) -> Tuple[List[ContractViolation], Any]:
+    """Run every pin against the ONE program ``fit`` executes (via its
+    ``lower_step`` AOT hook — see ``obs.introspect.analyze_runner``).
+
+    ``pins`` (default: the checked-in ``pins.json``) supplies the
+    per-label expectations; explicit ``budget_bytes`` /
+    ``expect_donation`` override it.  Returns ``(violations,
+    ProgramCost)``.
+    """
+    from ..obs import introspect
+
+    lower = getattr(fit, "lower_step", None)
+    if lower is None:
+        raise TypeError(
+            "fit has no lower_step AOT hook; pass an api.make_runner / "
+            "api.make_lbfgs_runner fit")
+    compiled = lower(w0).compile()
+    hlo = compiled.as_text()
+    cost = introspect.analyze_compiled(compiled, label=label)
+
+    pin = {} if pins is None else dict(pins.get(label, {}))
+    if pins is None and os.path.exists(DEFAULT_PINS_PATH):
+        pin = dict(load_pins().get(label, {}))
+    budget = budget_bytes if budget_bytes is not None else int(
+        pin.get("max_constant_bytes", DEFAULT_CONSTANT_BUDGET))
+    donate = expect_donation if expect_donation is not None else bool(
+        pin.get("donation", True))
+
+    violations = []
+    violations += check_constant_budget(hlo, label, budget)
+    if donate:
+        violations += check_donation(hlo, label, expect=True)
+    if "collectives" in pin:
+        violations += check_collective_census(cost.collectives, label,
+                                              pin["collectives"])
+    return violations, cost
+
+
+def pin_records(run_id: str, label: str,
+                violations: List[ContractViolation],
+                cost=None) -> List[dict]:
+    """The ``contract_pin`` records for one checked runner: one OK
+    record per passed contract, one failing record per violation — a
+    JSONL consumer sees pins were RUN, not merely not-violated."""
+    from ..obs import schema
+
+    bad = {v.contract for v in violations}
+    recs = []
+    for v in violations:
+        recs.append(schema.contract_pin_record(
+            run_id, v.contract, False, label=label, message=v.message,
+            observed=v.observed, expected=v.expected))
+    for contract in ("constant-bytes", "donation", "collective-census"):
+        if contract not in bad:
+            recs.append(schema.contract_pin_record(
+                run_id, contract, True, label=label))
+    return recs
+
+
+def check_default_runners(pins: Optional[Dict[str, dict]] = None,
+                          telemetry=None) -> List[ContractViolation]:
+    """The gate body behind ``tools/graft_lint.py --contracts``: build
+    the REAL public AGD and L-BFGS runners on a small synthetic problem
+    (CPU-deterministic) and run every pin against their compiled
+    programs.  Emits ``contract_pin`` records on ``telemetry`` when
+    given."""
+    import numpy as np
+
+    from .. import api
+    from ..ops.losses import LogisticGradient
+    from ..ops.prox import SquaredL2Updater
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (rng.random(64) > 0.5).astype(np.float32)
+    w0 = np.zeros(8, np.float32)
+    data = (X, y)
+    if pins is None:
+        pins = load_pins()
+
+    out: List[ContractViolation] = []
+    for label, fit in (
+            ("agd", api.make_runner(data, LogisticGradient(),
+                                    SquaredL2Updater(), reg_param=1e-3,
+                                    num_iterations=5, mesh=False)),
+            ("lbfgs", api.make_lbfgs_runner(data, LogisticGradient(),
+                                            SquaredL2Updater(),
+                                            reg_param=1e-3,
+                                            num_iterations=5,
+                                            mesh=False))):
+        violations, cost = check_runner(fit, w0, label=label, pins=pins)
+        out.extend(violations)
+        if telemetry is not None:
+            for rec in pin_records(telemetry.run_id, label, violations,
+                                   cost):
+                telemetry.emit(rec)
+    return out

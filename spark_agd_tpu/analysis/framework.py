@@ -1,0 +1,475 @@
+"""graftlint core: the AST lint framework the JAX-aware rules plug into.
+
+PR 5 fixed three compiled-program hazards by hand-review (an ndarray
+embedded as a program constant in ``models/evaluation.py``, misattributed
+bench phases); the ROADMAP serving/inner-loop items will multiply the
+number of jitted programs in the tree.  This package turns those hazard
+classes into *analysis*: stdlib-``ast`` rules that understand the repo's
+JAX idioms — which functions are traced, what a carry looks like, what
+the telemetry schema requires — plus dynamic contract pins
+(``analysis.contracts``) that verify the riskiest static claims against
+the real XLA program.
+
+This module is the rule-agnostic core:
+
+- :class:`Finding` — one diagnostic, stable across runs;
+- :class:`Module` — one parsed file with the shared semantic facts every
+  rule needs (parent links, scope map, the **traced-function set**: the
+  functions whose bodies execute under ``jax.jit``/``vmap``/
+  ``lax.while_loop``/... tracing);
+- waivers — ``# graftlint: disable=<rule>[,<rule>...] -- reason`` on the
+  flagged line (or a standalone comment on the line above), and
+  ``# graftlint: disable-file=<rule>`` anywhere in the first 40 lines
+  for whole-file opt-outs (host-driver files);
+- baseline — a JSON file grandfathering *intended* findings by
+  ``(rule, path, source line)`` so a newly added rule can land before
+  the tree is fully clean.  The shipped tree keeps it empty.
+
+Deliberately dependency-free (stdlib only): the lint gate must run in
+CI without touching a JAX backend.  Only ``analysis.contracts`` (the
+dynamic half) imports jax, and only when invoked.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``rule`` names the check, ``path`` is repo-relative
+    (posix separators), ``snippet`` is the stripped source line — the
+    stable identity baselines match on (line numbers drift, code lines
+    rarely do)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# trace-awareness: which functions run under a JAX trace?
+
+# call/decorator names that trace their function argument(s).  Matched on
+# the LAST attribute segment, so ``jax.jit``, ``jax.lax.cond``, and bare
+# ``jit`` all resolve.
+TRACE_WRAPPERS = frozenset({
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad",
+    "jacfwd", "jacrev", "hessian", "checkpoint", "remat",
+    "while_loop", "fori_loop", "cond", "scan", "switch",
+    "associative_scan", "shard_map", "pallas_call", "custom_jvp",
+    "custom_vjp", "linearize", "vjp", "jvp",
+})
+
+# the COMPILATION entry points among the wrappers: a concrete array
+# closed over by one of these becomes an embedded program constant.
+# (while_loop/cond/scan bodies, by contrast, are only callable during an
+# enclosing trace — their closures are tracers, which is idiomatic.)
+JIT_ENTRY_WRAPPERS = frozenset({"jit", "pjit", "pmap", "pallas_call"})
+
+
+def call_name(node: ast.AST) -> Optional[str]:
+    """The last dotted segment of a call target / decorator expression
+    (``jax.jit`` -> ``jit``); ``None`` when it isn't a name shape."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Full dotted form of a Name/Attribute chain (``np.asarray``), or
+    ``None`` for anything more dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_SCOPE_NODES = _FUNC_NODES + (ast.Module,)
+
+_WAIVER_RE = re.compile(r"#\s*graftlint:\s*disable=([\w, -]+?)(?:--|$)")
+_FILE_WAIVER_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([\w, -]+?)(?:--|$)")
+
+
+class Module:
+    """One parsed source file plus the semantic facts rules share.
+
+    ``traced``: the set of function nodes (def or lambda) whose BODIES
+    execute under a JAX trace — decorated with / passed to a
+    :data:`TRACE_WRAPPERS` call (through nested wrapper calls like
+    ``jit(vmap(f))``), resolved by name within the enclosing lexical
+    scopes, plus everything lexically nested inside such a function.
+    """
+
+    def __init__(self, path: str, source: str,
+                 tree: Optional[ast.Module] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source)
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.traced: Set[ast.AST] = set()
+        # functions that are jit/pjit/pmap COMPILATION roots (directly
+        # wrapped, possibly through vmap/grad chains) — the scope whose
+        # closed-over concrete arrays become embedded program constants
+        self.jit_entry: Set[ast.AST] = set()
+        self._compute_traced()
+        self._line_waivers: Dict[int, Set[str]] = {}
+        self.file_waivers: Set[str] = set()
+        self._collect_waivers()
+
+    # -- scopes -----------------------------------------------------------
+    def scope_of(self, node: ast.AST) -> ast.AST:
+        """Nearest enclosing function (or the module) that OWNS ``node``
+        — for a function node, the scope it is defined in, not itself."""
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, _SCOPE_NODES):
+            cur = self.parent.get(cur)
+        return cur if cur is not None else self.tree
+
+    def enclosing_functions(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Function nodes containing ``node``, innermost first."""
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                yield cur
+            cur = self.parent.get(cur)
+
+    def in_traced(self, node: ast.AST) -> bool:
+        """Whether ``node`` executes under a JAX trace (it sits inside a
+        traced function's body)."""
+        if isinstance(node, _FUNC_NODES) and node in self.traced:
+            return True
+        return any(f in self.traced
+                   for f in self.enclosing_functions(node))
+
+    def in_host_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        """The innermost HOST ``for``/``while`` loop containing ``node``
+        (``None`` when there is none, or when the loop itself is traced
+        code — a Python loop inside a jitted function unrolls at trace
+        time; the host-sync rules target host iteration loops)."""
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                return None  # left the loop's statement nesting
+            if isinstance(cur, (ast.For, ast.While)):
+                if self.in_traced(cur):
+                    return None
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    # -- traced-function discovery ---------------------------------------
+    def _functions_named(self, scope_start: ast.AST, name: str
+                         ) -> Optional[ast.AST]:
+        """Resolve ``name`` to a FunctionDef visible from ``scope_start``
+        by walking outward through enclosing scopes."""
+        scopes = [scope_start, *self.enclosing_functions(scope_start),
+                  self.tree]
+        for scope in scopes:
+            body = getattr(scope, "body", None)
+            if not isinstance(body, list):
+                continue
+            for stmt in ast.walk(scope):
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and stmt.name == name \
+                        and self.scope_of(stmt) is scope:
+                    return stmt
+        return None
+
+    def _mark_traced_arg(self, arg: ast.AST, at: ast.AST,
+                         entry: bool = False) -> None:
+        if isinstance(arg, ast.Lambda):
+            self.traced.add(arg)
+            if entry:
+                self.jit_entry.add(arg)
+        elif isinstance(arg, ast.Call) and call_name(arg) in TRACE_WRAPPERS:
+            for inner in arg.args:
+                self._mark_traced_arg(inner, at, entry=entry)
+        elif isinstance(arg, ast.Name):
+            fn = self._functions_named(self.scope_of(at), arg.id)
+            if fn is not None:
+                self.traced.add(fn)
+                if entry:
+                    self.jit_entry.add(fn)
+
+    def _compute_traced(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in TRACE_WRAPPERS:
+                entry = call_name(node) in JIT_ENTRY_WRAPPERS
+                for arg in node.args:
+                    self._mark_traced_arg(arg, node, entry=entry)
+                for kw in node.keywords:
+                    # lax.while_loop(cond_fun=..., body_fun=...) style
+                    if kw.arg in ("cond_fun", "body_fun", "f", "fun",
+                                  "body", "kernel"):
+                        self._mark_traced_arg(kw.value, node, entry=entry)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    name = call_name(dec)
+                    wrapped = None
+                    if name in TRACE_WRAPPERS:
+                        wrapped = name
+                    elif name == "partial" and isinstance(dec, ast.Call) \
+                            and dec.args:
+                        # @functools.partial(jax.jit, static_argnums=...)
+                        inner = call_name(dec.args[0])
+                        if inner in TRACE_WRAPPERS:
+                            wrapped = inner
+                    if wrapped is not None:
+                        self.traced.add(node)
+                        if wrapped in JIT_ENTRY_WRAPPERS:
+                            self.jit_entry.add(node)
+        # transitive closure: functions defined lexically inside a traced
+        # function execute at trace time too
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if isinstance(node, _FUNC_NODES) \
+                        and node not in self.traced \
+                        and any(f in self.traced
+                                for f in self.enclosing_functions(node)):
+                    self.traced.add(node)
+                    changed = True
+
+    # -- waivers ----------------------------------------------------------
+    @staticmethod
+    def _parse_rules(spec: str) -> Set[str]:
+        return {r.strip() for r in spec.split(",") if r.strip()}
+
+    def _collect_waivers(self) -> None:
+        for i, text in enumerate(self.lines, 1):
+            m = _FILE_WAIVER_RE.search(text)
+            if m and i <= 40:
+                self.file_waivers |= self._parse_rules(m.group(1))
+                continue
+            m = _WAIVER_RE.search(text)
+            if m:
+                rules = self._parse_rules(m.group(1))
+                self._line_waivers.setdefault(i, set()).update(rules)
+                if text.lstrip().startswith("#"):
+                    # standalone waiver comment applies to the first
+                    # CODE line below it (a justification may span
+                    # several comment lines)
+                    j = i + 1
+                    while j <= len(self.lines) \
+                            and self.lines[j - 1].lstrip().startswith("#"):
+                        j += 1
+                    self._line_waivers.setdefault(j, set()) \
+                        .update(rules)
+
+    def waived(self, rule: str, line: int) -> bool:
+        for rules in (self.file_waivers,
+                      self._line_waivers.get(line, ()),
+                      self._line_waivers.get(line - 1, ())):
+            if rule in rules or "all" in rules:
+                return True
+        return False
+
+    # -- finding construction ---------------------------------------------
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message, snippet=self.snippet(line))
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+class Rule:
+    """One lint check.  ``check`` runs per file; ``check_project`` runs
+    once over the whole parsed set (cross-file consistency — the
+    schema-drift rule)."""
+
+    name: str = "rule"
+    description: str = ""
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        return ()
+
+
+# ---------------------------------------------------------------------------
+# running
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules",
+              ".pytest_cache", "build", "dist"}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        yield os.path.join(root, n)
+
+
+def rel_path(path: str, root: Optional[str]) -> str:
+    if root:
+        try:
+            path = os.path.relpath(path, root)
+        except ValueError:  # different drive (windows) — keep absolute
+            pass
+    return path.replace(os.sep, "/")
+
+
+def parse_file(path: str, root: Optional[str] = None
+               ) -> Tuple[Optional[Module], Optional[Finding]]:
+    """(module, None) on success; (None, syntax-error finding) on a file
+    that does not parse — a non-parsing file is itself a finding, never
+    a crash of the gate."""
+    rel = rel_path(path, root)
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return None, Finding("parse-error", rel, 1, 0,
+                             f"cannot read file: {e}")
+    try:
+        return Module(rel, source), None
+    except SyntaxError as e:
+        return None, Finding("parse-error", rel, e.lineno or 1, 0,
+                             f"syntax error: {e.msg}")
+
+
+def lint_modules(mods: Sequence[Module], rules: Sequence[Rule]
+                 ) -> List[Finding]:
+    """Run every rule over every parsed module (plus the project-level
+    passes), apply waivers, and return findings sorted by location."""
+    by_path = {m.path: m for m in mods}
+    findings: List[Finding] = []
+    for rule in rules:
+        for mod in mods:
+            findings.extend(rule.check(mod))
+        findings.extend(rule.check_project(mods))
+    kept = []
+    for f in findings:
+        mod = by_path.get(f.path)
+        if mod is not None and mod.waived(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept
+
+
+def lint_paths(paths: Sequence[str], rules: Sequence[Rule],
+               root: Optional[str] = None
+               ) -> Tuple[List[Finding], int]:
+    """Lint every ``.py`` under ``paths``; returns ``(findings,
+    n_files)``.  ``root`` relativizes reported paths (default: CWD)."""
+    root = root if root is not None else os.getcwd()
+    mods: List[Module] = []
+    findings: List[Finding] = []
+    n = 0
+    for path in iter_py_files(paths):
+        n += 1
+        mod, err = parse_file(path, root)
+        if err is not None:
+            findings.append(err)
+        if mod is not None:
+            mods.append(mod)
+    findings.extend(lint_modules(mods, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, n
+
+
+def lint_source(source: str, rules: Sequence[Rule],
+                path: str = "<string>") -> List[Finding]:
+    """Lint one in-memory source string (the test-fixture entry point)."""
+    return lint_modules([Module(path, source)], rules)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(
+            f"{path}: not a graftlint baseline (expected an object with "
+            "a 'findings' list)")
+    return list(data["findings"])
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = [{"rule": f.rule, "path": f.path, "snippet": f.snippet,
+                "message": f.message} for f in findings]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": entries},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Sequence[dict]
+                   ) -> Tuple[List[Finding], int]:
+    """Drop findings grandfathered by the baseline — multiset match on
+    ``(rule, path, snippet)``, so a moved line stays waived but a NEW
+    occurrence of the same pattern is reported.  Returns ``(kept,
+    n_matched)``."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        key = (e.get("rule", ""), e.get("path", ""), e.get("snippet", ""))
+        budget[key] = budget.get(key, 0) + 1
+    kept: List[Finding] = []
+    matched = 0
+    for f in findings:
+        key = f.baseline_key()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            matched += 1
+            continue
+        kept.append(f)
+    return kept, matched

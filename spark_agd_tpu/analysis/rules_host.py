@@ -1,0 +1,95 @@
+"""Host-sync rule: device round-trips inside host iteration loops.
+
+The fused design's whole premise (SURVEY §7) is that the optimizer loop
+runs on device and the host reads scalars ONCE at the end.  A
+``float()`` / ``.item()`` / ``bool()`` / ``np.asarray()`` on a device
+value inside a host iteration loop silently reintroduces the per-step
+round-trip the reference paid in network hops — invisible in the code,
+dominant in the profile (arXiv 1612.01437's silent per-iteration
+overheads).
+
+Scope: the hot-path subsystems — ``core/``, ``parallel/``, and the
+resilience supervisor (its segment loop brushes against device values
+every boundary).  Host DRIVER files whose loops are host-side by design
+(``core/host_agd.py``, ``core/host_lbfgs.py``) opt out with a
+``disable-file`` waiver naming the reason.
+
+Loops inside traced functions are exempt: a Python loop under a trace
+unrolls at trace time — there is no per-iteration host hop to flag.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Sequence, Tuple
+
+from .framework import Finding, Module, Rule, call_name, dotted_name
+
+DEFAULT_SCOPE: Tuple[str, ...] = (
+    "spark_agd_tpu/core/",
+    "spark_agd_tpu/parallel/",
+    "spark_agd_tpu/resilience/supervisor.py",
+)
+
+# dotted-call forms that force a device->host transfer of their argument
+_TRANSFER_CALLS = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                             "numpy.array", "jax.device_get",
+                             "device_get"})
+
+
+def _contains_device_shape(expr: ast.AST) -> bool:
+    """Heuristic for 'this expression plausibly reads a device value':
+    it contains a call or a subscript (``loss_hist[i]``,
+    ``smooth(w)[0]``).  Bare names/attributes (``warm.big_l``) are
+    usually already-host scalars — flagging them drowns the signal."""
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Call, ast.Subscript)):
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = ("float()/.item()/bool()/np.asarray() on device values "
+                   "inside a host iteration loop reintroduces a per-step "
+                   "device round-trip")
+
+    def __init__(self, scope: Optional[Sequence[str]] = None):
+        self.scope = tuple(DEFAULT_SCOPE if scope is None else scope)
+
+    def _in_scope(self, path: str) -> bool:
+        return any(path.startswith(p) or path.endswith(p)
+                   for p in self.scope)
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        if not self._in_scope(mod.path):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.in_host_loop(node) is None or mod.in_traced(node):
+                continue
+            hit = self._classify(node)
+            if hit is not None:
+                yield mod.finding(
+                    self.name, node,
+                    f"{hit} inside a host iteration loop forces a "
+                    "device->host sync every pass; hoist it out of the "
+                    "loop, batch it per segment, or waive with a "
+                    "justification")
+
+    @staticmethod
+    def _classify(node: ast.Call) -> Optional[str]:
+        # x.item()
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            return ".item()"
+        name = dotted_name(node.func)
+        if name in _TRANSFER_CALLS:
+            return f"{name}()"
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "bool") \
+                and len(node.args) == 1 \
+                and _contains_device_shape(node.args[0]):
+            return f"{node.func.id}() on a computed value"
+        return None

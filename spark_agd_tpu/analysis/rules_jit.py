@@ -1,0 +1,342 @@
+"""Compiled-program rules: constant capture, donation, recompile hazards.
+
+The three hazard classes that cost real debugging time in this repo:
+
+- **constant-capture** — an ndarray closed over by a jit-compiled
+  function is baked into the program as a CONSTANT: XLA compile time
+  scales with the dataset (the r4 ``compile_s: 1842.74`` full-scale
+  wedge) and HBM holds a frozen copy.  The PR 5
+  ``cv_validation_scores`` bug, generalized: data must ride as jit
+  ARGUMENTS (``core.smooth.make_smooth_staged``'s whole reason to
+  exist).  The dynamic twin — a byte budget on the constants actually
+  embedded in the compiled HLO — is ``analysis.contracts``.
+
+- **donation** — a jitted step whose first argument is the optimizer
+  carry (``w``/``state``/``warm``...) without ``donate_argnums``
+  makes XLA copy the carry instead of aliasing it in place; and the
+  inverse bug, *using* a donated buffer after the call, is a runtime
+  error on backends that honor donation.  The dynamic twin asserts the
+  input-output aliasing in the real ``Compiled``.
+
+- **recompile-hazard** — a per-iteration-varying Python scalar reaching
+  a ``static_argnums`` position retraces every loop step; a ``jax.jit``
+  CALL inside a host loop builds a fresh callable (fresh cache) every
+  iteration.  Both turn a compile-once design into a compile-per-step
+  design, silently.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import (Finding, Module, Rule, call_name, dotted_name)
+
+# last dotted segment of calls that manufacture a concrete array on the
+# host — the bindings whose closure-capture by a jit entry embeds a
+# program constant
+ARRAY_MAKERS = frozenset({
+    "asarray", "array", "zeros", "ones", "full", "arange", "linspace",
+    "eye", "zeros_like", "ones_like", "full_like", "device_put",
+    "replicate", "stack", "concatenate", "copy", "empty",
+})
+
+# first-parameter names that mark a jitted function as taking the
+# optimizer carry / mutable state
+CARRY_NAMES = frozenset({"w", "ws", "w0", "state", "warm", "carry",
+                         "opt_state"})
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound anywhere inside ``fn``'s own subtree (params, assigns,
+    for-targets, withitems, comprehensions, nested defs)."""
+    bound: Set[str] = set(_param_names(fn))
+    a = fn.args
+    for p in (a.vararg, a.kwarg, *a.kwonlyargs):
+        if p is not None:
+            bound.add(p.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                bound.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(node.name)
+                bound.update(_param_names(node))
+            elif isinstance(node, ast.Lambda):
+                bound.update(_param_names(node))
+    return bound
+
+
+def _loads(fn: ast.AST) -> List[ast.Name]:
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    out = []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                         ast.Load):
+                out.append(node)
+    return out
+
+
+def _is_array_maker(expr: ast.AST) -> bool:
+    """Does this RHS manufacture a concrete host/device array?"""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_is_array_maker(e) for e in expr.elts)
+    if not isinstance(expr, ast.Call):
+        return False
+    name = call_name(expr)
+    if name in ARRAY_MAKERS:
+        return True
+    if name == "tree_map" and expr.args:
+        # jax.tree_util.tree_map(jnp.asarray, pytree)
+        first = expr.args[0]
+        return call_name(first) in ARRAY_MAKERS \
+            or (isinstance(first, ast.Lambda)
+                and _is_array_maker(first.body))
+    return False
+
+
+class ConstantCaptureRule(Rule):
+    name = "constant-capture"
+    description = ("an ndarray/jnp value closed over by a jit-compiled "
+                   "function becomes an embedded program constant; pass "
+                   "it as an argument instead")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        seen: Set[Tuple[int, str, int]] = set()
+        for fn in mod.jit_entry:
+            local = _local_bindings(fn)
+            enclosing = list(mod.enclosing_functions(fn))
+            for load in _loads(fn):
+                var = load.id
+                if var in local:
+                    continue
+                binding = self._array_binding(mod, enclosing, var)
+                if binding is None:
+                    continue
+                key = (id(fn), var, binding.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                fname = getattr(fn, "name", "<lambda>")
+                yield mod.finding(
+                    self.name, load,
+                    f"jit-compiled function '{fname}' closes over "
+                    f"array '{var}' (built at line {binding.lineno}) — "
+                    "it will be embedded as a compiled-program "
+                    "constant; thread it through as an argument")
+
+    @staticmethod
+    def _array_binding(mod: Module, enclosing: List[ast.AST],
+                       var: str) -> Optional[ast.AST]:
+        """The assignment that binds ``var`` to a fresh array in one of
+        the ENCLOSING function scopes (module-level constants are left
+        to judgement — they are usually small, deliberate tables)."""
+        for scope in enclosing:
+            body = scope.body if isinstance(scope.body, list) else []
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Assign) \
+                            and mod.scope_of(node) is scope:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name) \
+                                    and tgt.id == var \
+                                    and _is_array_maker(node.value):
+                                return node
+        return None
+
+
+def _donate_kwargs(call: ast.Call) -> Optional[ast.keyword]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return kw
+    return None
+
+
+def _unwrap_to_function(mod: Module, call: ast.Call) -> Optional[ast.AST]:
+    """The underlying function node of ``jit(f)`` / ``jit(vmap(f))`` /
+    ``jit(lambda ...)``, resolved in-module; None when not resolvable."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    from .framework import TRACE_WRAPPERS
+
+    while isinstance(arg, ast.Call) and call_name(arg) in TRACE_WRAPPERS:
+        if not arg.args:
+            return None
+        arg = arg.args[0]
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if isinstance(arg, ast.Name):
+        return mod._functions_named(mod.scope_of(call), arg.id)
+    return None
+
+
+class DonationRule(Rule):
+    name = "donation"
+    description = ("jit call sites taking carry-shaped state should "
+                   "donate the carry buffer; a donated buffer must not "
+                   "be used after the call")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        # name -> [(scope of the assignment, donated indices)]; the
+        # reuse pass only honors a binding whose scope lexically
+        # ENCLOSES the call site — `step = jit(f, donate_argnums=0)` in
+        # one factory must not taint an unrelated `step` in another
+        donated_fns: Dict[str, List[Tuple[ast.AST, Set[int]]]] = {}
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in ("jit", "pjit")):
+                continue
+            kw = _donate_kwargs(node)
+            if kw is None:
+                fn = _unwrap_to_function(mod, node)
+                if fn is None:
+                    continue
+                params = _param_names(fn)
+                if params and params[0] in CARRY_NAMES:
+                    fname = getattr(fn, "name", "<lambda>")
+                    yield mod.finding(
+                        self.name, node,
+                        f"jit of '{fname}' takes carry-shaped first "
+                        f"argument '{params[0]}' without donate_argnums"
+                        " — the carry buffer is copied instead of "
+                        "aliased in place; add donate_argnums=0 (and "
+                        "never reuse the input after the call) or "
+                        "waive with a justification")
+            else:
+                idxs = self._donated_indices(kw)
+                tgt = self._assigned_name(mod, node)
+                if tgt is not None and idxs:
+                    donated_fns.setdefault(tgt, []).append(
+                        (mod.scope_of(node), idxs))
+        yield from self._check_reuse(mod, donated_fns)
+
+    @staticmethod
+    def _donated_indices(kw: ast.keyword) -> Set[int]:
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return {e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)}
+        return set()
+
+    @staticmethod
+    def _assigned_name(mod: Module, call: ast.Call) -> Optional[str]:
+        parent = mod.parent.get(call)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            return parent.targets[0].id
+        return None
+
+    def _check_reuse(self, mod: Module,
+                     donated: Dict[str, List[Tuple[ast.AST, Set[int]]]]
+                     ) -> Iterable[Finding]:
+        if not donated:
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donated):
+                continue
+            scope = mod.scope_of(node)
+            visible = [scope, *mod.enclosing_functions(node), mod.tree]
+            idxs: Set[int] = set()
+            for bind_scope, bind_idxs in donated[node.func.id]:
+                if any(s is bind_scope for s in visible):
+                    idxs |= bind_idxs
+            if not idxs:
+                continue
+            # `w = g(w)` rebinds the name to the OUTPUT — later loads of
+            # it are the fresh buffer, not the donated one
+            parent = mod.parent.get(node)
+            rebound: Set[str] = set()
+            if isinstance(parent, ast.Assign):
+                for tgt in parent.targets:
+                    rebound |= {n.id for n in ast.walk(tgt)
+                                if isinstance(n, ast.Name)}
+            for i in idxs:
+                if i >= len(node.args):
+                    continue
+                arg = node.args[i]
+                if not isinstance(arg, ast.Name) or arg.id in rebound:
+                    continue
+                for later in ast.walk(scope):
+                    if isinstance(later, ast.Name) \
+                            and isinstance(later.ctx, ast.Load) \
+                            and later.id == arg.id \
+                            and later.lineno > node.lineno:
+                        yield mod.finding(
+                            self.name, later,
+                            f"'{arg.id}' was donated to "
+                            f"'{node.func.id}' at line {node.lineno} "
+                            "and is used again afterwards — the buffer "
+                            "is invalidated on backends that honor "
+                            "donation")
+                        break
+
+
+class RecompileHazardRule(Rule):
+    name = "recompile-hazard"
+    description = ("a loop-varying Python value reaching static_argnums "
+                   "(or a jax.jit call inside a host loop) retraces the "
+                   "program every iteration")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        # (a) jax.jit(...) constructed INSIDE a host loop — a fresh
+        # callable (fresh compile cache) per iteration
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in ("jit", "pjit") \
+                    and mod.in_host_loop(node) is not None:
+                yield mod.finding(
+                    self.name, node,
+                    "jax.jit called inside a host loop builds a fresh "
+                    "callable (and compiles) every iteration; hoist "
+                    "the jit out of the loop")
+        # (b) call sites passing the loop variable into a static
+        # position of a jit-with-static-argnums function
+        static_fns: Dict[str, Set[int]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in ("jit", "pjit"):
+                for kw in node.keywords:
+                    if kw.arg == "static_argnums":
+                        idxs = DonationRule._donated_indices(kw)
+                        tgt = DonationRule._assigned_name(mod, node)
+                        if tgt and idxs:
+                            static_fns.setdefault(tgt, set()).update(idxs)
+        if not static_fns:
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_fns):
+                continue
+            loop = mod.in_host_loop(node)
+            if loop is None or not isinstance(loop, ast.For):
+                continue
+            loop_vars = {n.id for n in ast.walk(loop.target)
+                         if isinstance(n, ast.Name)}
+            for i in static_fns[node.func.id]:
+                if i < len(node.args) \
+                        and isinstance(node.args[i], ast.Name) \
+                        and node.args[i].id in loop_vars:
+                    yield mod.finding(
+                        self.name, node,
+                        f"loop variable '{node.args[i].id}' reaches "
+                        f"static_argnums position {i} of "
+                        f"'{node.func.id}' — every iteration is a "
+                        "fresh trace+compile; make the argument traced "
+                        "or hoist distinct values out of the loop")

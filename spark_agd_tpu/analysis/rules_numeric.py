@@ -1,0 +1,101 @@
+"""Numeric-hygiene rules for traced code: np/jnp mixing and f64 literals.
+
+- **np-jnp-mix** — a ``np.*`` array op inside a traced function either
+  constant-folds at trace time (silently freezing a value that looks
+  dynamic) or raises ``TracerArrayConversionError`` at the first real
+  call.  Either way the author thought they wrote device code and
+  didn't.  Trace-time *shape/dtype* arithmetic (``np.prod``,
+  ``np.dtype``, dtype constructors) is legitimate and allowlisted.
+
+- **f64-literal** — an explicit ``float64`` dtype inside traced code:
+  under the default x64-disabled config it silently truncates to f32
+  (a wrong-answer generator for the f64 parity oracles), and under x64
+  it doubles HBM on the TPU where f64 is emulated.  Traced code derives
+  dtypes from the carry (``core.agd``'s ``dt`` pattern); host-side
+  oracles and ingest are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .framework import Finding, Module, Rule, dotted_name
+
+_NP_ROOTS = ("np", "numpy")
+
+# trace-time-legitimate numpy members: shape/dtype arithmetic and
+# constants (attributes like np.pi/np.inf are not Calls and never flag)
+_NP_OK = frozenset({
+    "dtype", "finfo", "iinfo", "result_type", "promote_types",
+    "can_cast", "prod", "ndim", "shape", "isscalar",
+    "float32", "float16", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_",
+    # f64 constructors are covered (better) by the f64-literal rule
+    "float64", "double",
+})
+
+_F64_NAMES = frozenset({"np.float64", "numpy.float64", "jnp.float64",
+                        "np.double", "numpy.double"})
+
+
+def _np_member(node: ast.AST):
+    """('np', member) when the expression is a numpy attribute chain."""
+    name = dotted_name(node)
+    if name is None:
+        return None
+    root, _, rest = name.partition(".")
+    if root in _NP_ROOTS and rest:
+        return rest.split(".")[-1]
+    return None
+
+
+class NpJnpMixRule(Rule):
+    name = "np-jnp-mix"
+    description = ("numpy array ops inside traced code constant-fold at "
+                   "trace time or raise on tracers; use jnp")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and mod.in_traced(node)):
+                continue
+            member = _np_member(node.func)
+            if member is None or member in _NP_OK:
+                continue
+            yield mod.finding(
+                self.name, node,
+                f"np.{member}() inside a traced function runs on the "
+                "host at trace time (constant-folds or raises on a "
+                "tracer); use the jnp equivalent, or hoist genuine "
+                "host-side staging out of the traced scope")
+
+
+class F64LiteralRule(Rule):
+    name = "f64-literal"
+    description = ("explicit float64 dtypes in traced code truncate "
+                   "silently under x64-off and double HBM under x64; "
+                   "derive the dtype from the carry")
+
+    def check(self, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not mod.in_traced(node):
+                continue
+            if isinstance(node, ast.Attribute):
+                if dotted_name(node) in _F64_NAMES and isinstance(
+                        node.ctx, ast.Load):
+                    # attribute used as dtype= value or called directly
+                    yield mod.finding(
+                        self.name, node,
+                        "float64 literal in traced code — derive the "
+                        "dtype from the carry (e.g. "
+                        "jnp.result_type(*leaves)) instead of pinning "
+                        "f64")
+            elif isinstance(node, ast.keyword) and node.arg == "dtype" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value in ("float64", "f64", "double"):
+                yield mod.finding(
+                    self.name, node.value,
+                    "dtype='float64' string literal in traced code — "
+                    "derive the dtype from the carry instead of "
+                    "pinning f64")

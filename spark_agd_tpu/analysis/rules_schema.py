@@ -1,0 +1,229 @@
+"""Schema-drift rule: telemetry emit sites vs the canonical record schema.
+
+``obs/schema.py`` is the one contract every telemetry producer and
+consumer meet at.  Drift between an emit site and the schema (a typo'd
+kind, a missing required field) is invisible until a consumer chokes on
+the JSONL — long after the run that wrote it is gone.  This rule checks
+the static half at lint time:
+
+- ``schema.<kind>_record(...)`` calls (and names imported from the
+  schema module) must name a registered kind;
+- hand-built record dict literals carrying ``schema_version`` must use
+  a registered ``kind``;
+- ``Telemetry`` helper call sites must pass the helper's required
+  fields (skipped when the site forwards ``**kwargs`` — the supervisor
+  ledger pattern);
+- project-wide: every kind in ``KINDS`` must have a selfcheck example
+  (``EXAMPLE_<KIND>_RECORD``) and a matching ``Telemetry`` helper, and
+  every mapped helper must exist — the drift class PR 5's chaos kinds
+  were added against by hand.
+
+The schema itself is imported (stdlib-only module) straight from its
+file, so the rule validates against the REAL registered kinds, not a
+parallel list that could itself drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, Iterable, Optional, Sequence, Set, Tuple
+
+from .framework import Finding, Module, Rule, dotted_name
+
+# kind -> Telemetry helper name, where they differ from the kind itself
+KIND_TO_HELPER: Dict[str, str] = {
+    "run": "run_summary",
+    "iteration": "iteration_callback",
+    "span": "span",
+    "metrics": "metrics_snapshot",
+}
+
+# Telemetry helper -> (names of leading positional params, required
+# keyword fields the SITE must supply — auto-filled fields like run_id
+# and heartbeat's process are absent)
+HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
+    "run_summary": ((), frozenset({"tool"})),
+    "iteration_callback": ((), frozenset()),
+    "span": (("name",), frozenset()),
+    "metrics_snapshot": ((), frozenset()),
+    "program_cost": (("cost",), frozenset()),
+    "numerics_failure": (("message",), frozenset()),
+    "attempt": ((), frozenset({"attempt", "outcome"})),
+    "recovery": ((), frozenset({"action"})),
+    "heartbeat": ((), frozenset()),
+    "chaos": ((), frozenset({"fault"})),
+    "journal_replay": ((), frozenset({"records"})),
+    "degraded": ((), frozenset({"surviving"})),
+    "contract_pin": ((), frozenset({"contract", "ok"})),
+}
+
+
+def _load_schema_module(path: Optional[str] = None):
+    """Import ``obs/schema.py`` standalone from its file (it is stdlib-
+    only by contract, so this never drags in jax)."""
+    if path is None:
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "obs", "schema.py")
+    path = os.path.abspath(path)
+    spec = importlib.util.spec_from_file_location("_graftlint_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class SchemaDriftRule(Rule):
+    name = "schema-drift"
+    description = ("telemetry emit sites must agree with obs/schema.py: "
+                   "registered kinds, required fields, and full "
+                   "example/helper coverage")
+
+    def __init__(self, schema_file: Optional[str] = None,
+                 kinds: Optional[Sequence[str]] = None):
+        self._schema_file = schema_file
+        self._kinds: Optional[Tuple[str, ...]] = \
+            tuple(kinds) if kinds is not None else None
+        self._schema_mod = None
+
+    @property
+    def kinds(self) -> Tuple[str, ...]:
+        if self._kinds is None:
+            self._kinds = tuple(self.schema_module.KINDS)
+        return self._kinds
+
+    @property
+    def schema_module(self):
+        if self._schema_mod is None:
+            self._schema_mod = _load_schema_module(self._schema_file)
+        return self._schema_mod
+
+    # -- per-file ---------------------------------------------------------
+    def check(self, mod: Module) -> Iterable[Finding]:
+        schema_names = self._schema_imports(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_record_call(mod, node,
+                                                  schema_names)
+                yield from self._check_helper_call(mod, node)
+            elif isinstance(node, ast.Dict):
+                yield from self._check_record_literal(mod, node)
+
+    @staticmethod
+    def _schema_imports(mod: Module) -> Set[str]:
+        """Names imported FROM a schema module (``from ..obs.schema
+        import chaos_record``) — the bare-name emit sites to check."""
+        names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.split(".")[-1] == "schema":
+                names.update(a.asname or a.name for a in node.names)
+        return names
+
+    def _check_record_call(self, mod: Module, node: ast.Call,
+                           schema_names: Set[str]) -> Iterable[Finding]:
+        name = dotted_name(node.func)
+        if name is None or not name.endswith("_record"):
+            return
+        parts = name.split(".")
+        bare = parts[-1]
+        if len(parts) > 1 and parts[-2] != "schema":
+            return
+        if len(parts) == 1 and bare not in schema_names:
+            return
+        kind = bare[:-len("_record")]
+        if kind in self.kinds:
+            return
+        if hasattr(self.schema_module, bare):
+            # a real non-constructor helper (validate_record, ...)
+            return
+        yield mod.finding(
+                self.name, node,
+                f"'{bare}' is not a constructor in obs.schema and "
+                f"'{kind}' is not a registered kind "
+                f"{tuple(self.kinds)} — typo'd kind or unregistered "
+                "record family")
+
+    def _check_helper_call(self, mod: Module, node: ast.Call
+                           ) -> Iterable[Finding]:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        helper = node.func.attr
+        sig = HELPER_SIGNATURES.get(helper)
+        if sig is None:
+            return
+        recv = dotted_name(node.func.value)
+        last = (recv or "").split(".")[-1].lower()
+        if "tel" not in last:
+            return
+        if any(kw.arg is None for kw in node.keywords):
+            return  # **kwargs forwarding — not statically checkable
+        pos_names, required = sig
+        given = {kw.arg for kw in node.keywords}
+        given.update(pos_names[:len(node.args)])
+        missing = sorted((required | set(pos_names)) - given)
+        if missing:
+            yield mod.finding(
+                self.name, node,
+                f"Telemetry.{helper}() call is missing required "
+                f"field(s) {missing} — the emitted record would fail "
+                "schema validation")
+
+    def _check_record_literal(self, mod: Module, node: ast.Dict
+                              ) -> Iterable[Finding]:
+        keys = {k.value: v for k, v in zip(node.keys, node.values)
+                if isinstance(k, ast.Constant)}
+        if "schema_version" not in keys or "kind" not in keys:
+            return
+        kv = keys["kind"]
+        if isinstance(kv, ast.Constant) and isinstance(kv.value, str) \
+                and kv.value not in self.kinds:
+            yield mod.finding(
+                self.name, kv,
+                f"hand-built record uses kind '{kv.value}', which is "
+                "not registered in obs.schema.KINDS")
+
+    # -- project-wide coverage -------------------------------------------
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        schema_mod = next((m for m in mods
+                           if m.path.endswith("obs/schema.py")), None)
+        tel_mod = next((m for m in mods
+                        if m.path.endswith("obs/telemetry.py")), None)
+        if schema_mod is not None:
+            real = self.schema_module
+            for kind in self.kinds:
+                attr = f"EXAMPLE_{kind.upper()}_RECORD"
+                if not hasattr(real, attr):
+                    yield Finding(
+                        self.name, schema_mod.path, 1, 0,
+                        f"kind '{kind}' has no selfcheck example "
+                        f"({attr}) — every kind must round-trip "
+                        "through selfcheck")
+            examples = getattr(real, "EXAMPLES", None)
+            if isinstance(examples, dict):
+                for kind in self.kinds:
+                    if kind not in examples:
+                        yield Finding(
+                            self.name, schema_mod.path, 1, 0,
+                            f"kind '{kind}' missing from the EXAMPLES "
+                            "table selfcheck iterates")
+        if tel_mod is not None:
+            methods = self._telemetry_methods(tel_mod)
+            if methods:
+                for kind in self.kinds:
+                    helper = KIND_TO_HELPER.get(kind, kind)
+                    if helper not in methods:
+                        yield Finding(
+                            self.name, tel_mod.path, 1, 0,
+                            f"kind '{kind}' has no Telemetry helper "
+                            f"(expected a '{helper}' method)")
+
+    @staticmethod
+    def _telemetry_methods(mod: Module) -> Set[str]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Telemetry":
+                return {n.name for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        return set()

@@ -160,6 +160,15 @@ def _build_smooth(gradient, data, mesh, dist_mode):
                                                mode=dist_mode)
 
 
+def _owned_array(x):
+    """A fresh device buffer the donated step may CONSUME.  The runner
+    steps donate their carry (``donate_argnums=0``), which invalidates
+    the input buffer after the call — ``jnp.asarray`` would alias an
+    already-placed caller array, letting donation delete the caller's
+    weights out from under a second ``fit``."""
+    return jnp.array(x, copy=True)
+
+
 def _make_instrumented_fit(step, place_w, dargs, telemetry):
     """The telemetry twin of the plain ``fit`` closure: the same ONE
     jitted program, but each phase runs under a span timer that streams
@@ -267,10 +276,14 @@ def make_runner(
         return agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl,
                            telemetry_cb=tel_cb)
 
-    step = jax.jit(_step)
+    # the carry is donated: XLA aliases the weights buffer in place
+    # instead of copying it (graftlint donation contract; the aliasing
+    # is pinned against the compiled program by analysis.contracts) —
+    # _place_w hands the program a fresh buffer it may consume
+    step = jax.jit(_step, donate_argnums=0)
 
     def _place_w(initial_weights):
-        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        w0 = jax.tree_util.tree_map(_owned_array, initial_weights)
         return w0 if m is None else mesh_lib.replicate(w0, m)
 
     if telemetry is None:
@@ -1198,6 +1211,8 @@ def run_minibatch_sgd(
         X = jnp.asarray(X)
     y = jnp.asarray(y)
     mask = None if mask is None else jnp.asarray(mask)
+    # graftlint: disable=donation -- one-shot program on the CALLER'S
+    # w0; donating would invalidate their buffer for a single execution
     res = jax.jit(
         lambda w, Xa, ya, ma: gd.run_minibatch_sgd(
             gradient, updater, Xa, ya, w, mask=ma, **kw))(w0, X, y, mask)
@@ -1272,16 +1287,19 @@ def make_lbfgs_runner(
     algorithm = "owlqn" if l1_coeff > 0 else "lbfgs"
     tel_cb = (None if telemetry is None
               else telemetry.iteration_callback(algorithm))
+    # carry donated exactly as in make_runner: the quasi-Newton loop's
+    # weight buffer aliases in place (pinned by analysis.contracts)
     if l1_coeff > 0:
         step = jax.jit(lambda w, da: lbfgs_lib.run_owlqn(
             _objective(build(*da)[0]), w, l1_coeff, cfg,
-            telemetry_cb=tel_cb))
+            telemetry_cb=tel_cb), donate_argnums=0)
     else:
         step = jax.jit(lambda w, da: lbfgs_lib.run_lbfgs(
-            _objective(build(*da)[0]), w, cfg, telemetry_cb=tel_cb))
+            _objective(build(*da)[0]), w, cfg, telemetry_cb=tel_cb),
+            donate_argnums=0)
 
     def _place_w(initial_weights):
-        w0 = jax.tree_util.tree_map(jnp.asarray, initial_weights)
+        w0 = jax.tree_util.tree_map(_owned_array, initial_weights)
         return w0 if m is None else mesh_lib.replicate(w0, m)
 
     if telemetry is None:

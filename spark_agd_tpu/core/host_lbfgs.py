@@ -25,6 +25,9 @@ Wolfe/convergence boundary can round differently, so f32 parity is
 trajectory-level, not branch-level (the multihost smoke asserts
 matching stop modes and objective values, not counts).
 """
+# graftlint: disable-file=host-sync -- host-orchestrated driver by
+# design: streamed / cross-process objectives cannot live inside
+# lax.while_loop, so Wolfe control scalars sync per evaluation
 
 from __future__ import annotations
 

@@ -27,6 +27,8 @@ Semantics covered (each with its reference citation):
 The oracle counts ``smooth`` evaluations so tests can also pin the
 2-3-passes-per-iteration cost shape (SURVEY §3.1).
 """
+# graftlint: disable-file=host-sync -- pure-NumPy f64 reference oracle:
+# every value is already on the host; there is no device to sync with
 
 from __future__ import annotations
 

@@ -229,6 +229,9 @@ def cv_validation_scores(cv, X, y, *, score_fn, predict_fn=None,
             return score_fn(predict_fn(w), ya, val_mask)
 
         dargs = (y, base, cv.fold_ids)
+    # graftlint: disable=donation -- w here is a read-only stacked
+    # batch of candidate weights (vmap lanes) scored once, not a
+    # mutated optimizer carry; nothing is aliased in place
     per_lane = jax.jit(jax.vmap(one, in_axes=(0, 0, None)))(
         flat_w, fold_lane, dargs).reshape(F, R)
     return per_lane, jnp.nanmean(per_lane, axis=0)

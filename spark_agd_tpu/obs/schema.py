@@ -37,7 +37,7 @@ SCHEMA_VERSION = 1
 
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "numerics_failure", "attempt", "recovery", "heartbeat",
-         "chaos", "journal_replay", "degraded")
+         "chaos", "journal_replay", "degraded", "contract_pin")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
@@ -82,7 +82,14 @@ _REQUIRED: Dict[str, dict] = {
     # one quorum-gated degraded continuation (resilience.degrade):
     # ``surviving`` processes keep training without their dead peers
     "degraded": {"run_id": str, "surviving": int},
+    # one compiled-program contract check (analysis.contracts):
+    # ``contract`` is constant-bytes / donation / collective-census,
+    # ``ok`` whether the pin held against the real XLA program
+    "contract_pin": {"run_id": str, "contract": str, "ok": bool},
 }
+
+# JSON value types the contract-pin observed/expected fields may carry
+_JSON_VAL = (int, float, str, dict, list, bool, type(None))
 
 _OPTIONAL: Dict[str, dict] = {
     "run": {
@@ -150,6 +157,11 @@ _OPTIONAL: Dict[str, dict] = {
         "saved_process_count": int, "lost": list, "quorum": _NUM,
         "min_quorum": _NUM, "generation": int, "to_iter": int,
         "process": int, "dropped_partitions": int, "source": str,
+        "tool": str, "timestamp_unix": _NUM,
+    },
+    "contract_pin": {
+        "label": str, "message": str, "observed": _JSON_VAL,
+        "expected": _JSON_VAL, "budget_bytes": int, "algorithm": str,
         "tool": str, "timestamp_unix": _NUM,
     },
 }
@@ -325,6 +337,17 @@ def degraded_record(run_id: str, surviving: int, **fields) -> dict:
             "run_id": run_id, "surviving": int(surviving), **fields}
 
 
+def contract_pin_record(run_id: str, contract: str, ok: bool,
+                        **fields) -> dict:
+    """One compiled-program contract check (``analysis.contracts``):
+    ``contract`` names the pin (constant-bytes / donation /
+    collective-census), ``ok`` whether it held; ``label`` names the
+    program, ``observed``/``expected`` carry the mismatch."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "contract_pin",
+            "run_id": run_id, "contract": str(contract),
+            "ok": bool(ok), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -361,6 +384,14 @@ EXAMPLE_ITERATION_RECORD = {
 EXAMPLE_SPAN_RECORD = {
     "schema_version": SCHEMA_VERSION, "kind": "span",
     "run_id": "r18c2d3e4-1a2b-0", "name": "compile", "seconds": 1.25,
+}
+
+EXAMPLE_METRICS_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "metrics",
+    "run_id": "r18c2d3e4-1a2b-0", "tool": "bench",
+    "metrics": {"compile.hits": 3, "compile.misses": 1,
+                "resilience.attempts": 1},
+    "timestamp_unix": 1754000000.0,
 }
 
 EXAMPLE_PROGRAM_COST_RECORD = {
@@ -425,25 +456,49 @@ EXAMPLE_DEGRADED_RECORD = {
     "dropped_partitions": 2, "source": "degrade",
 }
 
+EXAMPLE_CONTRACT_PIN_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "contract_pin",
+    "run_id": "r18c2d3e4-1a2b-0", "contract": "collective-census",
+    "ok": False, "label": "agd",
+    "message": "all-reduce: compiled program has 4, pin says 3",
+    "observed": {"all-reduce": 4}, "expected": {"all-reduce": 3},
+    "tool": "graft_lint",
+}
+
+# the kind-keyed table selfcheck iterates — graftlint's schema-drift
+# rule cross-checks that EVERY registered kind appears here (and has a
+# Telemetry helper), so a new kind cannot land without selfcheck
+# coverage
+EXAMPLES: Dict[str, dict] = {
+    "run": EXAMPLE_RUN_RECORD,
+    "iteration": EXAMPLE_ITERATION_RECORD,
+    "span": EXAMPLE_SPAN_RECORD,
+    "metrics": EXAMPLE_METRICS_RECORD,
+    "program_cost": EXAMPLE_PROGRAM_COST_RECORD,
+    "numerics_failure": EXAMPLE_NUMERICS_FAILURE_RECORD,
+    "attempt": EXAMPLE_ATTEMPT_RECORD,
+    "recovery": EXAMPLE_RECOVERY_RECORD,
+    "heartbeat": EXAMPLE_HEARTBEAT_RECORD,
+    "chaos": EXAMPLE_CHAOS_RECORD,
+    "journal_replay": EXAMPLE_JOURNAL_REPLAY_RECORD,
+    "degraded": EXAMPLE_DEGRADED_RECORD,
+    "contract_pin": EXAMPLE_CONTRACT_PIN_RECORD,
+}
+
 
 def selfcheck() -> Tuple[bool, List[str]]:
-    """Validate the example records, a JSON round-trip, and a negative
-    control (a broken record MUST fail).  Returns ``(ok, messages)`` —
-    the ``python -m spark_agd_tpu.obs --selfcheck`` body."""
+    """Validate every example record (one per registered kind), a JSON
+    round-trip, and an automatic negative sweep (every required field
+    of every kind, when deleted, MUST fail validation).  Returns
+    ``(ok, messages)`` — the ``python -m spark_agd_tpu.obs --selfcheck``
+    body."""
     msgs: List[str] = []
     ok = True
-    for name, rec in (("run", EXAMPLE_RUN_RECORD),
-                      ("iteration", EXAMPLE_ITERATION_RECORD),
-                      ("span", EXAMPLE_SPAN_RECORD),
-                      ("program_cost", EXAMPLE_PROGRAM_COST_RECORD),
-                      ("numerics_failure",
-                       EXAMPLE_NUMERICS_FAILURE_RECORD),
-                      ("attempt", EXAMPLE_ATTEMPT_RECORD),
-                      ("recovery", EXAMPLE_RECOVERY_RECORD),
-                      ("heartbeat", EXAMPLE_HEARTBEAT_RECORD),
-                      ("chaos", EXAMPLE_CHAOS_RECORD),
-                      ("journal_replay", EXAMPLE_JOURNAL_REPLAY_RECORD),
-                      ("degraded", EXAMPLE_DEGRADED_RECORD)):
+    missing = [k for k in KINDS if k not in EXAMPLES]
+    if missing:
+        ok = False
+        msgs.append(f"FAIL: kinds without an example record: {missing}")
+    for name, rec in EXAMPLES.items():
         errs = validate_record(json.loads(json.dumps(rec)))
         if errs:
             ok = False
@@ -451,58 +506,18 @@ def selfcheck() -> Tuple[bool, List[str]]:
         else:
             msgs.append(f"ok: example {name} record validates "
                         f"(round-tripped through JSON)")
-    bad = dict(EXAMPLE_RUN_RECORD)
-    del bad["run_id"]
-    if validate_record(bad):
-        msgs.append("ok: negative control (missing run_id) rejected")
-    else:
-        ok = False
-        msgs.append("FAIL: record missing run_id passed validation")
-    bad_pc = dict(EXAMPLE_PROGRAM_COST_RECORD)
-    del bad_pc["collectives"]
-    if validate_record(bad_pc):
-        msgs.append("ok: negative control (program_cost missing "
-                    "collectives) rejected")
-    else:
-        ok = False
-        msgs.append("FAIL: program_cost record missing collectives "
-                    "passed validation")
-    bad_rec = dict(EXAMPLE_RECOVERY_RECORD)
-    del bad_rec["action"]
-    if validate_record(bad_rec):
-        msgs.append("ok: negative control (recovery missing action) "
-                    "rejected")
-    else:
-        ok = False
-        msgs.append("FAIL: recovery record missing action passed "
-                    "validation")
-    bad_hb = dict(EXAMPLE_HEARTBEAT_RECORD)
-    del bad_hb["process"]
-    if validate_record(bad_hb):
-        msgs.append("ok: negative control (heartbeat missing process) "
-                    "rejected")
-    else:
-        ok = False
-        msgs.append("FAIL: heartbeat record missing process passed "
-                    "validation")
-    bad_chaos = dict(EXAMPLE_CHAOS_RECORD)
-    del bad_chaos["fault"]
-    if validate_record(bad_chaos):
-        msgs.append("ok: negative control (chaos missing fault) "
-                    "rejected")
-    else:
-        ok = False
-        msgs.append("FAIL: chaos record missing fault passed "
-                    "validation")
-    bad_deg = dict(EXAMPLE_DEGRADED_RECORD)
-    del bad_deg["surviving"]
-    if validate_record(bad_deg):
-        msgs.append("ok: negative control (degraded missing surviving) "
-                    "rejected")
-    else:
-        ok = False
-        msgs.append("FAIL: degraded record missing surviving passed "
-                    "validation")
+    # negative sweep: deleting ANY required field must be rejected
+    for name, rec in EXAMPLES.items():
+        for field in _REQUIRED[name]:
+            bad = dict(rec)
+            del bad[field]
+            if validate_record(bad):
+                msgs.append(f"ok: negative control ({name} missing "
+                            f"{field}) rejected")
+            else:
+                ok = False
+                msgs.append(f"FAIL: {name} record missing {field} "
+                            "passed validation")
     stamped = stamp({"value": 1.0}, tool="selfcheck")
     errs = validate_record(stamped)
     if errs:

@@ -255,6 +255,32 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def metrics_snapshot(self, *, tool: Optional[str] = None,
+                         **extra_metrics) -> dict:
+        """Emit (and return) a ``metrics`` record carrying the current
+        registry snapshot (plus any ``extra_metrics``) — a mid-run
+        checkpoint of the counters/gauges, where ``run_summary``
+        attaches the FINAL snapshot at end of run."""
+        metrics = self.registry.snapshot()
+        metrics.update(extra_metrics)
+        rec = schema.metrics_record(self.run_id, metrics, tool=tool)
+        self.bus.emit(rec)
+        return rec
+
+    def contract_pin(self, *, contract: str, ok: bool,
+                     **fields) -> dict:
+        """Emit (and return) a ``contract_pin`` record — one
+        compiled-program contract check (``analysis.contracts``:
+        constant-bytes / donation / collective-census) — counting
+        failures (``contracts.violations``), so a pin broken mid-run
+        surfaces in the run summary."""
+        if not ok:
+            self.registry.counter("contracts.violations").inc()
+        rec = schema.contract_pin_record(self.run_id, contract, ok,
+                                         **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

@@ -1,0 +1,691 @@
+"""graftlint: the JAX-aware static-analysis suite + contract pins.
+
+One true-positive and one true-negative fixture snippet per rule
+(backend-free: pure ``ast`` over in-memory sources), the waiver and
+baseline round-trips, the CLI gate's exit codes, the dynamic contract
+pins against the REAL compiled AGD/L-BFGS runners on CPU, and the
+tier-1 guard that the repo itself lints clean with an empty baseline —
+the zero-findings gate every future PR inherits.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu import analysis, api
+from spark_agd_tpu.analysis import (ConstantCaptureRule, DonationRule,
+                                    F64LiteralRule, HostSyncRule,
+                                    NpJnpMixRule, RecompileHazardRule,
+                                    SchemaDriftRule, contracts,
+                                    default_rules, lint_paths,
+                                    lint_source)
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import SquaredL2Updater
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "graft_lint.py")
+
+# the paths the shipped gate covers (ISSUE 6 acceptance)
+GATE_PATHS = ("spark_agd_tpu", "tools", "benchmarks")
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _tiny_problem(n=64, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    return X, y
+
+
+# ------------------------------------------------------------ per-rule
+# fixtures: one true positive and one true negative each
+
+
+class TestConstantCapture:
+    TP = """
+import jax
+import jax.numpy as jnp
+
+def make(data):
+    X = jnp.asarray(data)
+
+    @jax.jit
+    def step(w):
+        return X @ w
+
+    return step
+"""
+    TN = """
+import jax
+import jax.numpy as jnp
+
+def make(data):
+    X = jnp.asarray(data)
+
+    @jax.jit
+    def step(w, Xa):
+        return Xa @ w
+
+    return lambda w: step(w, X)
+"""
+
+    def test_true_positive(self):
+        fs = lint_source(self.TP, [ConstantCaptureRule()])
+        assert _rules_of(fs) == {"constant-capture"}
+        assert "closes over array 'X'" in fs[0].message
+
+    def test_true_negative(self):
+        assert lint_source(self.TN, [ConstantCaptureRule()]) == []
+
+    def test_while_loop_body_closure_is_idiomatic(self):
+        # closures over tracers inside lax.while_loop bodies are how
+        # traced code is written — only COMPILATION entries flag
+        src = """
+import jax.numpy as jnp
+from jax import lax
+
+def run(X, w):
+    def body(c):
+        return c + (X @ w)[0]
+    return lax.while_loop(lambda c: c < 1.0, body, 0.0)
+"""
+        assert lint_source(src, [ConstantCaptureRule()]) == []
+
+
+class TestHostSync:
+    TP = """
+def run(smooth, w, n):
+    losses = []
+    for _ in range(n):
+        w, loss = smooth(w)
+        losses.append(float(loss[0]))
+    return losses
+"""
+    TN = """
+def run(smooth, w, n):
+    for _ in range(n):
+        w, loss = smooth(w)
+    return float(loss[0])
+"""
+
+    def test_true_positive(self):
+        fs = lint_source(self.TP, [HostSyncRule()],
+                         path="spark_agd_tpu/core/fake.py")
+        assert _rules_of(fs) == {"host-sync"}
+
+    def test_true_negative_outside_loop(self):
+        assert lint_source(self.TN, [HostSyncRule()],
+                           path="spark_agd_tpu/core/fake.py") == []
+
+    def test_out_of_scope_path_not_flagged(self):
+        # the rule targets the hot-path subsystems only
+        assert lint_source(self.TP, [HostSyncRule()],
+                           path="spark_agd_tpu/data/fake.py") == []
+
+    def test_traced_loop_exempt(self):
+        # a Python loop under a trace unrolls at trace time — no
+        # per-iteration host hop exists
+        src = """
+import jax
+
+@jax.jit
+def step(w):
+    acc = 0.0
+    for i in range(4):
+        acc = acc + float(i)
+    return w + acc
+"""
+        assert lint_source(src, [HostSyncRule()],
+                           path="spark_agd_tpu/core/fake.py") == []
+
+
+class TestDonation:
+    TP = """
+import jax
+
+def make(build):
+    def _step(w, da):
+        return build(*da)(w)
+    return jax.jit(_step)
+"""
+    TN = """
+import jax
+
+def make(build):
+    def _step(w, da):
+        return build(*da)(w)
+    return jax.jit(_step, donate_argnums=0)
+"""
+
+    def test_true_positive(self):
+        fs = lint_source(self.TP, [DonationRule()])
+        assert _rules_of(fs) == {"donation"}
+        assert "without donate_argnums" in fs[0].message
+
+    def test_true_negative(self):
+        assert lint_source(self.TN, [DonationRule()]) == []
+
+    def test_reuse_after_donation(self):
+        src = """
+import jax
+
+def f(w, x):
+    return w + x
+
+g = jax.jit(f, donate_argnums=0)
+
+def driver(w, x):
+    out = g(w, x)
+    return out + w.sum()
+"""
+        fs = lint_source(src, [DonationRule()])
+        assert any("used again afterwards" in f.message for f in fs)
+
+    def test_rebind_is_not_reuse(self):
+        # `w = g(w)` rebinds to the OUTPUT buffer — idiomatic donation
+        src = """
+import jax
+
+def f(w, x):
+    return w + x
+
+g = jax.jit(f, donate_argnums=0)
+
+def driver(w, x):
+    w = g(w, x)
+    return w.sum()
+"""
+        assert lint_source(src, [DonationRule()]) == []
+
+    def test_same_name_in_another_scope_not_tainted(self):
+        # the PR 6 false-positive class: an unrelated `step` in a
+        # different factory must not inherit this one's donation
+        src = """
+import jax
+
+def make_a(f):
+    step = jax.jit(f, donate_argnums=0)
+    return step
+
+def make_b(g, w, da):
+    step = jax.jit(g)
+    out = step(w, da)
+    return out, w
+"""
+        assert lint_source(src, [DonationRule()]) == []
+
+
+class TestRecompileHazard:
+    TP = """
+import jax
+
+def driver(fn, xs):
+    out = []
+    for x in xs:
+        step = jax.jit(fn)
+        out.append(step(x))
+    return out
+"""
+    TN = """
+import jax
+
+def driver(fn, xs):
+    step = jax.jit(fn)
+    return [step(x) for x in xs]
+"""
+
+    def test_true_positive(self):
+        fs = lint_source(self.TP, [RecompileHazardRule()])
+        assert _rules_of(fs) == {"recompile-hazard"}
+        assert "inside a host loop" in fs[0].message
+
+    def test_true_negative(self):
+        assert lint_source(self.TN, [RecompileHazardRule()]) == []
+
+    def test_loop_var_into_static_argnums(self):
+        src = """
+import jax
+
+f = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+def driver(xs):
+    out = []
+    for i in range(10):
+        out.append(f(xs, i))
+    return out
+"""
+        fs = lint_source(src, [RecompileHazardRule()])
+        assert len(fs) == 1
+        assert "static_argnums position 1" in fs[0].message
+
+
+class TestNpJnpMix:
+    TP = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(w):
+    return np.dot(w, w)
+"""
+    TN = """
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+@jax.jit
+def step(w):
+    n = np.prod(w.shape)
+    return jnp.dot(w, w) / n
+
+def host_stage(rows):
+    return np.concatenate(rows)
+"""
+
+    def test_true_positive(self):
+        fs = lint_source(self.TP, [NpJnpMixRule()])
+        assert _rules_of(fs) == {"np-jnp-mix"}
+
+    def test_true_negative(self):
+        # trace-time shape arithmetic and host-side numpy are fine
+        assert lint_source(self.TN, [NpJnpMixRule()]) == []
+
+
+class TestF64Literal:
+    TP = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(w):
+    return w + jnp.zeros(3, jnp.float64)
+"""
+    TN = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def step(w):
+    return w + jnp.zeros(3, w.dtype)
+
+def host_oracle(x):
+    return np.asarray(x, np.float64)
+"""
+
+    def test_true_positive(self):
+        fs = lint_source(self.TP, [F64LiteralRule()])
+        assert _rules_of(fs) == {"f64-literal"}
+
+    def test_true_negative(self):
+        # carry-derived dtypes in traced code, f64 on the host oracle
+        assert lint_source(self.TN, [F64LiteralRule()]) == []
+
+
+class TestSchemaDrift:
+    TP = """
+from spark_agd_tpu.obs import schema
+
+def emit(run_id):
+    return schema.iteraton_record(run_id, "agd", 1, loss=0.5)
+"""
+    TN = """
+from spark_agd_tpu.obs import schema
+
+def emit(run_id):
+    return schema.iteration_record(run_id, "agd", 1, loss=0.5)
+"""
+
+    def test_true_positive_typod_kind(self):
+        fs = lint_source(self.TP, [SchemaDriftRule()])
+        assert _rules_of(fs) == {"schema-drift"}
+        assert "iteraton" in fs[0].message
+
+    def test_true_negative(self):
+        assert lint_source(self.TN, [SchemaDriftRule()]) == []
+
+    def test_helper_missing_required_field(self):
+        src = """
+def report(tel):
+    tel.attempt(attempt=2)
+"""
+        fs = lint_source(src, [SchemaDriftRule()])
+        assert len(fs) == 1
+        assert "outcome" in fs[0].message
+
+    def test_helper_kwargs_forwarding_skipped(self):
+        src = """
+def report(tel, **fields):
+    tel.attempt(**fields)
+"""
+        assert lint_source(src, [SchemaDriftRule()]) == []
+
+    def test_literal_unregistered_kind(self):
+        src = """
+def rec(run_id):
+    return {"schema_version": 1, "kind": "bogus_kind",
+            "run_id": run_id}
+"""
+        fs = lint_source(src, [SchemaDriftRule()])
+        assert len(fs) == 1
+        assert "bogus_kind" in fs[0].message
+
+
+# ------------------------------------------------------------- waivers
+
+
+class TestWaivers:
+    def test_inline_waiver(self):
+        src = TestDonation.TP.replace(
+            "return jax.jit(_step)",
+            "return jax.jit(_step)  # graftlint: disable=donation -- x")
+        assert lint_source(src, [DonationRule()]) == []
+
+    def test_standalone_comment_waiver_spans_comment_block(self):
+        src = TestDonation.TP.replace(
+            "    return jax.jit(_step)",
+            "    # graftlint: disable=donation -- a justification\n"
+            "    # that spans two comment lines\n"
+            "    return jax.jit(_step)")
+        assert lint_source(src, [DonationRule()]) == []
+
+    def test_waiver_names_other_rule_does_not_apply(self):
+        src = TestDonation.TP.replace(
+            "return jax.jit(_step)",
+            "return jax.jit(_step)  # graftlint: disable=host-sync")
+        assert _rules_of(lint_source(src, [DonationRule()])) \
+            == {"donation"}
+
+    def test_disable_file(self):
+        src = ("# graftlint: disable-file=host-sync -- host driver\n"
+               + TestHostSync.TP)
+        assert lint_source(src, [HostSyncRule()],
+                           path="spark_agd_tpu/core/fake.py") == []
+
+    def test_parse_error_is_a_finding_not_a_crash(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        findings, n = lint_paths([str(bad)], default_rules(),
+                                 root=str(tmp_path))
+        assert n == 1
+        assert [f.rule for f in findings] == ["parse-error"]
+
+
+# ------------------------------------------------------------ baseline
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(TestDonation.TP)
+        findings, _ = lint_paths([str(mod)], [DonationRule()],
+                                 root=str(tmp_path))
+        assert len(findings) == 1
+        bl = tmp_path / "baseline.json"
+        analysis.save_baseline(str(bl), findings)
+        kept, matched = analysis.apply_baseline(
+            findings, analysis.load_baseline(str(bl)))
+        assert kept == [] and matched == 1
+
+    def test_new_occurrence_still_reported(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(TestDonation.TP)
+        findings, _ = lint_paths([str(mod)], [DonationRule()],
+                                 root=str(tmp_path))
+        bl = tmp_path / "baseline.json"
+        analysis.save_baseline(str(bl), findings)
+        # a SECOND instance of the same pattern: baseline multiset
+        # budget covers only the grandfathered one
+        mod.write_text(TestDonation.TP + TestDonation.TP
+                       .replace("def make(", "def make2("))
+        findings2, _ = lint_paths([str(mod)], [DonationRule()],
+                                  root=str(tmp_path))
+        assert len(findings2) == 2
+        kept, matched = analysis.apply_baseline(
+            findings2, analysis.load_baseline(str(bl)))
+        assert matched == 1 and len(kept) == 1
+
+    def test_moved_line_stays_grandfathered(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(TestDonation.TP)
+        findings, _ = lint_paths([str(mod)], [DonationRule()],
+                                 root=str(tmp_path))
+        bl = tmp_path / "baseline.json"
+        analysis.save_baseline(str(bl), findings)
+        mod.write_text("\n\n\n" + TestDonation.TP)  # lines drift
+        findings2, _ = lint_paths([str(mod)], [DonationRule()],
+                                  root=str(tmp_path))
+        kept, matched = analysis.apply_baseline(
+            findings2, analysis.load_baseline(str(bl)))
+        assert kept == [] and matched == 1
+
+    def test_malformed_baseline_rejected(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps([1, 2, 3]))
+        with pytest.raises(ValueError, match="not a graftlint baseline"):
+            analysis.load_baseline(str(bl))
+
+
+# ----------------------------------------------------------------- CLI
+
+
+class TestCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, GATE, *args], cwd=REPO,
+            capture_output=True, text=True, timeout=120)
+
+    def test_exit_1_on_fixture_true_positive(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(TestDonation.TP)
+        p = self._run(str(mod))
+        assert p.returncode == 1
+        assert "donation" in p.stdout
+
+    def test_json_output(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(TestConstantCapture.TP)
+        p = self._run("--json", str(mod))
+        assert p.returncode == 1
+        out = json.loads(p.stdout)
+        assert out["files"] == 1
+        assert [f["rule"] for f in out["findings"]] \
+            == ["constant-capture"]
+
+    def test_write_baseline_then_clean(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(TestDonation.TP)
+        bl = tmp_path / "baseline.json"
+        assert self._run("--write-baseline", "--baseline", str(bl),
+                         str(mod)).returncode == 0
+        p = self._run("--baseline", str(bl), str(mod))
+        assert p.returncode == 0
+        assert "1 grandfathered" in p.stdout
+
+    def test_unknown_rule_is_usage_error(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("x = 1\n")
+        assert self._run("--rules", "no-such-rule",
+                         str(mod)).returncode == 2
+
+    def test_list_rules(self):
+        p = self._run("--list-rules")
+        assert p.returncode == 0
+        for rule in analysis.RULE_NAMES:
+            assert rule in p.stdout
+
+
+# ------------------------------------------------- the zero-findings
+# gate over the repo itself (tier-1: a future PR that introduces any
+# hazard class fails here before review)
+
+
+class TestRepoIsClean:
+    def test_repo_lints_clean(self):
+        findings, n_files = lint_paths(
+            [os.path.join(REPO, p) for p in GATE_PATHS],
+            default_rules(), root=REPO)
+        assert n_files > 50
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_shipped_baseline_is_empty(self):
+        baseline = analysis.load_baseline(
+            os.path.join(REPO, "graftlint.baseline.json"))
+        assert baseline == []
+
+    def test_schema_and_telemetry_coverage(self):
+        # the schema-drift project pass sees the real obs/ files —
+        # every kind has an example + helper (satellite 2 of ISSUE 6)
+        from spark_agd_tpu.obs import schema
+
+        assert set(schema.KINDS) == set(schema.EXAMPLES)
+        ok, msgs = schema.selfcheck()
+        assert ok, msgs
+
+
+# ------------------------------------------------------ contract pins
+# (the dynamic half: real compiled programs on CPU)
+
+
+class TestContractPins:
+    @pytest.fixture(scope="class")
+    def agd_fit(self):
+        X, y = _tiny_problem()
+        return api.make_runner((X, y), LogisticGradient(),
+                               SquaredL2Updater(), reg_param=1e-3,
+                               num_iterations=5, mesh=False)
+
+    def test_agd_pins_pass(self, agd_fit):
+        w0 = np.zeros(8, np.float32)
+        violations, cost = contracts.check_runner(
+            agd_fit, w0, label="agd", pins=contracts.load_pins())
+        assert violations == [], [v.format() for v in violations]
+        assert cost.label == "agd"
+
+    def test_default_runners_pass_shipped_pins(self):
+        # the exact gate body of `graft_lint.py --contracts`
+        assert contracts.check_default_runners() == []
+
+    def test_donation_aliasing_present_in_real_program(self, agd_fit):
+        hlo = agd_fit.lower_step(
+            np.zeros(8, np.float32)).compile().as_text()
+        assert contracts.donation_honored(hlo)
+
+    def test_constant_budget_violation_detected(self, agd_fit):
+        # the AGD program embeds a few hundred bytes of scalar
+        # constants; a 1-byte budget must trip
+        w0 = np.zeros(8, np.float32)
+        violations, _ = contracts.check_runner(
+            agd_fit, w0, label="agd", pins=contracts.load_pins(),
+            budget_bytes=1)
+        assert [v.contract for v in violations] == ["constant-bytes"]
+
+    def test_census_mismatch_detected(self, agd_fit):
+        w0 = np.zeros(8, np.float32)
+        pins = {"agd": {"collectives": {"all-reduce": 3},
+                        "max_constant_bytes": 1 << 20,
+                        "donation": True}}
+        violations, _ = contracts.check_runner(
+            agd_fit, w0, label="agd", pins=pins)
+        assert [v.contract for v in violations] \
+            == ["collective-census"]
+        assert violations[0].expected == {"all-reduce": 3}
+
+    def test_missing_donation_detected(self):
+        # an UNdonated program must fail the donation pin
+        import jax
+
+        fit = lambda: None  # noqa: E731 — minimal lower_step carrier
+        step = jax.jit(lambda w: w * 2.0)
+        fit.lower_step = lambda w0: step.lower(w0)
+        violations, _ = contracts.check_runner(
+            fit, np.zeros(8, np.float32), label="undonated",
+            pins={}, expect_donation=True)
+        assert [v.contract for v in violations] == ["donation"]
+
+    def test_pin_records_schema_valid(self, agd_fit):
+        from spark_agd_tpu.obs import schema
+
+        w0 = np.zeros(8, np.float32)
+        violations, cost = contracts.check_runner(
+            agd_fit, w0, label="agd", pins=contracts.load_pins(),
+            budget_bytes=1)
+        recs = contracts.pin_records("r-test", "agd", violations, cost)
+        kinds = [(r["contract"], r["ok"]) for r in recs]
+        assert ("constant-bytes", False) in kinds
+        assert ("donation", True) in kinds
+        assert ("collective-census", True) in kinds
+        for rec in recs:
+            assert schema.validate_record(
+                json.loads(json.dumps(rec))) == []
+
+    def test_embedded_constant_bytes_parser(self):
+        hlo = ("  %c1 = f32[128,64]{1,0} constant({...})\n"
+               "  %c2 = s32[] constant(7)\n"
+               "  %c3 = bf16[16]{0} constant({...})\n")
+        assert contracts.embedded_constant_bytes(hlo) \
+            == 128 * 64 * 4 + 4 + 16 * 2
+
+    def test_telemetry_contract_pin_helper(self):
+        from spark_agd_tpu.obs import Telemetry, schema
+
+        with Telemetry() as tel:
+            tel.contract_pin(contract="donation", ok=True, label="agd")
+            tel.contract_pin(contract="collective-census", ok=False,
+                             label="agd", observed={"all-reduce": 1},
+                             expected={"all-reduce": 0})
+            recs = [r for r in tel.records
+                    if r["kind"] == "contract_pin"]
+            snap = tel.registry.snapshot()
+        assert len(recs) == 2
+        for rec in recs:
+            assert schema.validate_record(
+                json.loads(json.dumps(rec))) == []
+        assert snap.get("contracts.violations") == 1
+
+
+# ------------------------------------------- donation fix pinned by
+# existing behavior: the runners' public contract must be unchanged
+
+
+class TestDonatedRunnerBehavior:
+    def test_fit_reusable_with_same_device_array(self):
+        import jax.numpy as jnp
+
+        X, y = _tiny_problem()
+        fit = api.make_runner((X, y), LogisticGradient(),
+                              SquaredL2Updater(), reg_param=1e-3,
+                              num_iterations=5, mesh=False)
+        w_np = np.zeros(8, np.float32)
+        w_dev = jnp.zeros(8, jnp.float32)
+        r1 = fit(w_np)
+        r2 = fit(w_dev)
+        r3 = fit(w_dev)  # donation must not eat the caller's buffer
+        np.testing.assert_array_equal(np.asarray(r1.loss_history),
+                                      np.asarray(r2.loss_history))
+        np.testing.assert_array_equal(np.asarray(r2.loss_history),
+                                      np.asarray(r3.loss_history))
+        # ... and the caller's array survives verbatim
+        np.testing.assert_array_equal(np.asarray(w_dev), w_np)
+
+    def test_lbfgs_fit_reusable_with_same_device_array(self):
+        import jax.numpy as jnp
+
+        X, y = _tiny_problem()
+        fit = api.make_lbfgs_runner((X, y), LogisticGradient(),
+                                    SquaredL2Updater(), reg_param=1e-3,
+                                    num_iterations=5, mesh=False)
+        w_dev = jnp.zeros(8, jnp.float32)
+        r1 = fit(w_dev)
+        r2 = fit(w_dev)
+        np.testing.assert_array_equal(np.asarray(r1.loss_history),
+                                      np.asarray(r2.loss_history))

@@ -65,7 +65,7 @@ def _load(paths: List[str]):
 def _kind(rec: dict) -> Optional[str]:
     k = rec.get("kind")
     if k in ("run", "iteration", "span", "metrics", "attempt",
-             "recovery", "numerics_failure"):
+             "recovery", "numerics_failure", "contract_pin"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -197,6 +197,34 @@ def summarize_resilience(attempts: List[dict], recoveries: List[dict],
     return _table(headers, rows)
 
 
+def summarize_contract_pins(pins: List[dict]) -> str:
+    """The compiled-program contract-pin rollup (``analysis.contracts``
+    via ``tools/graft_lint.py --contracts``): one row per (run,
+    program, contract) — a failing pin prints its observed/expected
+    mismatch so a broken donation or a new hot-loop collective reads
+    straight out of the run JSONL."""
+    headers = ["run_id", "program", "contract", "ok", "detail"]
+    rows = []
+    for rec in sorted(pins, key=lambda r: (r.get("run_id", "-"),
+                                           r.get("label", "-"),
+                                           r.get("contract", "?"))):
+        ok = bool(rec.get("ok"))
+        if ok:
+            detail = "-"
+        else:
+            detail = rec.get("message") or (
+                f"observed={_fmt(rec.get('observed'))} "
+                f"expected={_fmt(rec.get('expected'))}")
+        rows.append([
+            _fmt(rec.get("run_id", "-"))[:18],
+            _fmt(rec.get("label")),
+            _fmt(rec.get("contract", "?")),
+            "ok" if ok else "VIOLATED",
+            detail[:60],
+        ])
+    return _table(headers, rows)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -295,7 +323,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     runs, spans = [], []
-    attempts, recoveries, numerics = [], [], []
+    attempts, recoveries, numerics, pins = [], [], [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -312,6 +340,8 @@ def main(argv=None) -> int:
             recoveries.append(rec)
         elif k == "numerics_failure":
             numerics.append(rec)
+        elif k == "contract_pin":
+            pins.append(rec)
         elif k is None:
             unknown += 1
 
@@ -331,6 +361,11 @@ def main(argv=None) -> int:
               f"{len(recoveries)} recoveries, {len(numerics)} "
               f"numerics failures) ==")
         print(summarize_resilience(attempts, recoveries, numerics))
+    if pins:
+        n_bad = sum(1 for rec in pins if not rec.get("ok"))
+        print(f"\n== contract pins ({len(pins)} checks, "
+              f"{n_bad} violation(s)) ==")
+        print(summarize_contract_pins(pins))
     if unknown:
         print(f"\nnote: {unknown} record(s) of unknown shape ignored")
 

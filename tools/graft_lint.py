@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""graftlint CLI — the zero-findings static-analysis gate.
+
+Runs the JAX-aware lint suite (``spark_agd_tpu/analysis/``) over the
+given paths and exits 0 only when the tree is clean:
+
+    python tools/graft_lint.py spark_agd_tpu tools benchmarks
+    python tools/graft_lint.py --json ...          # machine-readable
+    python tools/graft_lint.py --contracts         # + dynamic pins
+    python tools/graft_lint.py --write-baseline    # grandfather current
+    python tools/graft_lint.py --list-rules
+
+Findings are waived inline with ``# graftlint: disable=<rule>[,...] --
+reason`` on the flagged line (or a standalone comment on the line
+above), ``# graftlint: disable-file=<rule>`` for whole-file opt-outs,
+or grandfathered via the baseline file (``graftlint.baseline.json``,
+kept EMPTY on the shipped tree — the baseline exists so a new rule can
+land before the tree is fully clean, not as a parking lot).
+
+``--contracts`` additionally verifies the dynamic pins against the real
+compiled AGD and L-BFGS runners (CPU): embedded-constant byte budget,
+donation honored in the input-output aliasing, collective census vs the
+checked-in ``spark_agd_tpu/analysis/pins.json``.  This half imports
+jax; the static gate does not.
+
+Exit codes: 0 clean, 1 findings or contract violations, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_PATHS = ("spark_agd_tpu", "tools", "benchmarks")
+_DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "graftlint.baseline.json")
+
+
+def _load_analysis():
+    """The static half of ``spark_agd_tpu.analysis`` WITHOUT importing
+    the parent package (which pulls jax): loaded standalone from its
+    directory, so the lint gate runs backend-free in CI."""
+    if "spark_agd_tpu.analysis" in sys.modules:
+        return sys.modules["spark_agd_tpu.analysis"]
+    name = "graftlint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_REPO_ROOT, "spark_agd_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graft_lint",
+        description="JAX-aware static-analysis gate (graftlint)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: "
+                        f"{' '.join(_DEFAULT_PATHS)} under the repo "
+                        "root)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="baseline file grandfathering known findings "
+                        "(default: graftlint.baseline.json at the repo "
+                        "root, when present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--rules", metavar="NAME[,NAME...]", default=None,
+                   help="run only these rules")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list rule names and descriptions")
+    p.add_argument("--contracts", action="store_true",
+                   help="also verify the dynamic contract pins against "
+                        "the real compiled runners (imports jax)")
+    p.add_argument("--records", metavar="FILE.jsonl", default=None,
+                   help="with --contracts: append the contract_pin "
+                        "records (one per pin per runner, pass AND "
+                        "fail) to this run-record JSONL — "
+                        "tools/agd_report.py surfaces them")
+    args = p.parse_args(argv)
+
+    analysis = _load_analysis()
+    rules = analysis.default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name}: {r.description}")
+        return 0
+    if args.rules:
+        wanted = {s.strip() for s in args.rules.split(",") if s.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}; see "
+                  "--list-rules", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    paths = args.paths or [os.path.join(_REPO_ROOT, d)
+                           for d in _DEFAULT_PATHS]
+    missing = [q for q in paths if not os.path.exists(q)]
+    if missing:
+        print(f"no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    findings, n_files = analysis.lint_paths(paths, rules,
+                                            root=_REPO_ROOT)
+
+    baseline_path = args.baseline or (
+        _DEFAULT_BASELINE if os.path.exists(_DEFAULT_BASELINE) else None)
+    if args.write_baseline:
+        out = args.baseline or _DEFAULT_BASELINE
+        analysis.save_baseline(out, findings)
+        print(f"wrote {len(findings)} finding(s) to {out}")
+        return 0
+    n_grandfathered = 0
+    if baseline_path:
+        baseline = analysis.load_baseline(baseline_path)
+        findings, n_grandfathered = analysis.apply_baseline(findings,
+                                                            baseline)
+
+    violations = []
+    if args.contracts:
+        # the dynamic half needs the real package (jax)
+        sys.path.insert(0, _REPO_ROOT)
+        from spark_agd_tpu.analysis import contracts
+
+        telemetry = None
+        if args.records:
+            from spark_agd_tpu.obs import JSONLSink, Telemetry
+
+            telemetry = Telemetry([JSONLSink(args.records)])
+        violations = contracts.check_default_runners(telemetry=telemetry)
+        if telemetry is not None:
+            telemetry.close()
+    elif args.records:
+        print("--records needs --contracts", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "files": n_files,
+            "findings": [f.to_json() for f in findings],
+            "grandfathered": n_grandfathered,
+            "contract_violations": [
+                {"contract": v.contract, "label": v.label,
+                 "message": v.message, "observed": v.observed,
+                 "expected": v.expected} for v in violations],
+        }, indent=2, default=str))
+    else:
+        for f in findings:
+            print(f.format())
+        for v in violations:
+            print(v.format())
+        tail = f"{n_files} file(s): {len(findings)} finding(s)"
+        if n_grandfathered:
+            tail += f", {n_grandfathered} grandfathered"
+        if args.contracts:
+            tail += f", {len(violations)} contract violation(s)"
+        print(tail)
+    return 1 if (findings or violations) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
