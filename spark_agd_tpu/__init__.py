@@ -103,6 +103,7 @@ from .ops.prox import (  # noqa: F401
 )
 from .ops.sparse import CSRMatrix  # noqa: F401
 from . import obs  # noqa: F401
+from . import serve  # noqa: F401  (the serving plane: docs/SERVING.md)
 from .obs import Telemetry  # noqa: F401
 from .data.streaming import (  # noqa: F401
     StreamingDataset,

@@ -209,6 +209,64 @@ def pin_records(run_id: str, label: str,
     return recs
 
 
+def check_compiled(compiled, *, label: str, pin: dict,
+                   ) -> Tuple[List[ContractViolation], Any]:
+    """Run every pin against one already-compiled program (no AOT hook
+    needed — what the serve engine's per-bucket executables use)."""
+    from ..obs import introspect
+
+    hlo = compiled.as_text()
+    cost = introspect.analyze_compiled(compiled, label=label)
+    budget = int(pin.get("max_constant_bytes", DEFAULT_CONSTANT_BUDGET))
+    violations = []
+    violations += check_constant_budget(hlo, label, budget)
+    if bool(pin.get("donation", True)):
+        violations += check_donation(hlo, label, expect=True)
+    if "collectives" in pin:
+        violations += check_collective_census(cost.collectives, label,
+                                              pin["collectives"])
+    return violations, cost
+
+
+def check_serve_engine(pins: Optional[Dict[str, dict]] = None,
+                       telemetry=None) -> List[ContractViolation]:
+    """The serving half of the dynamic gate: build a small
+    representative :class:`~spark_agd_tpu.serve.engine.ServeEngine`
+    (logistic, two buckets, both ops) and pin EVERY per-bucket compiled
+    program — donated output honored in the aliasing, zero collectives
+    (serving is single-device SPMD-free by construction), and the
+    embedded-constant budget (weights must ride as ARGUMENTS, or a hot
+    swap would recompile).  Labels are per-op (``serve_logistic_
+    predict`` …) — buckets share a pin because they share program
+    structure."""
+    import numpy as np
+
+    from ..models.glm import LogisticRegressionModel
+    from ..serve.engine import ServeEngine
+
+    if pins is None:
+        pins = load_pins()
+    rng = np.random.default_rng(0)
+    model = LogisticRegressionModel(
+        rng.normal(size=16).astype(np.float32), 0.25)
+    engine = ServeEngine(model, max_batch=16, buckets=(8, 16))
+
+    out: List[ContractViolation] = []
+    for (op, bucket), compiled in sorted(
+            engine.compiled_programs().items()):
+        label = engine.program_label(op)
+        violations, cost = check_compiled(
+            compiled, label=f"{label}/b{bucket}",
+            pin=dict(pins.get(label, {})))
+        out.extend(violations)
+        if telemetry is not None:
+            for rec in pin_records(telemetry.run_id,
+                                   f"{label}/b{bucket}", violations,
+                                   cost):
+                telemetry.emit(rec)
+    return out
+
+
 def check_default_runners(pins: Optional[Dict[str, dict]] = None,
                           telemetry=None) -> List[ContractViolation]:
     """The gate body behind ``tools/graft_lint.py --contracts``: build

@@ -8,10 +8,12 @@ round-trip the reference paid in network hops — invisible in the code,
 dominant in the profile (arXiv 1612.01437's silent per-iteration
 overheads).
 
-Scope: the hot-path subsystems — ``core/``, ``parallel/``, and the
+Scope: the hot-path subsystems — ``core/``, ``parallel/``, the
 resilience supervisor (its segment loop brushes against device values
-every boundary).  Host DRIVER files whose loops are host-side by design
-(``core/host_agd.py``, ``core/host_lbfgs.py``) opt out with a
+every boundary), and ``serve/`` (the request path: one device sync per
+batch inside the engine, never a ``float()``/``.item()`` per request in
+the queue worker loop).  Host DRIVER files whose loops are host-side by
+design (``core/host_agd.py``, ``core/host_lbfgs.py``) opt out with a
 ``disable-file`` waiver naming the reason.
 
 Loops inside traced functions are exempt: a Python loop under a trace
@@ -29,6 +31,9 @@ DEFAULT_SCOPE: Tuple[str, ...] = (
     "spark_agd_tpu/core/",
     "spark_agd_tpu/parallel/",
     "spark_agd_tpu/resilience/supervisor.py",
+    # the serving request path: the micro-batch worker loop must sync
+    # once per BATCH (inside serve_batch), never per request
+    "spark_agd_tpu/serve/",
 )
 
 # dotted-call forms that force a device->host transfer of their argument

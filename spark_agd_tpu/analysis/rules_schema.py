@@ -57,6 +57,8 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     "journal_replay": ((), frozenset({"records"})),
     "degraded": ((), frozenset({"surviving"})),
     "contract_pin": ((), frozenset({"contract", "ok"})),
+    "serve_request": ((), frozenset({"rows"})),
+    "serve_latency": ((), frozenset({"requests"})),
 }
 
 

@@ -50,6 +50,11 @@ RUN_METRICS: Dict[str, Tuple[str, float]] = {
     "compile_s": ("lower", 0.50),
     "iters_per_sec": ("higher", 0.15),
     "iters_to_tol": ("lower", 0.10),
+    # serving-soak summaries (tools/serve_drill.py run records): tail
+    # latency is the SLO metric, so its default threshold is tight
+    "p50_ms": ("lower", 0.25),
+    "p99_ms": ("lower", 0.25),
+    "qps": ("higher", 0.15),
 }
 
 PROGRAM_METRICS: Dict[str, Tuple[str, float]] = {

@@ -37,14 +37,17 @@ SCHEMA_VERSION = 1
 
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "numerics_failure", "attempt", "recovery", "heartbeat",
-         "chaos", "journal_replay", "degraded", "contract_pin")
+         "chaos", "journal_replay", "degraded", "contract_pin",
+         "serve_request", "serve_latency")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
-# canonical set for consumers
+# canonical set for consumers.  ``hot_swap`` is the serving registry's
+# generation swap (serve.registry).
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
-                    "host_lost", "elastic_resume", "degraded_continue")
+                    "host_lost", "elastic_resume", "degraded_continue",
+                    "hot_swap")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -86,6 +89,13 @@ _REQUIRED: Dict[str, dict] = {
     # ``contract`` is constant-bytes / donation / collective-census,
     # ``ok`` whether the pin held against the real XLA program
     "contract_pin": {"run_id": str, "contract": str, "ok": bool},
+    # one inference request through the serving plane (serve.queue):
+    # ``rows`` is the request's row count; ``status`` ok/rejected/error
+    "serve_request": {"run_id": str, "rows": int},
+    # one serving-latency rollup (serve.queue.latency_summary):
+    # ``requests`` completed in the window; QPS and percentile fields
+    # ride as optionals
+    "serve_latency": {"run_id": str, "requests": int},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -104,6 +114,10 @@ _OPTIONAL: Dict[str, dict] = {
         # across
         "jax_version": str, "jaxlib_version": str,
         "n_processes": int, "mesh_shape": dict,
+        # serving soak summaries (tools/serve_drill.py): the fields the
+        # perf gate's latency metrics pair on
+        "requests": int, "rejected": int, "hot_swaps": int,
+        "qps": _OPT_NUM, "p50_ms": _OPT_NUM, "p99_ms": _OPT_NUM,
     },
     "iteration": {"L": _NUM, "theta": _NUM, "step": _NUM,
                   "restarted": bool, "accepted": bool,
@@ -163,6 +177,19 @@ _OPTIONAL: Dict[str, dict] = {
         "label": str, "message": str, "observed": _JSON_VAL,
         "expected": _JSON_VAL, "budget_bytes": int, "algorithm": str,
         "tool": str, "timestamp_unix": _NUM,
+    },
+    "serve_request": {
+        "op": str, "status": str, "bucket": int, "batch_rows": int,
+        "queue_ms": _NUM, "latency_ms": _NUM, "generation": int,
+        "model": str, "error": (str, type(None)), "algorithm": str,
+        "tool": str, "timestamp_unix": _NUM,
+    },
+    "serve_latency": {
+        "rows": int, "qps": _OPT_NUM, "p50_ms": _OPT_NUM,
+        "p99_ms": _OPT_NUM, "mean_ms": _OPT_NUM, "max_ms": _OPT_NUM,
+        "queue_depth": int, "rejected": int, "errors": int,
+        "hot_swaps": int, "generation": int, "window_s": _NUM,
+        "model": str, "tool": str, "timestamp_unix": _NUM,
     },
 }
 
@@ -348,6 +375,25 @@ def contract_pin_record(run_id: str, contract: str, ok: bool,
             "ok": bool(ok), **fields}
 
 
+def serve_request_record(run_id: str, rows: int, **fields) -> dict:
+    """One inference request through the serving plane
+    (``serve.queue``): ``rows`` the request's row count, ``status``
+    ok/rejected/error, ``bucket``/``batch_rows`` the padded shape and
+    coalesced batch it rode in, ``generation`` the model generation
+    that served it."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "serve_request",
+            "run_id": run_id, "rows": int(rows), **fields}
+
+
+def serve_latency_record(run_id: str, requests: int, **fields) -> dict:
+    """One serving-latency rollup (``serve.queue.latency_summary``):
+    ``requests`` completed in the window, with QPS, p50/p99/mean/max
+    latency, queue depth, reject/error counts, and the hot-swap census
+    as optional fields."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "serve_latency",
+            "run_id": run_id, "requests": int(requests), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -465,6 +511,22 @@ EXAMPLE_CONTRACT_PIN_RECORD = {
     "tool": "graft_lint",
 }
 
+EXAMPLE_SERVE_REQUEST_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "serve_request",
+    "run_id": "r18c2d3e4-1a2b-0", "rows": 3, "op": "predict_proba",
+    "status": "ok", "bucket": 8, "batch_rows": 7, "generation": 2,
+    "queue_ms": 1.8, "latency_ms": 4.2, "tool": "serve.queue",
+}
+
+EXAMPLE_SERVE_LATENCY_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "serve_latency",
+    "run_id": "r18c2d3e4-1a2b-0", "requests": 240, "rows": 1913,
+    "qps": 412.5, "p50_ms": 2.1, "p99_ms": 9.7, "mean_ms": 2.9,
+    "max_ms": 14.0, "queue_depth": 0, "rejected": 3, "errors": 0,
+    "hot_swaps": 1, "generation": 2, "window_s": 0.582,
+    "tool": "serve.queue",
+}
+
 # the kind-keyed table selfcheck iterates — graftlint's schema-drift
 # rule cross-checks that EVERY registered kind appears here (and has a
 # Telemetry helper), so a new kind cannot land without selfcheck
@@ -483,6 +545,8 @@ EXAMPLES: Dict[str, dict] = {
     "journal_replay": EXAMPLE_JOURNAL_REPLAY_RECORD,
     "degraded": EXAMPLE_DEGRADED_RECORD,
     "contract_pin": EXAMPLE_CONTRACT_PIN_RECORD,
+    "serve_request": EXAMPLE_SERVE_REQUEST_RECORD,
+    "serve_latency": EXAMPLE_SERVE_LATENCY_RECORD,
 }
 
 

@@ -281,6 +281,39 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def serve_request(self, *, rows: int, **fields) -> dict:
+        """Emit (and return) a ``serve_request`` record — one inference
+        request through the serving plane (``serve.queue``) — counting
+        requests and rows (``serve.requests`` / ``serve.rows``), with
+        non-ok statuses additionally landing in ``serve.rejected`` /
+        ``serve.errors`` so shed load is visible in every run
+        summary."""
+        self.registry.counter("serve.requests").inc()
+        self.registry.counter("serve.rows").inc(int(rows))
+        status = fields.get("status")
+        if status == "rejected":
+            self.registry.counter("serve.rejected").inc()
+        elif status == "error":
+            self.registry.counter("serve.errors").inc()
+        rec = schema.serve_request_record(self.run_id, rows, **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def serve_latency(self, *, requests: int, **fields) -> dict:
+        """Emit (and return) a ``serve_latency`` record — one serving
+        rollup (``serve.queue.latency_summary``) — mirroring the
+        headline numbers into gauges (``serve.qps`` / ``serve.p50_ms``
+        / ``serve.p99_ms`` / ``serve.queue_depth``) so dashboards read
+        them off the registry snapshot."""
+        for g in ("qps", "p50_ms", "p99_ms", "queue_depth"):
+            v = fields.get(g)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.registry.gauge(f"serve.{g}").set(v)
+        rec = schema.serve_latency_record(self.run_id, requests,
+                                          **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

@@ -62,6 +62,7 @@ from .errors import (  # noqa: F401
     NumericsFailureError,
     Preempted,
     QuorumLost,
+    ServeOverloaded,
     SimulatedDeviceLoss,
     SupervisorGivingUp,
     classify_failure,

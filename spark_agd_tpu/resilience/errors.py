@@ -83,6 +83,26 @@ class QuorumLost(RuntimeError):
             "restart elastically on restored capacity")
 
 
+class ServeOverloaded(RuntimeError):
+    """The serving plane's typed backpressure rejection
+    (``serve.queue.MicroBatchQueue``): the micro-batching queue is at
+    capacity and admitting the request would let latency grow without
+    bound.  Classified TRANSIENT — the overload clears as the queue
+    drains, so the client-side remedy is the same backoff-and-retry the
+    supervisor applies to a lost device; the SERVER never retries (it
+    sheds, which is the point)."""
+
+    def __init__(self, queued_rows: int, limit_rows: int,
+                 detail: str = ""):
+        extra = f" ({detail})" if detail else ""
+        super().__init__(
+            f"serving queue overloaded: {queued_rows} rows queued "
+            f"against a limit of {limit_rows}{extra}; back off and "
+            "retry")
+        self.queued_rows = int(queued_rows)
+        self.limit_rows = int(limit_rows)
+
+
 class NumericsFailureError(FloatingPointError):
     """The smooth evaluation (or the in-loop loss stream) went
     non-finite — raised by ``utils.debug.report_numerics_failure`` so a
@@ -152,8 +172,9 @@ def classify_failure(exc: BaseException) -> str:
         # unlike HostLost: retrying cannot bring a QUORUM back — must
         # be checked before the transient isinstance row (RuntimeError)
         return FATAL
-    if isinstance(exc, (SimulatedDeviceLoss, HostLost, TimeoutError,
-                        OSError, ConnectionError, BrokenPipeError)):
+    if isinstance(exc, (SimulatedDeviceLoss, HostLost, ServeOverloaded,
+                        TimeoutError, OSError, ConnectionError,
+                        BrokenPipeError)):
         return TRANSIENT
     if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError,
                         AssertionError, NotImplementedError)):

@@ -1,0 +1,41 @@
+"""serve/ — batched low-latency inference for the trained model zoo.
+
+The north star demands "heavy traffic from millions of users"; after six
+PRs the repo could train and survive anything while serving nothing.
+This package is the serving plane, built on the same two disciplines the
+training side already enforces:
+
+- **compile once, never on the request path** (the Flare staged-query
+  lesson, arXiv 1703.08219): :class:`~spark_agd_tpu.serve.engine.
+  ServeEngine` AOT-compiles one program per (op, bucket) pair up front —
+  a small ladder of padded batch shapes — so every request size maps to
+  an existing executable and request-size jitter can never trigger an
+  XLA recompile (the MLPerf TPU-pod fixed-shape playbook,
+  arXiv 1909.09756);
+- **verified state, typed refusals**: :class:`~spark_agd_tpu.serve.
+  registry.ModelRegistry` publishes and loads model generations through
+  ``resilience.manifest``'s CRC-verified manifests, refusing torn or
+  corrupt generations exactly like the training-side loaders, and
+  hot-swaps weights without dropping in-flight requests (weights are
+  program *arguments*, so a swap is a pointer flip, not a recompile).
+
+:class:`~spark_agd_tpu.serve.queue.MicroBatchQueue` sits in front:
+dynamic micro-batching (max-batch + max-wait admission), padding to the
+nearest bucket, per-request slicing, and backpressure with a typed
+``ServeOverloaded`` rejection classified TRANSIENT by the resilience
+taxonomy.  Telemetry rides the canonical ``obs.schema`` record family
+(``serve_request`` / ``serve_latency``); ``tools/serve_drill.py`` is the
+load-generator gate.  See ``docs/SERVING.md``.
+"""
+
+from ..resilience.errors import ServeOverloaded  # noqa: F401
+from .engine import (BucketLadder, ModelSpec, ServeEngine,  # noqa: F401
+                     params_of, spec_of)
+from .queue import MicroBatchQueue, ServeResult  # noqa: F401
+from .registry import LoadedModel, ModelRegistry  # noqa: F401
+
+__all__ = [
+    "BucketLadder", "LoadedModel", "MicroBatchQueue", "ModelRegistry",
+    "ModelSpec", "ServeEngine", "ServeOverloaded", "ServeResult",
+    "params_of", "spec_of",
+]

@@ -1,0 +1,428 @@
+"""AOT-compiled batched inference engine over a bucketed shape ladder.
+
+The training side learned twice (PR 5's constant-capture bug, the
+staged-compile wedge) that the program and the data must be split: pay
+tracing/compilation once, thread everything that changes per call as an
+argument.  Serving doubles down on both points:
+
+- **weights are arguments**, so a registry hot-swap binds a new
+  generation into the *same* compiled programs — no recompile, no
+  dropped requests (the swap is an atomic reference flip under the call
+  lock);
+- **batch shapes come from a fixed ladder** (powers of two up to
+  ``max_batch``), all compiled at construction — a request of any
+  admissible size pads to the nearest bucket and runs an existing
+  executable.  The request path NEVER compiles; an unknown shape is a
+  typed error, not a 20-second XLA stall;
+- **the output buffer is donated**: each program takes a same-shaped
+  scratch array, overwrites it in place (``dynamic_update_slice`` over
+  the full extent, value-identical to returning the result), and the
+  engine rebinds the aliased output as the next call's scratch — steady
+  state allocates nothing per batch.  The aliasing is pinned by the
+  ``serve_*`` entries in ``analysis/pins.json`` (donation honored, zero
+  collectives, constant-byte budget) against the real compiled HLO.
+
+The forward math reuses the model classes' own kernels (``ops.sparse.
+matvec``, ``models.mlp.mlp_forward``) so a served prediction is the same
+computation the in-memory model runs, just batched and padded — padding
+rows are sliced off host-side before the caller sees them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.sparse import matvec
+
+# model class name -> the short kind the program labels use
+_KIND_SHORT = {
+    "LogisticRegressionModel": "logistic",
+    "SVMModel": "svm",
+    "LinearRegressionModel": "linear",
+    "SoftmaxRegressionModel": "softmax",
+    "MLPModel": "mlp",
+}
+
+# ops each kind serves; SVM/linear have no probability (mirrors the
+# model classes' own method surface)
+_KIND_OPS = {
+    "logistic": ("predict", "predict_proba"),
+    "svm": ("predict",),
+    "linear": ("predict",),
+    "softmax": ("predict", "predict_proba"),
+    "mlp": ("predict", "predict_proba"),
+}
+
+DEFAULT_MAX_BATCH = 64
+DEFAULT_MIN_BUCKET = 8
+
+
+class ServeSpecMismatch(ValueError):
+    """A hot-swap candidate's shape signature differs from the programs
+    the engine compiled (different feature count, class count, threshold
+    mode, activation, or dtype) — binding it would need a recompile on
+    the request path, which the engine refuses by design.  Classified
+    FATAL by the resilience taxonomy (``ValueError``): the fix is a new
+    engine, not a retry."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The shape signature of a servable model — everything that is
+    baked into the compiled programs (weights are NOT part of it; they
+    stay arguments so generations sharing a spec share programs)."""
+
+    kind: str                 # _KIND_SHORT value
+    n_features: int
+    num_classes: int          # 1 for the binary/regression family
+    dtype: str                # weights dtype string, e.g. "float32"
+    has_threshold: bool = False
+    activation: Optional[str] = None  # MLP only
+    hidden_units: int = 0             # MLP only
+
+    @property
+    def ops(self) -> Tuple[str, ...]:
+        return _KIND_OPS[self.kind]
+
+
+def spec_of(model) -> ModelSpec:
+    """Derive the shape signature of any registered model class."""
+    name = type(model).__name__
+    kind = _KIND_SHORT.get(name)
+    if kind is None:
+        raise TypeError(
+            f"{name} is not a servable model class; known: "
+            f"{sorted(_KIND_SHORT)}")
+    if kind == "mlp":
+        from ..models.mlp import _ACTIVATIONS
+
+        act = next((n for n, f in _ACTIVATIONS.items()
+                    if f is model.activation), None)
+        if act is None:
+            raise ValueError(
+                "cannot serve an MLP with an unregistered activation "
+                f"callable; known: {sorted(_ACTIVATIONS)}")
+        d, h = model.params["W1"].shape
+        k = model.params["W2"].shape[1]
+        return ModelSpec(kind, int(d), int(k),
+                         str(model.params["W1"].dtype),
+                         activation=act, hidden_units=int(h))
+    w = model.weights
+    if kind == "softmax":
+        return ModelSpec(kind, int(w.shape[0]), int(w.shape[1]),
+                         str(w.dtype))
+    return ModelSpec(kind, int(w.shape[0]), 1, str(w.dtype),
+                     has_threshold=getattr(model, "threshold",
+                                           None) is not None)
+
+
+def params_of(model, spec: Optional[ModelSpec] = None) -> Dict[str, Any]:
+    """The model's weights as the argument pytree the compiled programs
+    take.  Scalars (intercept, threshold) are cast to the weights dtype
+    so the served math promotes exactly like the in-memory model's."""
+    spec = spec or spec_of(model)
+    if spec.kind == "mlp":
+        return {k: jnp.asarray(v) for k, v in model.params.items()}
+    w = jnp.asarray(model.weights)
+    params: Dict[str, Any] = {
+        "w": w, "b": jnp.asarray(model.intercept, dtype=w.dtype)}
+    if spec.has_threshold:
+        params["thr"] = jnp.asarray(model.threshold, dtype=w.dtype)
+    return params
+
+
+def _make_forward(spec: ModelSpec, op: str):
+    """The pure ``(params, X) -> values`` function for one (kind, op) —
+    the model classes' own math, verbatim."""
+    if op not in spec.ops:
+        raise ValueError(
+            f"op {op!r} is not served for kind {spec.kind!r} "
+            f"(supported: {spec.ops})")
+    kind = spec.kind
+
+    def forward(params, X):
+        if kind == "mlp":
+            from ..models.mlp import _ACTIVATIONS, mlp_forward
+
+            logits = mlp_forward(params, X, _ACTIVATIONS[spec.activation])
+            if op == "predict_proba":
+                return jax.nn.softmax(logits, axis=-1)
+            return jnp.argmax(logits, axis=-1)
+        if kind == "softmax":
+            logits = matvec(X, params["w"]) + params["b"]
+            if op == "predict_proba":
+                return jax.nn.softmax(logits, axis=-1)
+            return jnp.argmax(logits, axis=-1)
+        margin = matvec(X, params["w"]) + params["b"]
+        if kind == "logistic":
+            p = jax.nn.sigmoid(margin)
+            if op == "predict_proba":
+                return p
+            if spec.has_threshold:
+                return (p > params["thr"]).astype(jnp.float32)
+            return p
+        # svm / linear predict
+        if kind == "svm" and spec.has_threshold:
+            return (margin > params["thr"]).astype(jnp.float32)
+        return margin
+
+    return forward
+
+
+def _make_program(forward):
+    """Wrap a forward into the donated-scratch program shape: ``out`` is
+    a same-shaped buffer overwritten in place (full-extent
+    ``dynamic_update_slice`` — value-identical to ``forward``'s result,
+    but keeps the donated input live so XLA honors the aliasing)."""
+
+    def program(params, X, out):
+        vals = forward(params, X)
+        return jax.lax.dynamic_update_slice(out, vals,
+                                            (0,) * vals.ndim)
+
+    return jax.jit(program, donate_argnums=2)
+
+
+class BucketLadder:
+    """The fixed padding-shape ladder: powers of two from ``min_bucket``
+    up to ``max_batch`` (``max_batch`` itself is always a rung, even
+    when it is not a power of two).  ``bucket_for(n)`` maps any
+    admissible request size to the smallest rung that holds it."""
+
+    def __init__(self, max_batch: int = DEFAULT_MAX_BATCH,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 buckets: Optional[Sequence[int]] = None):
+        max_batch = int(max_batch)
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if buckets is None:
+            b = min(int(min_bucket), max_batch)
+            ladder = []
+            while b < max_batch:
+                ladder.append(b)
+                b *= 2
+            ladder.append(max_batch)
+        else:
+            ladder = sorted({int(b) for b in buckets})
+            if not ladder or ladder[0] < 1:
+                raise ValueError(f"invalid bucket ladder {buckets!r}")
+            if ladder[-1] != max_batch:
+                raise ValueError(
+                    f"the top bucket must equal max_batch={max_batch}, "
+                    f"got {ladder!r}")
+        self.buckets: Tuple[int, ...] = tuple(ladder)
+        self.max_batch = max_batch
+
+    def bucket_for(self, n: int) -> int:
+        if n < 1 or n > self.max_batch:
+            raise ValueError(
+                f"batch of {n} rows is not admissible (1 <= n <= "
+                f"max_batch={self.max_batch})")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise AssertionError("unreachable: ladder tops at max_batch")
+
+    def __repr__(self):
+        return f"BucketLadder{self.buckets}"
+
+
+@dataclasses.dataclass
+class _Program:
+    """One compiled (op, bucket) executable plus its donated scratch."""
+
+    compiled: Any
+    scratch: Any          # device array; rebound to the output per call
+    out_shape: Tuple[int, ...]
+    out_dtype: Any
+    compiles: int = 1
+
+
+class ServeEngine:
+    """See module docstring.  Construction compiles every (op, bucket)
+    program up front (the warmup IS ``__init__`` — an engine that
+    exists can serve); ``bind`` hot-swaps a new same-spec generation's
+    weights into the running programs.
+
+    Thread-safety: ``serve_batch``/``predict``/``bind`` serialize on one
+    internal lock (the donated scratch makes concurrent calls into the
+    same program unsound by construction); the micro-batching queue is
+    the intended concurrency layer above.
+    """
+
+    def __init__(self, model, *, generation: int = 0,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 min_bucket: int = DEFAULT_MIN_BUCKET,
+                 buckets: Optional[Sequence[int]] = None,
+                 ops: Optional[Sequence[str]] = None,
+                 telemetry=None):
+        self.spec = spec_of(model)
+        self.ladder = BucketLadder(max_batch, min_bucket, buckets)
+        self.ops: Tuple[str, ...] = tuple(ops or self.spec.ops)
+        for op in self.ops:
+            if op not in self.spec.ops:
+                raise ValueError(
+                    f"op {op!r} not served for kind {self.spec.kind!r} "
+                    f"(supported: {self.spec.ops})")
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._params = params_of(model, self.spec)
+        self._generation = int(generation)
+        self._np_dtype = np.dtype(self.spec.dtype)
+        self.hot_swaps = 0
+        self._programs: Dict[Tuple[str, int], _Program] = {}
+        self._compile_programs()
+
+    # -- warmup (compile the whole ladder, count every compile) -----------
+    def _compile_programs(self) -> None:
+        p_struct = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._params)
+        span = (self.telemetry.span("serve_warmup")
+                if self.telemetry is not None else None)
+        if span is not None:
+            span.__enter__()
+        try:
+            for op in self.ops:
+                forward = _make_forward(self.spec, op)
+                jfn = _make_program(forward)
+                for bucket in self.ladder.buckets:
+                    x_struct = jax.ShapeDtypeStruct(
+                        (bucket, self.spec.n_features), self._np_dtype)
+                    out_struct = jax.eval_shape(forward, p_struct,
+                                                x_struct)
+                    compiled = jfn.lower(
+                        p_struct, x_struct,
+                        jax.ShapeDtypeStruct(out_struct.shape,
+                                             out_struct.dtype)).compile()
+                    scratch = jnp.zeros(out_struct.shape,
+                                        out_struct.dtype)
+                    self._programs[(op, bucket)] = _Program(
+                        compiled, scratch, tuple(out_struct.shape),
+                        out_struct.dtype)
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+        if self.telemetry is not None:
+            self._emit_program_costs()
+
+    def _emit_program_costs(self) -> None:
+        from ..obs import introspect
+
+        for (op, bucket), prog in self._programs.items():
+            cost = introspect.analyze_compiled(
+                prog.compiled, label=self.program_label(op))
+            self.telemetry.program_cost(cost, algorithm="serve",
+                                        bucket=bucket)
+
+    def program_label(self, op: str) -> str:
+        """The pin/telemetry label of one op's programs (shared across
+        buckets — the pin is about program *structure*, which the
+        bucket does not change)."""
+        return f"serve_{self.spec.kind}_{op}"
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    @property
+    def max_batch(self) -> int:
+        return self.ladder.max_batch
+
+    def compiled_programs(self) -> Dict[Tuple[str, int], Any]:
+        """(op, bucket) -> the real ``jax.stages.Compiled`` — what the
+        contract pins (``analysis.contracts.check_serve_engine``) and
+        tests introspect."""
+        return {k: p.compiled for k, p in self._programs.items()}
+
+    def compile_census(self) -> Dict[str, int]:
+        """Per-(op, bucket) compile counts — the drill pins this frozen
+        after warmup: serving must never add an entry or a count."""
+        return {f"{op}/b{bucket}": p.compiles
+                for (op, bucket), p in self._programs.items()}
+
+    # -- hot swap ----------------------------------------------------------
+    def bind(self, model, generation: int) -> None:
+        """Atomically swap in a new generation's weights.  The spec must
+        match the compiled programs (else :class:`ServeSpecMismatch`);
+        an in-flight batch finishes on the old weights — the swap waits
+        for the call lock, never interrupts."""
+        new_spec = spec_of(model)
+        if new_spec != self.spec:
+            raise ServeSpecMismatch(
+                f"generation {generation} has spec {new_spec}, engine "
+                f"compiled for {self.spec}; refusing a hot swap that "
+                "would recompile on the request path")
+        new_params = params_of(model, new_spec)
+        with self._lock:
+            self._params = new_params
+            self._generation = int(generation)
+            self.hot_swaps += 1
+
+    # -- the serving path --------------------------------------------------
+    def serve_batch(self, X: np.ndarray,
+                    op: str = "predict") -> Tuple[np.ndarray, int, int]:
+        """Serve one coalesced batch: pad to the nearest bucket, run the
+        pre-compiled program, slice the padding back off.  Returns
+        ``(values, generation, bucket)`` with ``values`` already on
+        host.  Raises ``ValueError`` for inadmissible sizes/ops — the
+        request path never compiles."""
+        if op not in self.ops:
+            raise ValueError(
+                f"op {op!r} not served for kind {self.spec.kind!r} "
+                f"(supported: {self.ops})")
+        X = np.ascontiguousarray(X, dtype=self._np_dtype)
+        if X.ndim != 2 or X.shape[1] != self.spec.n_features:
+            raise ValueError(
+                f"expected a (n, {self.spec.n_features}) batch, got "
+                f"shape {X.shape}")
+        n = X.shape[0]
+        bucket = self.ladder.bucket_for(n)
+        prog = self._programs.get((op, bucket))
+        if prog is None:
+            raise ValueError(
+                f"no compiled program for op={op!r} bucket={bucket} "
+                f"(ops: {self.ops}, ladder: {self.ladder.buckets}) — "
+                "the request path never compiles")
+        if n == bucket:
+            padded = X
+        else:
+            padded = np.zeros((bucket, X.shape[1]), self._np_dtype)
+            padded[:n] = X
+        with self._lock:
+            generation = self._generation
+            out = prog.compiled(self._params, padded, prog.scratch)
+            # the donated scratch's buffer now IS the output; copy the
+            # result to host, then recycle the device buffer as the
+            # next call's scratch
+            host = jax.device_get(out)
+            prog.scratch = out
+        return host[:n], generation, bucket
+
+    def predict(self, X, op: str = "predict") -> np.ndarray:
+        """Direct (queue-less) convenience: serve ``X`` of any size,
+        chunking batches larger than ``max_batch`` through the top
+        bucket.  One device sync per chunk, results concatenated."""
+        X = np.asarray(X, dtype=self._np_dtype)
+        squeeze = X.ndim == 1
+        if squeeze:
+            X = X[None, :]
+        chunks: List[np.ndarray] = []
+        top = self.ladder.max_batch
+        for start in range(0, X.shape[0], top):
+            chunks.append(self.serve_batch(X[start:start + top], op)[0])
+        vals = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        return vals[0] if squeeze else vals
+
+    def __repr__(self):
+        return (f"ServeEngine(kind={self.spec.kind}, "
+                f"d={self.spec.n_features}, ops={self.ops}, "
+                f"ladder={self.ladder.buckets}, "
+                f"generation={self._generation})")

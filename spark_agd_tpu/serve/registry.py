@@ -1,0 +1,191 @@
+"""Versioned model registry over the CRC-verified manifest machinery.
+
+A served model is a checkpoint with users attached, so the registry
+speaks the exact commit protocol the training side's distributed
+checkpoints do (``resilience.manifest``): a published generation is one
+shard npz (the model snapshot, written atomically with per-entry CRCs
+by ``models.glm.save_model``) plus one ``manifest-gNNNNNNNN.json``
+carrying the file-level CRC32/size, committed by atomically repointing
+``manifest.json``.  That buys serving the same guarantees training
+already trusts:
+
+- a generation is visible only once fully landed (manifest-after-shard
+  ordering);
+- a torn, truncated, or bit-flipped shard FAILS ``verify_manifest`` and
+  the loader walks back one generation instead of serving garbage —
+  the refusal is identical to ``DistributedCheckpointer``'s, down to
+  the ``checkpoint_fallback`` recovery record;
+- old generations are the rollback chain (``keep`` newest retained,
+  GC'd with the same in-flight-orphan sparing).
+
+Hot swap: ``refresh(engine=...)`` loads the newest verifiable
+generation and binds its weights into the running engine's compiled
+programs — weights are program arguments, so in-flight batches finish
+on the old generation and the next batch serves the new one; nothing
+drops and nothing recompiles.  Each swap emits a ``recovery`` record
+with the new ``hot_swap`` action.
+
+A training loop publishes with ``registry.publish(model)``; a serving
+process polls ``registry.refresh(engine)`` — the two never need to
+share more than the directory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, List, Optional
+
+from ..resilience import manifest as mf
+from ..utils.checkpoint import CheckpointCorruptError
+
+DEFAULT_KEEP = 4
+
+# serving is single-process on the loading side: the one shard of a
+# published generation is written as process 0
+_SHARD_PROCESS = 0
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    """One verified, loaded generation."""
+
+    generation: int
+    model: Any
+    path: str                 # the shard file the model came from
+    manifest: mf.Manifest
+
+
+class ModelRegistry:
+    """See module docstring."""
+
+    def __init__(self, directory: str, *, telemetry=None,
+                 fingerprint: Optional[str] = None,
+                 keep: int = DEFAULT_KEEP):
+        self.directory = str(directory)
+        self.telemetry = telemetry
+        self.fingerprint = fingerprint
+        self.keep = max(1, int(keep))
+        self._current: Optional[LoadedModel] = None
+
+    # -- publishing --------------------------------------------------------
+    def newest_generation(self) -> int:
+        """Newest committed generation id (0 when none)."""
+        gens = mf.committed_generations(self.directory)
+        return gens[0] if gens else 0
+
+    def publish(self, model, *, converged: bool = False,
+                prior_iters: int = 0) -> int:
+        """Commit one model snapshot as the next generation: shard
+        first (atomic npz), manifest after — the same write ordering
+        the distributed checkpointer uses, so a crash mid-publish
+        leaves an invisible orphan, never a half-visible generation.
+        Returns the new generation id."""
+        from ..models.glm import save_model
+
+        os.makedirs(self.directory, exist_ok=True)
+        generation = self.newest_generation() + 1
+        shard = mf.shard_name(generation, _SHARD_PROCESS)
+        path = os.path.join(self.directory, shard)
+        save_model(model, path)
+        entry = mf.ShardEntry(path=shard, process=_SHARD_PROCESS,
+                              crc32=mf.crc32_file(path),
+                              size=os.path.getsize(path))
+        man = mf.Manifest(generation=generation, process_count=1,
+                          shards=[entry], fingerprint=self.fingerprint,
+                          converged=bool(converged),
+                          prior_iters=int(prior_iters))
+        mf.write_manifest(self.directory, man)
+        mf.gc_generations(self.directory, self.keep)
+        if self.telemetry is not None:
+            self.telemetry.recovery(
+                action="checkpoint", generation=generation, path=shard,
+                source="serve.registry", tool="serve.registry")
+        return generation
+
+    # -- loading -----------------------------------------------------------
+    def load(self, generation: Optional[int] = None) -> LoadedModel:
+        """Load one specific generation (default: the HEAD manifest's),
+        REFUSING anything unverifiable: a missing manifest raises
+        ``LookupError``, a failed file-level CRC/size check or an
+        unparseable shard raises ``CheckpointCorruptError`` — exactly
+        the training-side loader contract, with no fallback."""
+        man = mf.load_manifest(self.directory, generation)
+        if man is None:
+            raise LookupError(
+                f"no committed generation"
+                + (f" g{generation}" if generation is not None else "")
+                + f" in {self.directory!r}")
+        return self._load_manifest(man)
+
+    def _load_manifest(self, man: mf.Manifest) -> LoadedModel:
+        from ..models.glm import load_model
+
+        problems = mf.verify_manifest(man, self.directory)
+        if problems:
+            raise CheckpointCorruptError(
+                self.directory,
+                ValueError(f"generation g{man.generation}: "
+                           + "; ".join(problems)))
+        path = man.shard_path(self.directory, _SHARD_PROCESS)
+        try:
+            model = load_model(path)
+        except (ValueError, KeyError, OSError) as e:
+            raise CheckpointCorruptError(path, e) from e
+        return LoadedModel(man.generation, model, path, man)
+
+    def load_newest(self) -> Optional[LoadedModel]:
+        """Walk committed generations newest → oldest, returning the
+        first that verifies and loads; unverifiable generations are
+        skipped with a ``checkpoint_fallback`` recovery record (the
+        multi-generation ``.bak`` chain, serving edition).  None when
+        nothing loadable exists."""
+        for generation in mf.committed_generations(self.directory):
+            man = mf.load_manifest(self.directory, generation)
+            if man is None:
+                continue
+            try:
+                return self._load_manifest(man)
+            except CheckpointCorruptError as e:
+                if self.telemetry is not None:
+                    self.telemetry.recovery(
+                        action="checkpoint_fallback",
+                        generation=generation, reason=str(e)[:200],
+                        source="serve.registry", tool="serve.registry")
+        return None
+
+    # -- hot swap ----------------------------------------------------------
+    @property
+    def current(self) -> Optional[LoadedModel]:
+        return self._current
+
+    def refresh(self, engine=None) -> Optional[int]:
+        """Poll for a newer loadable generation; when found, bind it
+        into ``engine`` (when given) and emit a ``hot_swap`` recovery
+        record.  Returns the new generation id, or None when already
+        current (or nothing loadable).  A spec-incompatible generation
+        propagates ``ServeSpecMismatch`` from the engine — the registry
+        never half-swaps."""
+        newest = self.newest_generation()
+        have = self._current.generation if self._current else 0
+        if newest <= have and self._current is not None:
+            return None
+        loaded = self.load_newest()
+        if loaded is None or (self._current is not None
+                              and loaded.generation <= have):
+            return None
+        if engine is not None:
+            engine.bind(loaded.model, loaded.generation)
+        previous = have
+        self._current = loaded
+        if self.telemetry is not None:
+            self.telemetry.recovery(
+                action="hot_swap", generation=loaded.generation,
+                from_generation=previous, source="serve.registry",
+                tool="serve.registry")
+        return loaded.generation
+
+    def gc(self) -> List[str]:
+        """Housekeeping: drop all but the ``keep`` newest generations
+        (same in-flight-orphan sparing as the training GC)."""
+        return mf.gc_generations(self.directory, self.keep)

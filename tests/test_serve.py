@@ -1,0 +1,741 @@
+"""Serving-plane tests: the AOT bucketed engine, the micro-batching
+queue, and the manifest-backed hot-swap registry (``serve/``).
+
+The contracts pinned here are the ones the north star's traffic story
+rests on: every request size maps to a program compiled at warmup (the
+census never grows while serving), the donated output scratch is
+honored by XLA, a registry round-trip is bit-exact for every model
+class (including ``SoftmaxRegressionModel`` and the padding edge sizes
+1 / bucket boundary / max_batch), corrupt generations are refused with
+the training-side loader semantics, overload is a typed TRANSIENT
+rejection, and every emitted record is schema-valid.  The drill tool
+gate (``tools/serve_drill.py``) rides at the bottom, chaos-drill style:
+a reduced smoke in tier-1, the full soak behind ``-m 'serve and slow'``.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu.models.glm import (LinearRegressionModel,
+                                      LogisticRegressionModel,
+                                      SVMModel, SoftmaxRegressionModel)
+from spark_agd_tpu.models.mlp import MLPModel, init_mlp_params
+from spark_agd_tpu.obs import Telemetry, schema
+from spark_agd_tpu.resilience.errors import (FATAL, TRANSIENT,
+                                             ServeOverloaded,
+                                             classify_failure)
+from spark_agd_tpu.resilience.faults import scramble_file, truncate_file
+from spark_agd_tpu.serve import (BucketLadder, MicroBatchQueue,
+                                 ModelRegistry, ServeEngine, params_of,
+                                 spec_of)
+from spark_agd_tpu.serve.engine import ServeSpecMismatch
+from spark_agd_tpu.utils.checkpoint import CheckpointCorruptError
+
+pytestmark = pytest.mark.serve
+
+D = 10  # feature count every fixture model shares
+MAX_BATCH = 16  # fixtures use ladder (4, 8, 16)
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _logistic(seed=1):
+    r = _rng(seed)
+    return LogisticRegressionModel(
+        r.normal(size=D).astype(np.float32), float(r.normal()) * 0.1)
+
+
+@pytest.fixture(scope="module")
+def logistic_engine():
+    return ServeEngine(_logistic(), generation=1, max_batch=MAX_BATCH,
+                       min_bucket=4)
+
+
+@pytest.fixture(scope="module")
+def softmax_engine():
+    r = _rng(3)
+    model = SoftmaxRegressionModel(
+        r.normal(size=(D, 4)).astype(np.float32),
+        r.normal(size=4).astype(np.float32))
+    return ServeEngine(model, max_batch=MAX_BATCH, min_bucket=8)
+
+
+@pytest.fixture(scope="module")
+def mlp_engine():
+    model = MLPModel(init_mlp_params(D, 6, 3, seed=5))
+    return ServeEngine(model, max_batch=MAX_BATCH, min_bucket=8)
+
+
+def _X(n, seed=7, d=D):
+    return _rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# the bucket ladder
+
+
+class TestBucketLadder:
+    def test_default_powers_of_two(self):
+        assert BucketLadder(64, 8).buckets == (8, 16, 32, 64)
+
+    def test_non_power_of_two_max_is_top_rung(self):
+        assert BucketLadder(48, 8).buckets == (8, 16, 32, 48)
+
+    def test_min_bucket_clamped_to_max(self):
+        assert BucketLadder(4, 8).buckets == (4,)
+
+    def test_bucket_for_maps_to_smallest_holding_rung(self):
+        ladder = BucketLadder(16, 4)
+        assert [ladder.bucket_for(n) for n in (1, 4, 5, 8, 9, 16)] \
+            == [4, 4, 8, 8, 16, 16]
+
+    @pytest.mark.parametrize("n", [0, -1, 17])
+    def test_inadmissible_sizes_raise(self, n):
+        with pytest.raises(ValueError, match="not admissible"):
+            BucketLadder(16, 4).bucket_for(n)
+
+    def test_explicit_ladder_must_top_at_max_batch(self):
+        with pytest.raises(ValueError, match="top bucket"):
+            BucketLadder(16, buckets=(4, 8))
+        assert BucketLadder(16, buckets=(8, 16)).buckets == (8, 16)
+
+
+# ---------------------------------------------------------------------------
+# model specs
+
+
+class TestModelSpec:
+    def test_logistic_spec(self):
+        spec = spec_of(_logistic())
+        assert (spec.kind, spec.n_features, spec.num_classes,
+                spec.has_threshold) == ("logistic", D, 1, True)
+        assert spec.ops == ("predict", "predict_proba")
+
+    def test_cleared_threshold_changes_spec(self):
+        m = _logistic().clear_threshold()
+        assert spec_of(m).has_threshold is False
+
+    def test_softmax_and_mlp_specs(self):
+        r = _rng(0)
+        sm = spec_of(SoftmaxRegressionModel(
+            r.normal(size=(D, 5)).astype(np.float32)))
+        assert (sm.kind, sm.num_classes) == ("softmax", 5)
+        mlp = spec_of(MLPModel(init_mlp_params(D, 7, 3)))
+        assert (mlp.kind, mlp.num_classes, mlp.hidden_units,
+                mlp.activation) == ("mlp", 3, 7, "tanh")
+
+    def test_svm_and_linear_serve_predict_only(self):
+        r = _rng(0)
+        w = r.normal(size=D).astype(np.float32)
+        assert spec_of(SVMModel(w)).ops == ("predict",)
+        assert spec_of(LinearRegressionModel(w)).ops == ("predict",)
+
+    def test_unservable_class_raises(self):
+        with pytest.raises(TypeError, match="not a servable"):
+            spec_of(object())
+
+    def test_params_scalars_follow_weights_dtype(self):
+        params = params_of(_logistic())
+        assert params["b"].dtype == params["w"].dtype
+        assert params["thr"].dtype == params["w"].dtype
+
+
+# ---------------------------------------------------------------------------
+# the engine
+
+
+class TestServeEngine:
+    @pytest.mark.parametrize("n", [1, 3, 4, 5, 16])
+    def test_logistic_matches_model(self, logistic_engine, n):
+        model = _logistic()
+        X = _X(n, seed=n)
+        got = logistic_engine.predict(X, "predict_proba")
+        assert np.allclose(got, np.asarray(model.predict_proba(X)),
+                           atol=1e-6)
+        pred = logistic_engine.predict(X)
+        assert np.array_equal(pred, np.asarray(model.predict(X)))
+        assert set(np.unique(pred)) <= {0.0, 1.0}
+
+    def test_cleared_threshold_predict_returns_proba(self):
+        model = _logistic().clear_threshold()
+        eng = ServeEngine(model, max_batch=8)
+        X = _X(5)
+        assert np.allclose(eng.predict(X),
+                           np.asarray(model.predict_proba(X)),
+                           atol=1e-6)
+
+    def test_svm_and_linear_margins(self):
+        r = _rng(9)
+        w = r.normal(size=D).astype(np.float32)
+        svm = SVMModel(w, 0.2)
+        lin = LinearRegressionModel(w, 0.2)
+        X = _X(6)
+        assert np.array_equal(
+            ServeEngine(svm, max_batch=8).predict(X),
+            np.asarray(svm.predict(X)))
+        assert np.allclose(
+            ServeEngine(lin, max_batch=8).predict(X),
+            np.asarray(lin.predict(X)), atol=1e-6)
+
+    def test_svm_has_no_proba_program(self):
+        svm = SVMModel(_rng(9).normal(size=D).astype(np.float32))
+        eng = ServeEngine(svm, max_batch=8)
+        with pytest.raises(ValueError, match="not served"):
+            eng.predict(_X(3), "predict_proba")
+
+    def test_softmax_matches_model(self, softmax_engine):
+        r = _rng(3)
+        model = SoftmaxRegressionModel(
+            r.normal(size=(D, 4)).astype(np.float32),
+            r.normal(size=4).astype(np.float32))
+        X = _X(7)
+        proba = softmax_engine.predict(X, "predict_proba")
+        assert np.allclose(proba, np.asarray(model.predict_proba(X)),
+                           atol=1e-6)
+        assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+        assert np.array_equal(softmax_engine.predict(X),
+                              np.asarray(model.predict(X)))
+
+    def test_mlp_matches_model(self, mlp_engine):
+        model = MLPModel(init_mlp_params(D, 6, 3, seed=5))
+        X = _X(9)
+        assert np.allclose(mlp_engine.predict(X, "predict_proba"),
+                           np.asarray(model.predict_proba(X)),
+                           atol=1e-6)
+        assert np.array_equal(mlp_engine.predict(X),
+                              np.asarray(model.predict(X)))
+
+    def test_predict_chunks_batches_beyond_max(self, logistic_engine):
+        model = _logistic()
+        X = _X(2 * MAX_BATCH + 3)
+        got = logistic_engine.predict(X, "predict_proba")
+        assert got.shape == (2 * MAX_BATCH + 3,)
+        assert np.allclose(got, np.asarray(model.predict_proba(X)),
+                           atol=1e-6)
+
+    def test_single_row_squeeze(self, logistic_engine):
+        x = _X(1)[0]
+        got = logistic_engine.predict(x, "predict_proba")
+        assert got.shape == ()
+
+    def test_wrong_feature_count_raises(self, logistic_engine):
+        with pytest.raises(ValueError, match="batch"):
+            logistic_engine.serve_batch(_X(3, d=D + 1))
+
+    def test_census_is_one_compile_per_program_and_frozen(
+            self, logistic_engine):
+        census = logistic_engine.compile_census()
+        assert set(census) == {f"{op}/b{b}"
+                               for op in ("predict", "predict_proba")
+                               for b in (4, 8, 16)}
+        assert all(v == 1 for v in census.values())
+        for n in (1, 5, 9, 16):  # every rung, twice
+            logistic_engine.serve_batch(_X(n), "predict")
+            logistic_engine.serve_batch(_X(n), "predict_proba")
+        assert logistic_engine.compile_census() == census
+
+    def test_donation_honored_in_every_compiled_program(
+            self, logistic_engine, softmax_engine, mlp_engine):
+        for eng in (logistic_engine, softmax_engine, mlp_engine):
+            for key, compiled in eng.compiled_programs().items():
+                assert "input_output_alias" in compiled.as_text(), \
+                    f"{eng.spec.kind} {key}: donated scratch not " \
+                    "honored"
+
+    def test_zero_collectives_in_serving_programs(self,
+                                                  logistic_engine):
+        from spark_agd_tpu.obs import introspect
+
+        for compiled in logistic_engine.compiled_programs().values():
+            cost = introspect.analyze_compiled(compiled, label="serve")
+            assert cost.n_collectives == 0
+
+    def test_serve_batch_reports_generation_and_bucket(self):
+        eng = ServeEngine(_logistic(), generation=7, max_batch=8)
+        vals, generation, bucket = eng.serve_batch(_X(3))
+        assert (generation, bucket, vals.shape) == (7, 8, (3,))
+
+    def test_bind_hot_swaps_without_recompiling(self):
+        eng = ServeEngine(_logistic(1), generation=1, max_batch=8)
+        census = eng.compile_census()
+        other = _logistic(2)
+        X = _X(5)
+        before = eng.predict(X, "predict_proba")
+        eng.bind(other, 2)
+        after = eng.predict(X, "predict_proba")
+        assert eng.generation == 2 and eng.hot_swaps == 1
+        assert eng.compile_census() == census
+        assert not np.allclose(before, after)
+        assert np.allclose(after, np.asarray(other.predict_proba(X)),
+                           atol=1e-6)
+
+    def test_bind_refuses_spec_mismatch(self):
+        eng = ServeEngine(_logistic(), max_batch=8)
+        wrong_d = LogisticRegressionModel(
+            _rng(0).normal(size=D + 2).astype(np.float32))
+        with pytest.raises(ServeSpecMismatch, match="refusing"):
+            eng.bind(wrong_d, 2)
+        assert classify_failure(ServeSpecMismatch("x")) == FATAL
+
+    def test_program_labels(self, logistic_engine):
+        assert logistic_engine.program_label("predict") \
+            == "serve_logistic_predict"
+
+
+# ---------------------------------------------------------------------------
+# the micro-batching queue
+
+
+class TestMicroBatchQueue:
+    def test_submit_requires_started(self, logistic_engine):
+        q = MicroBatchQueue(logistic_engine)
+        with pytest.raises(RuntimeError, match="not running"):
+            q.submit(_X(1))
+
+    def test_roundtrip_and_slicing(self, logistic_engine):
+        model = _logistic()
+        with MicroBatchQueue(logistic_engine, max_wait_us=100) as q:
+            sizes = (1, 3, 7, 16)
+            futs = [(n, q.submit(_X(n, seed=n), "predict_proba"))
+                    for n in sizes]
+            for n, f in futs:
+                res = f.result(timeout=30)
+                assert res.rows == n and res.value.shape == (n,)
+                want = np.asarray(
+                    model.predict_proba(_X(n, seed=n)))
+                assert np.allclose(res.value, want, atol=1e-6)
+
+    def test_coalescing_shares_one_batch(self, logistic_engine):
+        # a long window: the three submits land before the worker
+        # closes the batch, so they ride one padded program call
+        with MicroBatchQueue(logistic_engine,
+                             max_wait_us=300_000) as q:
+            futs = [q.submit(_X(2, seed=s)) for s in range(3)]
+            results = [f.result(timeout=30) for f in futs]
+        assert all(r.batch_rows == 6 for r in results)
+        assert {r.bucket for r in results} == {8}
+
+    def test_ops_never_share_a_batch(self, logistic_engine):
+        with MicroBatchQueue(logistic_engine,
+                             max_wait_us=200_000) as q:
+            f1 = q.submit(_X(2), "predict")
+            f2 = q.submit(_X(2), "predict_proba")
+            r1, r2 = f1.result(30), f2.result(30)
+        assert r1.batch_rows == 2 and r2.batch_rows == 2
+        assert set(np.unique(r1.value)) <= {0.0, 1.0}
+
+    def test_single_row_result_squeezed(self, logistic_engine):
+        with MicroBatchQueue(logistic_engine, max_wait_us=0) as q:
+            res = q.submit(_X(1)[0], "predict_proba").result(30)
+        assert res.value.shape == () and res.rows == 1
+
+    def test_oversized_and_bad_requests_fail_typed(self,
+                                                   logistic_engine):
+        with MicroBatchQueue(logistic_engine) as q:
+            with pytest.raises(ValueError, match="not admissible"):
+                q.submit(_X(MAX_BATCH + 1))
+            with pytest.raises(ValueError, match="features"):
+                q.submit(_X(3, d=D + 2))
+            with pytest.raises(ValueError, match="not served"):
+                q.submit(_X(3), "decode")
+
+    def test_overload_is_typed_transient_and_admitted_drain(
+            self, logistic_engine):
+        tel = Telemetry()
+        q = MicroBatchQueue(logistic_engine, max_wait_us=300_000,
+                            max_queue_rows=6, telemetry=tel).start()
+        admitted, rejected = [], 0
+        for _ in range(20):
+            try:
+                admitted.append(q.submit(_X(2)))
+            except ServeOverloaded as e:
+                rejected += 1
+                assert classify_failure(e) == TRANSIENT
+                assert e.limit_rows == 6
+        assert rejected > 0 and admitted
+        assert all(f.result(30).rows == 2 for f in admitted)
+        q.stop()
+        recs = [r for r in tel.records
+                if r.get("kind") == "serve_request"
+                and r.get("status") == "rejected"]
+        assert len(recs) == rejected
+
+    def test_submit_after_stop_raises(self, logistic_engine):
+        q = MicroBatchQueue(logistic_engine).start()
+        q.stop()
+        with pytest.raises(RuntimeError, match="not running"):
+            q.submit(_X(1))
+
+    def test_stop_drains_admitted_requests(self, logistic_engine):
+        q = MicroBatchQueue(logistic_engine,
+                            max_wait_us=200_000).start()
+        futs = [q.submit(_X(2, seed=s)) for s in range(4)]
+        q.stop()  # must flush the coalescing window, not drop it
+        assert all(f.result(timeout=5).rows == 2 for f in futs)
+
+    def test_telemetry_records_are_schema_valid(self, logistic_engine):
+        tel = Telemetry()
+        with MicroBatchQueue(logistic_engine, max_wait_us=100,
+                             telemetry=tel) as q:
+            for n in (1, 5, 9):
+                q.submit(_X(n)).result(30)
+            q.emit_latency()
+        errors = [e for rec in tel.records
+                  for e in schema.validate_record(rec)]
+        assert errors == []
+        kinds = {r["kind"] for r in tel.records}
+        assert {"serve_request", "serve_latency"} <= kinds
+        snap = tel.registry.snapshot()
+        assert snap["serve.requests"] == 3
+        assert snap["serve.rows"] == 15
+
+    def test_latency_summary_fields(self, logistic_engine):
+        with MicroBatchQueue(logistic_engine, max_wait_us=0) as q:
+            for _ in range(5):
+                q.submit(_X(2)).result(30)
+            s = q.latency_summary()
+        assert s["requests"] == 5 and s["rows"] == 10
+        assert s["p50_ms"] <= s["p99_ms"] <= s["max_ms"]
+        assert s["qps"] > 0 and s["rejected"] == 0
+
+    def test_hot_swap_mid_stream_drops_nothing(self):
+        eng = ServeEngine(_logistic(1), generation=1, max_batch=8)
+        m2 = _logistic(2)
+        results = []
+        with MicroBatchQueue(eng, max_wait_us=0) as q:
+            for i in range(40):
+                if i == 20:
+                    eng.bind(m2, 2)
+                results.append(q.submit(_X(2)).result(30))
+        generations = [r.generation for r in results]
+        assert len(results) == 40
+        assert set(generations) == {1, 2}
+        assert generations == sorted(generations)  # monotone swap
+
+
+# ---------------------------------------------------------------------------
+# the registry (manifest-backed generations, CRC refusal, hot swap)
+
+
+def _all_models():
+    r = _rng(11)
+    w = r.normal(size=D).astype(np.float32)
+    return [
+        LogisticRegressionModel(w, 0.3),
+        LogisticRegressionModel(w, 0.3, threshold=None),
+        SVMModel(w, -0.1),
+        LinearRegressionModel(w, 1.5),
+        SoftmaxRegressionModel(
+            r.normal(size=(D, 4)).astype(np.float32),
+            r.normal(size=4).astype(np.float32)),
+        MLPModel(init_mlp_params(D, 5, 3, seed=2)),
+    ]
+
+
+class TestModelRegistry:
+    def test_publish_commits_shard_then_manifest(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        gen = reg.publish(_logistic())
+        assert gen == 1
+        names = sorted(os.listdir(tmp_path))
+        assert "manifest.json" in names
+        assert any(n.startswith("manifest-g00000001") for n in names)
+        assert any(n.startswith("shard-g00000001.h000") for n in names)
+
+    @pytest.mark.parametrize("model", _all_models(),
+                             ids=lambda m: type(m).__name__ + (
+                                 "_nothr" if getattr(m, "threshold",
+                                                     0) is None
+                                 else ""))
+    def test_round_trip_bit_identical_every_class(self, tmp_path,
+                                                  model):
+        """The satellite pin: snapshot → manifest-verified restore →
+        predictions bit-identical to the in-memory model."""
+        reg = ModelRegistry(str(tmp_path))
+        gen = reg.publish(model)
+        restored = reg.load(gen).model
+        assert type(restored) is type(model)
+        X = _X(9)
+        assert np.array_equal(np.asarray(model.predict(X)),
+                              np.asarray(restored.predict(X)))
+        if hasattr(model, "predict_proba"):
+            assert np.array_equal(
+                np.asarray(model.predict_proba(X)),
+                np.asarray(restored.predict_proba(X)))
+
+    @pytest.mark.parametrize("n", [1, 4, MAX_BATCH],
+                             ids=["batch1", "boundary", "max_batch"])
+    def test_served_round_trip_bit_identical_at_edge_sizes(
+            self, tmp_path, n):
+        """Registry-restored weights served through the bucketed
+        engine are bit-identical to serving the in-memory model — at
+        the padding edges (1 row, exactly a bucket, max_batch)."""
+        model = _logistic()
+        reg = ModelRegistry(str(tmp_path))
+        gen = reg.publish(model)
+        restored = reg.load(gen).model
+        eng = ServeEngine(model, generation=0, max_batch=MAX_BATCH,
+                          min_bucket=4)
+        X = _X(n, seed=n)
+        before = [eng.predict(X, op)
+                  for op in ("predict", "predict_proba")]
+        eng.bind(restored, gen)
+        after = [eng.predict(X, op)
+                 for op in ("predict", "predict_proba")]
+        for b, a in zip(before, after):
+            assert np.array_equal(b, a)
+
+    def test_generations_increment(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        assert reg.newest_generation() == 0
+        assert reg.publish(_logistic(1)) == 1
+        assert reg.publish(_logistic(2)) == 2
+        assert reg.newest_generation() == 2
+        assert reg.load().generation == 2  # HEAD points at the newest
+
+    def test_missing_generation_raises_lookup(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        with pytest.raises(LookupError, match="no committed"):
+            reg.load()
+        assert reg.load_newest() is None
+
+    def test_crc_tamper_refused_and_falls_back(self, tmp_path):
+        tel = Telemetry()
+        reg = ModelRegistry(str(tmp_path), telemetry=tel)
+        m1, m2 = _logistic(1), _logistic(2)
+        reg.publish(m1)
+        gen2 = reg.publish(m2)
+        shard2 = os.path.join(
+            tmp_path, reg.load(gen2).manifest.shards[0].path)
+        scramble_file(shard2)
+        # explicit load of the tampered generation: typed refusal,
+        # exactly like the training-side loaders
+        with pytest.raises(CheckpointCorruptError, match="CRC32"):
+            reg.load(gen2)
+        # the newest-first walk falls back to the intact generation 1
+        loaded = reg.load_newest()
+        assert loaded.generation == 1
+        assert np.array_equal(np.asarray(loaded.model.weights),
+                              np.asarray(m1.weights))
+        falls = [r for r in tel.records
+                 if r.get("action") == "checkpoint_fallback"]
+        assert len(falls) == 1 and falls[0]["generation"] == gen2
+
+    def test_torn_write_refused(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        gen = reg.publish(_logistic())
+        shard = os.path.join(tmp_path,
+                             reg.load(gen).manifest.shards[0].path)
+        truncate_file(shard, keep_fraction=0.4)
+        with pytest.raises(CheckpointCorruptError, match="torn|size"):
+            reg.load(gen)
+        assert reg.load_newest() is None  # nothing intact remains
+
+    def test_refresh_binds_and_emits_hot_swap(self, tmp_path):
+        tel = Telemetry()
+        reg = ModelRegistry(str(tmp_path), telemetry=tel)
+        m1, m2 = _logistic(1), _logistic(2)
+        reg.publish(m1)
+        eng = ServeEngine(m1, generation=0, max_batch=8)
+        assert reg.refresh(eng) == 1
+        assert reg.refresh(eng) is None  # already current: no-op
+        reg.publish(m2)
+        assert reg.refresh(eng) == 2
+        assert eng.generation == 2
+        swaps = [r for r in tel.records
+                 if r.get("action") == "hot_swap"]
+        assert [s["generation"] for s in swaps] == [1, 2]
+        for rec in swaps:
+            assert schema.validate_record(rec) == []
+
+    def test_refresh_propagates_spec_mismatch(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path))
+        reg.publish(_logistic(1))
+        eng = ServeEngine(_logistic(1), generation=0, max_batch=8)
+        assert reg.refresh(eng) == 1
+        reg.publish(LogisticRegressionModel(
+            _rng(0).normal(size=D + 3).astype(np.float32)))
+        with pytest.raises(ServeSpecMismatch):
+            reg.refresh(eng)
+
+    def test_gc_keeps_newest(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path), keep=2)
+        for s in range(1, 5):
+            reg.publish(_logistic(s))
+        from spark_agd_tpu.resilience import manifest as mf
+
+        assert mf.committed_generations(str(tmp_path)) == [4, 3]
+        assert reg.load_newest().generation == 4
+
+
+# ---------------------------------------------------------------------------
+# schema / telemetry / perfgate integration
+
+
+class TestServeTelemetrySchema:
+    def test_serve_kinds_registered_with_examples_and_helpers(self):
+        assert {"serve_request", "serve_latency"} <= set(schema.KINDS)
+        assert "serve_request" in schema.EXAMPLES
+        assert "serve_latency" in schema.EXAMPLES
+        tel = Telemetry()
+        assert callable(tel.serve_request)
+        assert callable(tel.serve_latency)
+
+    def test_examples_validate_and_selfcheck_covers(self):
+        ok, msgs = schema.selfcheck()
+        assert ok, msgs
+        assert schema.validate_record(
+            schema.EXAMPLE_SERVE_REQUEST_RECORD) == []
+        assert schema.validate_record(
+            schema.EXAMPLE_SERVE_LATENCY_RECORD) == []
+
+    def test_required_fields_enforced(self):
+        bad = dict(schema.EXAMPLE_SERVE_REQUEST_RECORD)
+        del bad["rows"]
+        assert schema.validate_record(bad)
+        bad = dict(schema.EXAMPLE_SERVE_LATENCY_RECORD)
+        del bad["requests"]
+        assert schema.validate_record(bad)
+
+    def test_helper_counters(self):
+        tel = Telemetry()
+        tel.serve_request(rows=3, status="ok")
+        tel.serve_request(rows=1, status="rejected")
+        tel.serve_request(rows=2, status="error")
+        tel.serve_latency(requests=3, qps=10.0, p99_ms=5.0)
+        snap = tel.registry.snapshot()
+        assert snap["serve.requests"] == 3
+        assert snap["serve.rows"] == 6
+        assert snap["serve.rejected"] == 1
+        assert snap["serve.errors"] == 1
+        assert snap["serve.qps"] == 10.0
+        assert snap["serve.p99_ms"] == 5.0
+
+    def test_hot_swap_is_a_canonical_recovery_action(self):
+        assert "hot_swap" in schema.RECOVERY_ACTIONS
+
+    def test_perfgate_gates_tail_latency(self):
+        from spark_agd_tpu.obs.perfgate import compare_records
+
+        key = {"tool": "serve_drill", "name": "soak",
+               "algorithm": "serve"}
+        base = [schema.run_record(p50_ms=10.0, p99_ms=50.0, **key)]
+        fat = [schema.run_record(p50_ms=11.0, p99_ms=400.0, **key)]
+        res = compare_records(base, fat,
+                              thresholds={"p50_ms": 0.5,
+                                          "p99_ms": 0.5})
+        assert [d.metric for d in res.regressions] == ["p99_ms"]
+        ok = [schema.run_record(p50_ms=9.0, p99_ms=40.0, **key)]
+        assert compare_records(base, ok).ok
+
+
+class TestServeContracts:
+    def test_serve_engine_passes_checked_in_pins(self):
+        from spark_agd_tpu.analysis import contracts
+
+        tel = Telemetry()
+        violations = contracts.check_serve_engine(telemetry=tel)
+        assert violations == []
+        pins = [r for r in tel.records
+                if r.get("kind") == "contract_pin"]
+        # 2 ops x 2 buckets x 3 contracts, all passing
+        assert len(pins) == 12 and all(r["ok"] for r in pins)
+        assert all(schema.validate_record(r) == [] for r in pins)
+
+    def test_serve_pin_violation_detected(self):
+        from spark_agd_tpu.analysis import contracts
+
+        pins = {"serve_logistic_predict":
+                {"collectives": {"all-reduce": 2},
+                 "max_constant_bytes": 65536, "donation": True},
+                "serve_logistic_predict_proba":
+                {"collectives": {"all-reduce": 0},
+                 "max_constant_bytes": 65536, "donation": True}}
+        violations = contracts.check_serve_engine(pins=pins)
+        assert violations, "a wrong collective pin must be caught"
+        assert all(v.contract == "collective-census"
+                   for v in violations)
+
+
+class TestServeReport:
+    def test_report_serving_section(self, tmp_path, capsys):
+        import json
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import agd_report
+
+        run_id = "r-serve-test"
+        records = [
+            schema.serve_request_record(run_id, 4, status="ok",
+                                        generation=1),
+            schema.serve_request_record(run_id, 2, status="ok",
+                                        generation=2),
+            schema.serve_request_record(run_id, 1, status="rejected"),
+            schema.serve_latency_record(run_id, 2, qps=99.5,
+                                        p50_ms=1.5, p99_ms=8.0),
+            schema.recovery_record(run_id, "hot_swap", generation=2),
+        ]
+        path = tmp_path / "serve.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in records))
+        rc = agd_report.main([str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "== serving (3 requests, 1 latency rollups) ==" in out
+        assert "99.5" in out and "8" in out
+        serving = out[out.index("== serving"):]
+        line = next(ln for ln in serving.splitlines()
+                    if ln.startswith(run_id[:18]))
+        cells = line.split()
+        # requests / rows / ok / rejected / errors
+        assert cells[1:6] == ["3", "7", "2", "1", "0"]
+        assert cells[9] == "1"  # hot_swaps
+        assert cells[10] == "1,2"  # generations
+
+
+# ---------------------------------------------------------------------------
+# the drill tool gate
+
+
+def _drill_cmd(tmp_path, *extra):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_drill.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(tool))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return ([sys.executable, tool, "--out", str(tmp_path / "drill")]
+            + list(extra)), env
+
+
+class TestServeDrillTool:
+    def test_smoke_soak_exits_zero(self, tmp_path):
+        """exit-0/1 contract: a reduced soak (4 clients, mixed sizes,
+        hot swap, overload, perf gate) inside the tier-1 budget."""
+        cmd, env = _drill_cmd(tmp_path, "--requests", "15",
+                              "--max-batch", "16")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300, env=env)
+        assert proc.returncode == 0, \
+            f"drill failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}"
+        assert "SERVE DRILL PASSED" in proc.stdout
+
+    @pytest.mark.slow
+    def test_full_soak(self, tmp_path):
+        """The acceptance-criteria configuration (behind
+        ``-m 'serve and slow'``): the default high-concurrency soak."""
+        cmd, env = _drill_cmd(tmp_path, "-v", "--clients", "6",
+                              "--requests", "80")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=560, env=env)
+        assert proc.returncode == 0, \
+            f"drill failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+        assert "SERVE DRILL PASSED" in proc.stdout

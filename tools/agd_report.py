@@ -65,7 +65,8 @@ def _load(paths: List[str]):
 def _kind(rec: dict) -> Optional[str]:
     k = rec.get("kind")
     if k in ("run", "iteration", "span", "metrics", "attempt",
-             "recovery", "numerics_failure", "contract_pin"):
+             "recovery", "numerics_failure", "contract_pin",
+             "serve_request", "serve_latency"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -225,6 +226,48 @@ def summarize_contract_pins(pins: List[dict]) -> str:
     return _table(headers, rows)
 
 
+def summarize_serving(requests: List[dict], latencies: List[dict],
+                      recoveries: List[dict]) -> str:
+    """The serving rollup (``serve_request`` / ``serve_latency``
+    records from ``serve.queue``, plus ``hot_swap`` recovery records
+    from the registry): per run — request/row/reject/error counts, the
+    newest latency rollup's QPS and p50/p99 tail, and the hot-swap
+    census — the mirror of the resilience and contract-pin sections."""
+    per_run: Dict[str, dict] = defaultdict(
+        lambda: {"requests": 0, "rows": 0, "ok": 0, "rejected": 0,
+                 "errors": 0, "latency": None, "hot_swaps": 0,
+                 "generations": set()})
+    for r in requests:
+        e = per_run[r.get("run_id", "-")]
+        e["requests"] += 1
+        e["rows"] += int(r.get("rows", 0) or 0)
+        status = r.get("status", "ok")
+        key = status if status in ("rejected",) else (
+            "errors" if status == "error" else "ok")
+        e[key] += 1
+        if r.get("generation") is not None:
+            e["generations"].add(r["generation"])
+    for rec in latencies:
+        e = per_run[rec.get("run_id", "-")]
+        e["latency"] = rec  # records are in file order; keep the newest
+    for rec in recoveries:
+        if rec.get("action") == "hot_swap":
+            per_run[rec.get("run_id", "-")]["hot_swaps"] += 1
+    headers = ["run_id", "requests", "rows", "ok", "rejected", "errors",
+               "qps", "p50_ms", "p99_ms", "hot_swaps", "generations"]
+    rows = []
+    for run_id, e in sorted(per_run.items()):
+        lat = e["latency"] or {}
+        gens = ",".join(str(g) for g in sorted(e["generations"])) or "-"
+        rows.append([
+            _fmt(run_id)[:18], str(e["requests"]), str(e["rows"]),
+            str(e["ok"]), str(e["rejected"]), str(e["errors"]),
+            _fmt(lat.get("qps")), _fmt(lat.get("p50_ms")),
+            _fmt(lat.get("p99_ms")), str(e["hot_swaps"]), gens,
+        ])
+    return _table(headers, rows)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -324,6 +367,7 @@ def main(argv=None) -> int:
 
     runs, spans = [], []
     attempts, recoveries, numerics, pins = [], [], [], []
+    serve_reqs, serve_lats = [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -342,6 +386,10 @@ def main(argv=None) -> int:
             numerics.append(rec)
         elif k == "contract_pin":
             pins.append(rec)
+        elif k == "serve_request":
+            serve_reqs.append(rec)
+        elif k == "serve_latency":
+            serve_lats.append(rec)
         elif k is None:
             unknown += 1
 
@@ -366,6 +414,10 @@ def main(argv=None) -> int:
         print(f"\n== contract pins ({len(pins)} checks, "
               f"{n_bad} violation(s)) ==")
         print(summarize_contract_pins(pins))
+    if serve_reqs or serve_lats:
+        print(f"\n== serving ({len(serve_reqs)} requests, "
+              f"{len(serve_lats)} latency rollups) ==")
+        print(summarize_serving(serve_reqs, serve_lats, recoveries))
     if unknown:
         print(f"\nnote: {unknown} record(s) of unknown shape ignored")
 

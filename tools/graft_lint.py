@@ -18,10 +18,11 @@ kept EMPTY on the shipped tree — the baseline exists so a new rule can
 land before the tree is fully clean, not as a parking lot).
 
 ``--contracts`` additionally verifies the dynamic pins against the real
-compiled AGD and L-BFGS runners (CPU): embedded-constant byte budget,
-donation honored in the input-output aliasing, collective census vs the
-checked-in ``spark_agd_tpu/analysis/pins.json``.  This half imports
-jax; the static gate does not.
+compiled AGD and L-BFGS runners plus the serving engine's per-bucket
+programs (CPU): embedded-constant byte budget, donation honored in the
+input-output aliasing, collective census vs the checked-in
+``spark_agd_tpu/analysis/pins.json``.  This half imports jax; the
+static gate does not.
 
 Exit codes: 0 clean, 1 findings or contract violations, 2 usage error.
 """
@@ -139,6 +140,7 @@ def main(argv=None) -> int:
 
             telemetry = Telemetry([JSONLSink(args.records)])
         violations = contracts.check_default_runners(telemetry=telemetry)
+        violations += contracts.check_serve_engine(telemetry=telemetry)
         if telemetry is not None:
             telemetry.close()
     elif args.records:

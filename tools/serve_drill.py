@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Load-generator drill for the serving plane — CI proof the queue,
+engine, and registry hold up under concurrent traffic.
+
+One process, four phases against a logistic model served on CPU:
+
+1. **Warmup census** — publish generation 1 to a fresh registry and
+   build the engine; every (op, bucket) program must compile EXACTLY
+   once, observed both by the engine's own census and the persistent
+   compile-cache census (``utils.compile_cache.observe_compile``).
+2. **Concurrent soak** — ``--clients`` threads (>= 4) each fire
+   ``--requests`` requests of mixed sizes (1 .. max_batch, seeded RNG)
+   through the micro-batching queue, each response verified against a
+   per-generation numpy reference.  Mid-soak, generation 2 is published
+   and hot-swapped in: both generations must serve (the response
+   carries the generation that produced it), with ZERO dropped or
+   wrongly-answered requests and ZERO new compiles.
+3. **Overload leg** — a second, tiny queue is flooded while its worker
+   is not running: the typed ``ServeOverloaded`` must fire, classify
+   TRANSIENT (the resilience taxonomy), and every ADMITTED request must
+   still complete once the worker starts.
+4. **Tail-latency gate** — the soak's p50/p99 go through the REAL
+   ``obs.perfgate`` comparison core against a budget baseline record
+   (``--p50-budget-ms`` / ``--p99-budget-ms`` with zero threshold): a
+   fat tail fails the drill exactly like a perf regression fails the
+   perf gate.
+
+PASS (exit 0) additionally requires every record in the emitted JSONL
+(serve_request / serve_latency / recovery / run) to validate against
+the canonical ``obs.schema``.  Any miss prints the reason and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serve_drill.py [--out DIR] [-v]
+
+CPU-deterministic apart from wall-clock; runs in a few seconds.  See
+``docs/SERVING.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/serve_drill.py",
+        description="serving-plane load-generator drill")
+    p.add_argument("--out", default=None,
+                   help="artifact directory (default: a tempdir)")
+    p.add_argument("--clients", type=int, default=4,
+                   help="concurrent client threads (>= 4 for the "
+                        "acceptance configuration; default 4)")
+    p.add_argument("--requests", type=int, default=60,
+                   help="requests per client (default 60)")
+    p.add_argument("--features", type=int, default=24)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-wait-us", type=int, default=1500)
+    p.add_argument("--p50-budget-ms", type=float, default=250.0,
+                   help="p50 latency budget the perf gate enforces "
+                        "(generous: CI hosts are contended)")
+    p.add_argument("--p99-budget-ms", type=float, default=1000.0,
+                   help="p99 tail-latency budget the perf gate "
+                        "enforces")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.clients < 1 or args.requests < 2:
+        print("need at least 1 client and 2 requests", file=sys.stderr)
+        return 1
+
+    import numpy as np
+
+    from spark_agd_tpu.models.glm import LogisticRegressionModel
+    from spark_agd_tpu.obs import JSONLSink, Telemetry, schema
+    from spark_agd_tpu.obs.perfgate import compare_records
+    from spark_agd_tpu.resilience.errors import (TRANSIENT,
+                                                 ServeOverloaded,
+                                                 classify_failure)
+    from spark_agd_tpu.serve import (MicroBatchQueue, ModelRegistry,
+                                     ServeEngine)
+    from spark_agd_tpu.utils import compile_cache
+
+    failures = []
+
+    def check(ok, what):
+        tag = "ok" if ok else "FAIL"
+        if args.verbose or not ok:
+            print(f"[{tag}] {what}")
+        if not ok:
+            failures.append(what)
+        return ok
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="serve_drill_")
+    os.makedirs(out_dir, exist_ok=True)
+    jsonl = os.path.join(out_dir, "serve_drill.jsonl")
+    telemetry = Telemetry([JSONLSink(jsonl)])
+    rng = np.random.default_rng(args.seed)
+    D = args.features
+
+    def make_model(seed):
+        r = np.random.default_rng(seed)
+        return LogisticRegressionModel(
+            r.normal(size=D).astype(np.float32) * 0.7,
+            float(r.normal()) * 0.2)
+
+    # references the clients verify against: generation -> (w, b, thr)
+    models = {1: make_model(1), 2: make_model(2)}
+
+    def reference(generation, X, op):
+        m = models[generation]
+        margin = X.astype(np.float64) @ np.asarray(
+            m.weights, np.float64) + m.intercept
+        proba = 1.0 / (1.0 + np.exp(-margin))
+        if op == "predict_proba":
+            return proba
+        return (proba > m.threshold).astype(np.float32)
+
+    # -- phase 1: registry generation 1 + engine warmup census ----------
+    registry = ModelRegistry(os.path.join(out_dir, "registry"),
+                             telemetry=telemetry)
+    registry.publish(models[1])
+    cache_dir = os.path.join(out_dir, "xla_cache")
+    compile_cache.enable(cache_dir, min_compile_time_secs=0)
+    with compile_cache.observe_compile(cache_dir,
+                                       telemetry.registry):
+        engine = ServeEngine(models[1], generation=1,
+                             max_batch=args.max_batch,
+                             min_bucket=4, telemetry=telemetry)
+    registry.refresh(engine)
+    warm_census = engine.compile_census()
+    n_programs = len(engine.ops) * len(engine.ladder.buckets)
+    check(len(warm_census) == n_programs
+          and all(v == 1 for v in warm_census.values()),
+          f"warmup compiled each of the {n_programs} (op, bucket) "
+          f"programs exactly once: {warm_census}")
+    cache_files = compile_cache.stats(cache_dir)["files"]
+    check(cache_files > 0,
+          f"compile-cache census saw the warmup compiles "
+          f"({cache_files} cache file(s))")
+
+    # -- phase 2: concurrent soak with a mid-soak hot swap --------------
+    queue = MicroBatchQueue(engine, max_wait_us=args.max_wait_us,
+                            max_queue_rows=64 * args.max_batch,
+                            telemetry=telemetry).start()
+    swap_after = (args.clients * args.requests) // 2
+    served = {"n": 0, "mismatch": 0, "dropped": 0}
+    served_generations = set()
+    lock = threading.Lock()
+    swap_done = threading.Event()
+
+    def maybe_swap():
+        with lock:
+            due = served["n"] >= swap_after and not swap_done.is_set()
+            if due:
+                swap_done.set()  # claimed under the lock: one swapper
+        if due:
+            registry.publish(models[2])
+            registry.refresh(engine)
+
+    def client(idx):
+        crng = np.random.default_rng(1000 + idx)
+        for i in range(args.requests):
+            n = int(crng.integers(1, args.max_batch + 1))
+            op = "predict_proba" if (i % 3) else "predict"
+            X = crng.normal(size=(n, D)).astype(np.float32)
+            try:
+                res = queue.submit(X, op).result(timeout=60)
+            except Exception:  # noqa: BLE001 — counted, not raised
+                with lock:
+                    served["dropped"] += 1
+                continue
+            want = reference(res.generation, X, op)
+            good = bool(np.allclose(res.value, want, atol=1e-5))
+            with lock:
+                served["n"] += 1
+                served["mismatch"] += 0 if good else 1
+                served_generations.add(res.generation)
+            maybe_swap()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    queue.emit_latency()
+    summary = queue.latency_summary()
+    queue.stop()
+
+    total = args.clients * args.requests
+    check(served["n"] == total and served["dropped"] == 0,
+          f"all {total} soak requests served, zero dropped "
+          f"(served {served['n']}, dropped {served['dropped']})")
+    check(served["mismatch"] == 0,
+          f"every response matches its generation's reference "
+          f"({served['mismatch']} mismatches)")
+    check(served_generations == {1, 2},
+          f"the mid-soak hot swap served BOTH generations "
+          f"(saw {sorted(served_generations)})")
+    check(engine.hot_swaps >= 1,
+          f"engine recorded the hot swap ({engine.hot_swaps})")
+    check(engine.compile_census() == warm_census,
+          "the soak triggered zero new compiles (request-size jitter "
+          "never recompiles)")
+
+    # -- phase 3: typed overload + drain ---------------------------------
+    # a long coalescing window (0.4 s) and a tiny row cap: the flood
+    # of 32 submits lands entirely inside the window, so everything
+    # past the cap is deterministically shed while admitted requests
+    # wait out the window and then complete
+    small = MicroBatchQueue(engine, max_wait_us=400_000,
+                            max_queue_rows=8,
+                            telemetry=telemetry).start()
+    admitted, rejected = [], 0
+    overload_transient = True
+    for _ in range(32):
+        try:
+            admitted.append(small.submit(
+                rng.normal(size=(2, D)).astype(np.float32)))
+        except ServeOverloaded as e:
+            rejected += 1
+            overload_transient &= (classify_failure(e) == TRANSIENT)
+    check(rejected > 0 and admitted,
+          f"backpressure rejected the flood past capacity "
+          f"({rejected} rejected, {len(admitted)} admitted)")
+    check(overload_transient,
+          "ServeOverloaded classifies TRANSIENT (client backoff)")
+    drained = sum(1 for f in admitted
+                  if f.result(timeout=30).rows == 2)
+    small.stop()
+    check(drained == len(admitted),
+          f"every admitted request completed after the overload "
+          f"({drained}/{len(admitted)})")
+
+    # -- phase 4: tail latency through the real perf gate ----------------
+    key = {"tool": "serve_drill", "name": "logistic_soak",
+           "algorithm": "serve"}
+    baseline = [dict(schema.run_record(
+        run_id="serve-budget", p50_ms=args.p50_budget_ms,
+        p99_ms=args.p99_budget_ms, **key))]
+    candidate_rec = telemetry.run_summary(
+        tool="serve_drill", name="logistic_soak", algorithm="serve",
+        platform="cpu", requests=summary["requests"],
+        rejected=summary["rejected"],
+        hot_swaps=summary["hot_swaps"], qps=summary["qps"],
+        p50_ms=summary.get("p50_ms"), p99_ms=summary.get("p99_ms"))
+    gate = compare_records(baseline, [candidate_rec],
+                           thresholds={"p50_ms": 0.0, "p99_ms": 0.0})
+    check(not gate.regressions,
+          f"perfgate: p50 {summary.get('p50_ms')}ms <= "
+          f"{args.p50_budget_ms}ms and p99 {summary.get('p99_ms')}ms "
+          f"<= {args.p99_budget_ms}ms"
+          + ("" if not gate.regressions else
+             " — REGRESSIONS: " + "; ".join(
+                 f"{d.metric} {d.candidate} vs budget {d.baseline}"
+                 for d in gate.regressions)))
+    telemetry.close()
+
+    # -- every emitted record must be schema-valid -----------------------
+    records = schema.read_jsonl(jsonl)
+    bad = [(i, errs) for i, rec in enumerate(records, 1)
+           for errs in [schema.validate_record(rec)] if errs]
+    check(records and not bad,
+          f"all {len(records)} emitted records schema-valid"
+          + (f" — first bad: {bad[0]}" if bad else ""))
+    n_req = sum(1 for r in records if r.get("kind") == "serve_request")
+    n_lat = sum(1 for r in records if r.get("kind") == "serve_latency")
+    n_swap = sum(1 for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "hot_swap")
+    check(n_req >= total and n_lat >= 1 and n_swap >= 1,
+          f"JSONL carries the serving story ({n_req} serve_request, "
+          f"{n_lat} serve_latency, {n_swap} hot_swap records)")
+
+    if args.verbose:
+        print(f"artifacts: {jsonl}")
+        print(f"summary: {summary}")
+    if failures:
+        print(f"SERVE DRILL FAILED: {len(failures)} check(s): "
+              + "; ".join(failures[:4]))
+        return 1
+    print(f"SERVE DRILL PASSED: {total} requests from "
+          f"{args.clients} clients, qps={summary['qps']}, "
+          f"p50={summary.get('p50_ms')}ms p99={summary.get('p99_ms')}ms, "
+          f"{rejected} typed rejections, hot swap g1->g2 with zero "
+          "drops, zero recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
