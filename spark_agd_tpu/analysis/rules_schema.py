@@ -59,6 +59,12 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     "contract_pin": ((), frozenset({"contract", "ok"})),
     "serve_request": ((), frozenset({"rows"})),
     "serve_latency": ((), frozenset({"requests"})),
+    # the causal-tracing helpers (obs.trace / obs.timeline): a span
+    # context manager, a pre-measured closed span, and the per-trace
+    # analysis rollup
+    "trace_span": (("name",), frozenset()),
+    "trace_point": (("name",), frozenset({"seconds"})),
+    "trace_summary": ((), frozenset({"trace_id", "spans"})),
 }
 
 

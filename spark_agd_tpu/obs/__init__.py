@@ -44,7 +44,16 @@ from .sinks import (  # noqa: F401
     TensorBoardSink,
 )
 from .telemetry import Telemetry  # noqa: F401
-from . import introspect, perfgate, schema  # noqa: F401
+from . import (  # noqa: F401
+    flight,
+    introspect,
+    perfgate,
+    schema,
+    timeline,
+    trace,
+)
+from .flight import FlightRecorder, dump_on_failure, load_dump  # noqa: F401
+from .trace import SpanContext, TracedSpan  # noqa: F401
 from .introspect import (  # noqa: F401
     ProgramCost,
     analyze,

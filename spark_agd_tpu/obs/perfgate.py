@@ -55,6 +55,11 @@ RUN_METRICS: Dict[str, Tuple[str, float]] = {
     "p50_ms": ("lower", 0.25),
     "p99_ms": ("lower", 0.25),
     "qps": ("higher", 0.15),
+    # per-host skew (obs.timeline.straggler_score, stamped onto run
+    # records by the drills): lower is better, ~1.0 balanced — a
+    # regression that only slows ONE host moves this metric even when
+    # aggregate wall clock hides behind the fast hosts
+    "straggler_score": ("lower", 0.25),
 }
 
 PROGRAM_METRICS: Dict[str, Tuple[str, float]] = {

@@ -38,16 +38,17 @@ SCHEMA_VERSION = 1
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "numerics_failure", "attempt", "recovery", "heartbeat",
          "chaos", "journal_replay", "degraded", "contract_pin",
-         "serve_request", "serve_latency")
+         "serve_request", "serve_latency", "trace_summary")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
 # canonical set for consumers.  ``hot_swap`` is the serving registry's
-# generation swap (serve.registry).
+# generation swap (serve.registry); ``flight_dump`` records a flight-
+# recorder dump written by a failure path (obs.flight).
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
                     "host_lost", "elastic_resume", "degraded_continue",
-                    "hot_swap")
+                    "hot_swap", "flight_dump")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -96,6 +97,10 @@ _REQUIRED: Dict[str, dict] = {
     # ``requests`` completed in the window; QPS and percentile fields
     # ride as optionals
     "serve_latency": {"run_id": str, "requests": int},
+    # one trace's analysis rollup (obs.timeline.analyze): ``spans``
+    # reconstructed span count; hosts/critical path/straggler score
+    # ride as optionals
+    "trace_summary": {"run_id": str, "trace_id": str, "spans": int},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -118,11 +123,23 @@ _OPTIONAL: Dict[str, dict] = {
         # perf gate's latency metrics pair on
         "requests": int, "rejected": int, "hot_swaps": int,
         "qps": _OPT_NUM, "p50_ms": _OPT_NUM, "p99_ms": _OPT_NUM,
+        # per-host skew (obs.timeline.straggler_score over the run's
+        # trace): the perf gate's lower-is-better skew metric
+        "straggler_score": _OPT_NUM, "hosts": int,
     },
     "iteration": {"L": _NUM, "theta": _NUM, "step": _NUM,
                   "restarted": bool, "accepted": bool,
                   "timestamp_unix": _NUM},
-    "span": {"timestamp_unix": _NUM},
+    # the trace fields (obs.trace) are OPTIONAL: untraced phase spans
+    # carry none of them; a traced span carries all of trace_id/
+    # span_id/process/status/t_start_unix (parent_id None at a root).
+    # ``status`` is "open" for the flushed start marker, then "ok"/
+    # "error" (or a producer status) on the closing record — an "open"
+    # with no close is a TRUNCATED span (the emitting host died).
+    "span": {"timestamp_unix": _NUM, "trace_id": str, "span_id": str,
+             "parent_id": (str, type(None)), "process": int,
+             "status": str, "t_start_unix": _NUM,
+             "error": (str, type(None)), "tool": str},
     "metrics": {"timestamp_unix": _NUM, "tool": str},
     "program_cost": {
         "flops": _OPT_NUM, "transcendentals": _OPT_NUM,
@@ -190,6 +207,13 @@ _OPTIONAL: Dict[str, dict] = {
         "queue_depth": int, "rejected": int, "errors": int,
         "hot_swaps": int, "generation": int, "window_s": _NUM,
         "model": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "trace_summary": {
+        "hosts": int, "roots": int, "truncated": int,
+        "connected": bool, "critical_path_s": _OPT_NUM,
+        "critical_path": list, "straggler_score": _OPT_NUM,
+        "slowest_host": (int, type(None)), "step_span": str,
+        "algorithm": str, "tool": str, "timestamp_unix": _NUM,
     },
 }
 
@@ -394,6 +418,17 @@ def serve_latency_record(run_id: str, requests: int, **fields) -> dict:
             "run_id": run_id, "requests": int(requests), **fields}
 
 
+def trace_summary_record(run_id: str, trace_id: str, spans: int,
+                         **fields) -> dict:
+    """One trace's analysis rollup (``obs.timeline.analyze``):
+    ``spans`` reconstructed, with host/truncation counts, the critical
+    path, and the straggler score as optional fields — the record the
+    drills pin their causal-tree acceptance on."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "trace_summary",
+            "run_id": run_id, "trace_id": str(trace_id),
+            "spans": int(spans), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -430,6 +465,9 @@ EXAMPLE_ITERATION_RECORD = {
 EXAMPLE_SPAN_RECORD = {
     "schema_version": SCHEMA_VERSION, "kind": "span",
     "run_id": "r18c2d3e4-1a2b-0", "name": "compile", "seconds": 1.25,
+    "trace_id": "t9f2ab34c11d0e8a7", "span_id": "s1a2b3c4d5e6f",
+    "parent_id": "s0f0e0d0c0b0a", "process": 1, "status": "ok",
+    "t_start_unix": 1754000000.0,
 }
 
 EXAMPLE_METRICS_RECORD = {
@@ -518,6 +556,17 @@ EXAMPLE_SERVE_REQUEST_RECORD = {
     "queue_ms": 1.8, "latency_ms": 4.2, "tool": "serve.queue",
 }
 
+EXAMPLE_TRACE_SUMMARY_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "trace_summary",
+    "run_id": "r18c2d3e4-1a2b-0", "trace_id": "t9f2ab34c11d0e8a7",
+    "spans": 42, "hosts": 2, "roots": 1, "truncated": 1,
+    "connected": True, "critical_path_s": 1.84,
+    "critical_path": [{"name": "supervised_run", "process": 0,
+                       "seconds": 1.84, "truncated": False}],
+    "straggler_score": 1.62, "slowest_host": 0,
+    "step_span": "segment", "tool": "agd_trace",
+}
+
 EXAMPLE_SERVE_LATENCY_RECORD = {
     "schema_version": SCHEMA_VERSION, "kind": "serve_latency",
     "run_id": "r18c2d3e4-1a2b-0", "requests": 240, "rows": 1913,
@@ -547,6 +596,7 @@ EXAMPLES: Dict[str, dict] = {
     "contract_pin": EXAMPLE_CONTRACT_PIN_RECORD,
     "serve_request": EXAMPLE_SERVE_REQUEST_RECORD,
     "serve_latency": EXAMPLE_SERVE_LATENCY_RECORD,
+    "trace_summary": EXAMPLE_TRACE_SUMMARY_RECORD,
 }
 
 

@@ -31,8 +31,9 @@ import re
 import time as _time
 from typing import Iterable, List, Optional
 
-from . import schema
+from . import schema, trace as trace_lib
 from .events import EventBus
+from .flight import FlightRecorder
 from .registry import MetricsRegistry
 from .sinks import InMemorySink, Sink
 
@@ -71,13 +72,24 @@ class Telemetry:
     span phase wrapped in a matching ``TraceAnnotation`` so the span
     timers and the device timeline line up.  One-shot by design:
     traces are large and ``start_trace`` cannot nest.
+
+    ``flight``: the always-on crash flight recorder (``obs.flight``) —
+    a bounded in-memory ring of the last N records, dumped by failure
+    paths so every ``SupervisorGivingUp`` / ``QuorumLost`` /
+    ``ServeOverloaded`` ships with its last-seconds timeline.  True
+    (default) attaches a fresh :class:`~spark_agd_tpu.obs.flight.
+    FlightRecorder`; pass a configured recorder, or ``False`` to opt
+    out.  ``flight_dir`` is where automatic failure dumps land —
+    without it the ring exists but ``dump_on_failure`` writes nothing
+    (no surprise files).
     """
 
     def __init__(self, sinks: Optional[Iterable[Sink]] = None, *,
                  registry: Optional[MetricsRegistry] = None,
                  every: int = 1, host_mode: str = "all",
                  run_id: Optional[str] = None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 flight=True, flight_dir: Optional[str] = None):
         self.run_id = run_id or schema.new_run_id()
         self.profile_dir = profile_dir
         self.registry = registry or MetricsRegistry()
@@ -91,6 +103,18 @@ class Telemetry:
                 if isinstance(s, InMemorySink):
                     self._mem = s
                     break
+        self.flight: Optional[FlightRecorder] = None
+        for s in sinks:
+            if isinstance(s, FlightRecorder):
+                self.flight = s
+                break
+        if self.flight is None and flight:
+            self.flight = (flight if isinstance(flight, FlightRecorder)
+                           else FlightRecorder(directory=flight_dir))
+            sinks = list(sinks) + [self.flight]
+        if self.flight is not None and flight_dir is not None \
+                and self.flight.directory is None:
+            self.flight.directory = flight_dir
         self.bus = EventBus(sinks, host_mode=host_mode)
         self.every = max(1, int(every))
         self.registry.set_span_hook(self._on_span)
@@ -103,6 +127,59 @@ class Telemetry:
         """Context manager timing a phase; the duration lands in the
         registry AND streams one ``span`` record as it closes."""
         return self.registry.span(name)
+
+    # -- causal tracing (obs.trace / obs.timeline) -------------------------
+    def trace_span(self, name: str, *, parent=None, **fields):
+        """Context manager opening one CAUSAL span (``obs.trace``):
+        parented to the current thread's context (or the explicit
+        ``parent`` :class:`~spark_agd_tpu.obs.trace.SpanContext`),
+        installed as current for the body, emitted as an ``open``
+        record immediately (flushed — a killed host leaves a truncated
+        span on disk) and a closing ``span`` record with the measured
+        duration, trace ids, rank, and ``fields``.  ``__enter__``
+        returns the span's context; the handle's ``note(**fields)``
+        adds outcome fields to the closing record."""
+        return trace_lib.TracedSpan(self, name, parent, fields)
+
+    def trace_point(self, name: str, *, seconds: float, ctx=None,
+                    parent=None, status: str = "ok",
+                    t_start_unix: Optional[float] = None,
+                    **fields) -> dict:
+        """Emit (and return) one already-measured CLOSED span record —
+        the non-context-manager member for latencies measured
+        elsewhere (the serve queue's per-request spans).  ``ctx`` is
+        the span's own context when pre-allocated; otherwise a fresh
+        child of ``parent`` (or of the current context) is minted."""
+        if ctx is None:
+            ctx = trace_lib.child_of(
+                parent if parent is not None
+                else trace_lib.current_context())
+        rec = schema.span_record(self.run_id, name, float(seconds))
+        rec.update(trace_id=ctx.trace_id, span_id=ctx.span_id,
+                   parent_id=ctx.parent_id, process=int(ctx.process),
+                   status=str(status))
+        if t_start_unix is not None:
+            rec["t_start_unix"] = round(float(t_start_unix), 6)
+        rec.update(fields)
+        self.registry.counter("trace.spans").inc()
+        self.bus.emit(rec)
+        return rec
+
+    def trace_summary(self, *, trace_id: str, spans: int,
+                      **fields) -> dict:
+        """Emit (and return) a ``trace_summary`` record — one trace's
+        analysis rollup (``obs.timeline.analyze(...).summary_fields()``)
+        — mirroring the straggler score into the
+        ``trace.straggler_score`` gauge so skew rides the run
+        summary's metrics snapshot."""
+        score = fields.get("straggler_score")
+        if isinstance(score, (int, float)) and not isinstance(score,
+                                                              bool):
+            self.registry.gauge("trace.straggler_score").set(score)
+        rec = schema.trace_summary_record(self.run_id, trace_id,
+                                          spans, **fields)
+        self.bus.emit(rec)
+        return rec
 
     # -- the live in-loop stream ------------------------------------------
     def iteration_callback(self, algorithm: str = "agd"):

@@ -65,6 +65,7 @@ from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import flight as flight_lib
 from ..utils import checkpoint as ckpt
 from . import manifest as manifest_lib
 from .distributed import (
@@ -216,7 +217,9 @@ def load_degraded(
         decision = policy.decide(m.process_count, n_surv)
         if not decision.allowed:
             # quorum is a property of the topology, not of this
-            # generation: no older generation can fix it
+            # generation: no older generation can fix it.  The refusal
+            # ships with its last-seconds timeline (obs.flight).
+            flight_lib.dump_on_failure(telemetry, "quorum_lost")
             raise QuorumLost(decision.reason)
         problems = _verify_surviving(m, directory, survivors)
         if problems:

@@ -51,6 +51,7 @@ processes mid-run, elastic resume on one) and
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -361,6 +362,20 @@ class DistributedCheckpointer(AutoCheckpointer):
         shard_path = os.path.join(self.directory, shard)
         ckpt.atomic_savez(shard_path, payload)
 
+        # the commit barrier rides as one causal ``ckpt_commit`` span
+        # (obs.trace) — under the supervisor's run span, so a slow or
+        # wedged barrier is visible per host in the timeline
+        commit_span = (self.telemetry.trace_span(
+            "ckpt_commit", generation=int(gen),
+            to_iter=int(warm.prior_iters))
+            if self.telemetry is not None else None)
+        with commit_span if commit_span is not None \
+                else contextlib.nullcontext():
+            self._commit(warm, gen, shard_path, converged, aborted,
+                         action)
+
+    def _commit(self, warm, gen, shard_path, converged, aborted,
+                action):
         row = np.asarray(
             [gen, manifest_lib.crc32_file(shard_path),
              os.path.getsize(shard_path), _warm_crc(warm)], np.int64)

@@ -52,6 +52,7 @@ from ..obs.sinks import Sink, _jsonable
 
 MAGIC = b"AGDWAL01"
 _FRAME = struct.Struct("<II")  # (payload length, payload CRC32)
+FRAME_SIZE = _FRAME.size  # bytes of frame header before each payload
 
 # a frame claiming more than this is torn/garbage, not a real record
 MAX_RECORD_BYTES = 1 << 26
@@ -87,25 +88,35 @@ def _encode(record: dict) -> bytes:
     return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
 
 
-def replay(path: str) -> JournalReplay:
+def encode_record(record: dict) -> bytes:
+    """One record's frame (header + canonical-JSON payload) — the
+    framing shared with the flight recorder (``obs.flight``), which
+    writes the same frames under its own magic."""
+    return _encode(record)
+
+
+def replay(path: str, *, magic: bytes = MAGIC) -> JournalReplay:
     """Recover every committed record from ``path`` — see the module
     docstring for the stop conditions.  A missing file replays empty
     and clean; a file whose header is damaged replays empty with the
-    reason (nothing after an unidentifiable header can be trusted)."""
+    reason (nothing after an unidentifiable header can be trusted).
+    ``magic`` selects the file family: the journal's own header by
+    default, ``obs.flight.MAGIC`` when replaying a flight-recorder
+    dump (same frames, different producer)."""
     if not os.path.exists(path):
         return JournalReplay([], [], 0, 0, None)
     with open(path, "rb") as f:
         blob = f.read()
-    if len(blob) < len(MAGIC):
+    if len(blob) < len(magic):
         return JournalReplay([], [], 0, len(blob),
                              "torn header" if blob else None)
-    if blob[:len(MAGIC)] != MAGIC:
+    if blob[:len(magic)] != magic:
         return JournalReplay([], [], 0, len(blob),
                              "bad magic (not a journal, or its header "
                              "was overwritten)")
     records: List[dict] = []
     payloads: List[bytes] = []
-    off = len(MAGIC)
+    off = len(magic)
     reason = None
     while off < len(blob):
         if off + _FRAME.size > len(blob):

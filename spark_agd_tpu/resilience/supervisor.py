@@ -36,6 +36,7 @@ the run's metrics.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable, List, NamedTuple, Optional
@@ -45,6 +46,7 @@ import numpy as np
 
 from ..core import agd
 from ..core.agd import AGDConfig, AGDWarmState
+from ..obs import flight as flight_lib
 from ..utils import checkpoint as ckpt
 from . import errors, faults as faults_lib, retry as retry_lib
 
@@ -229,199 +231,293 @@ def run_agd_supervised(
         jax.block_until_ready(res.num_iters)
         return res
 
-    # -- resume ----------------------------------------------------------
-    hist: list = []
-    warm = None
-    if checkpointer is not None:
-        loaded = checkpointer.load(w0)
-        if loaded is not None:
-            if loaded.converged or loaded.aborted:
-                # terminal checkpoint: rerunning must not add iterations
-                return SupervisedResult(
-                    weights=loaded.warm.x,
-                    loss_history=np.asarray(loaded.loss_history),
-                    num_iters=int(loaded.warm.prior_iters),
-                    converged=loaded.converged,
-                    aborted_non_finite=loaded.aborted,
-                    retries=0, rollbacks=0,
-                    resumed_from=int(loaded.warm.prior_iters),
-                    attempts=[])
-            warm = loaded.warm
-            hist = list(np.asarray(loaded.loss_history))
-    if warm is None:
-        warm = AGDWarmState.initial(w0, config)
-    resumed_from = int(warm.prior_iters)
-    if checkpointer is not None:
-        checkpointer.install_signal_handlers()
-        checkpointer.update(warm, hist)  # generation zero / post-resume
-
-    schedule = policy.backoff_schedule()
-    ledger: List[dict] = []
-    attempt_no = 0
-    seg_failures = 0   # consecutive transient failures of THIS segment
-    retries = rollbacks = 0
-    converged = aborted = False
-    total = int(config.num_iterations)
-    t_run0 = clock()
-
-    def record_attempt(outcome: str, start_iter: int, iters: int,
-                       seconds: float, error: Optional[str] = None,
-                       failure_kind: Optional[str] = None):
-        entry = {"attempt": attempt_no, "outcome": outcome,
-                 "start_iter": start_iter, "iters": iters,
-                 "seconds": round(seconds, 6), "error": error,
-                 "failure_kind": failure_kind, "algorithm": "agd"}
-        ledger.append(entry)
-        if telemetry is not None:
-            telemetry.attempt(**entry)
-
-    def recovery(action: str, **fields):
-        if telemetry is not None:
-            telemetry.recovery(action=action, **fields)
-
-    def numeric_rollback(start: int, reason: str):
-        nonlocal warm, rollbacks
-        if rollbacks >= policy.max_rollbacks:
-            raise errors.SupervisorGivingUp(
-                f"non-finite numerics persisted through "
-                f"{policy.max_rollbacks} rollbacks (last: {reason})",
-                ledger)
-        rollbacks += 1
-        warm = _rollback(warm, policy.rollback_l_factor)
-        recovery("rollback", reason=reason, failure_kind=errors.NUMERIC,
-                 from_iter=start, to_iter=int(warm.prior_iters),
-                 big_l=float(warm.big_l), source="supervisor")
-
+    # the causal trace (obs.trace): one ``supervised_run`` span per
+    # call — parented to whatever context is active (a drill's cross-
+    # process root rides in through trace.activate/from_env) — opened
+    # BEFORE resume so the generation-zero/post-resume checkpoint
+    # commit is part of the tree, with one child ``segment`` span PER
+    # ATTEMPT.  A retried or rolled-back segment opens a fresh span
+    # re-parented to the run root (never to the failed attempt), so
+    # the tree reads as siblings with the same start_iter.  All
+    # host-side: the compiled program is untouched (pinned
+    # HLO-identical by tests/test_trace.py).
+    run_span = (telemetry.trace_span("supervised_run", algorithm="agd")
+                if telemetry is not None else None)
     try:
-        while int(warm.prior_iters) < total:
-            start = int(warm.prior_iters)
-            k = min(policy.segment_iters or total, total - start)
-            if policy.max_wall_seconds is not None:
-                elapsed = clock() - t_run0
-                if elapsed > policy.max_wall_seconds:
-                    attempt_no += 1
-                    record_attempt(
-                        "deadline", start, 0, elapsed,
-                        error=(f"wall-clock budget "
-                               f"{policy.max_wall_seconds:g}s exceeded"),
-                        failure_kind="deadline")
-                    raise errors.SupervisorGivingUp(
-                        f"DEADLINE: wall-clock budget "
-                        f"{policy.max_wall_seconds:g}s exhausted after "
-                        f"{elapsed:.3f}s at iteration {start} "
-                        f"({retries} retries, {rollbacks} rollbacks so "
-                        "far); not retrying further", ledger)
-            if heartbeat is not None:
-                heartbeat.beat(iter=start, phase="segment")
-            if faults is not None or monitor is not None:
-                try:
-                    if faults is not None:
-                        faults.before_segment(start)
-                    if monitor is not None:
-                        monitor.check()
-                except Exception as e:  # noqa: BLE001 — classified below
-                    attempt_no += 1
-                    kind = errors.classify_failure(e)
-                    record_attempt("failed", start, 0, 0.0,
-                                   error=f"{type(e).__name__}: {e}",
-                                   failure_kind=kind)
-                    if kind == errors.FATAL:
-                        # a fatal boundary fault (chaos-injected config
-                        # error, QuorumLost) must give up TYPED, exactly
-                        # like a fatal segment failure — never a bare
-                        # traceback with the ledger lost
-                        raise errors.SupervisorGivingUp(
-                            f"fatal failure at iteration {start}: "
-                            f"{type(e).__name__}: {e}", ledger) from e
-                    if kind != errors.TRANSIENT:
-                        raise
-                    seg_failures += 1
-                    retries += 1
-                    if seg_failures >= policy.max_attempts:
-                        raise errors.SupervisorGivingUp(
-                            f"segment at iteration {start} failed "
-                            f"{seg_failures} times (last: {e})",
-                            ledger) from e
-                    delay = schedule.next_delay(seg_failures)
-                    recovery("retry", reason=str(e), failure_kind=kind,
-                             attempt=seg_failures, backoff_s=delay,
-                             from_iter=start, source="supervisor")
-                    if delay:
-                        sleep(delay)
-                    continue
-            poisoned = (faults is not None and faults.take_poison(start))
-
-            attempt_no += 1
-            t0 = time.perf_counter()
-            try:
-                res = retry_lib.run_with_watchdog(
-                    run_segment, (warm, k, poisoned), {},
-                    policy.attempt_timeout, f"agd@{start}")
-            except errors.Preempted:
-                raise
-            except Exception as e:  # noqa: BLE001 — classified below
-                dt = time.perf_counter() - t0
-                kind = errors.classify_failure(e)
-                record_attempt("failed", start, 0, dt,
-                               error=f"{type(e).__name__}: {e}",
-                               failure_kind=kind)
-                if kind == errors.NUMERIC:
-                    numeric_rollback(start, f"{type(e).__name__}: {e}")
-                    seg_failures = 0
-                    continue
-                if kind == errors.TRANSIENT:
-                    seg_failures += 1
-                    retries += 1
-                    if seg_failures >= policy.max_attempts:
-                        raise errors.SupervisorGivingUp(
-                            f"segment at iteration {start} failed "
-                            f"{seg_failures} times (last: {e})",
-                            ledger) from e
-                    delay = schedule.next_delay(seg_failures)
-                    recovery("retry", reason=str(e), failure_kind=kind,
-                             attempt=seg_failures, backoff_s=delay,
-                             from_iter=start, source="supervisor")
-                    if delay:
-                        sleep(delay)
-                    continue
-                raise errors.SupervisorGivingUp(
-                    f"fatal failure at iteration {start}: "
-                    f"{type(e).__name__}: {e}", ledger) from e
-            dt = time.perf_counter() - t0
-
-            if bool(res.aborted_non_finite):
-                record_attempt("aborted_non_finite", start,
-                               int(res.num_iters), dt,
-                               failure_kind=errors.NUMERIC)
-                numeric_rollback(start, "non-finite loss in segment")
-                seg_failures = 0
-                continue
-
-            done = int(res.num_iters)
-            record_attempt("ok", start, done, dt)
-            # graftlint: disable=host-sync -- ONE device read per
-            # SEGMENT boundary (the batching the rule recommends), not
-            # a per-iteration sync
-            hist.extend(np.asarray(res.loss_history)[:done].tolist())
-            warm = ckpt.warm_from_result(res, start + done)
-            converged = bool(res.converged)
-            seg_failures = 0
+        with run_span if run_span is not None \
+                else contextlib.nullcontext():
+            # -- resume ----------------------------------------------------------
+            hist: list = []
+            warm = None
             if checkpointer is not None:
-                checkpointer.update(warm, hist, converged=converged)
-            if converged or done == 0:
-                break
-    finally:
-        if checkpointer is not None:
-            # terminal/abandon flush: whatever the exit path, the last
-            # completed state is on disk before handlers come off
-            checkpointer.update(warm, hist, converged=converged,
-                                aborted=aborted, force=True)
-            checkpointer.uninstall_signal_handlers()
-        if heartbeat is not None:
+                loaded = checkpointer.load(w0)
+                if loaded is not None:
+                    if loaded.converged or loaded.aborted:
+                        # terminal checkpoint: rerunning must not add iterations
+                        return SupervisedResult(
+                            weights=loaded.warm.x,
+                            loss_history=np.asarray(loaded.loss_history),
+                            num_iters=int(loaded.warm.prior_iters),
+                            converged=loaded.converged,
+                            aborted_non_finite=loaded.aborted,
+                            retries=0, rollbacks=0,
+                            resumed_from=int(loaded.warm.prior_iters),
+                            attempts=[])
+                    warm = loaded.warm
+                    hist = list(np.asarray(loaded.loss_history))
+            if warm is None:
+                warm = AGDWarmState.initial(w0, config)
+            resumed_from = int(warm.prior_iters)
+            if run_span is not None:
+                run_span.note(resumed_from=resumed_from)
+            if checkpointer is not None:
+                checkpointer.install_signal_handlers()
+                checkpointer.update(warm, hist)  # generation zero / post-resume
+
+            schedule = policy.backoff_schedule()
+            ledger: List[dict] = []
+            attempt_no = 0
+            seg_failures = 0   # consecutive transient failures of THIS segment
+            retries = rollbacks = 0
+            converged = aborted = False
+            total = int(config.num_iterations)
+            t_run0 = clock()
+
+            def record_attempt(outcome: str, start_iter: int, iters: int,
+                               seconds: float, error: Optional[str] = None,
+                               failure_kind: Optional[str] = None):
+                entry = {"attempt": attempt_no, "outcome": outcome,
+                         "start_iter": start_iter, "iters": iters,
+                         "seconds": round(seconds, 6), "error": error,
+                         "failure_kind": failure_kind, "algorithm": "agd"}
+                ledger.append(entry)
+                if telemetry is not None:
+                    telemetry.attempt(**entry)
+
+            def recovery(action: str, **fields):
+                if telemetry is not None:
+                    telemetry.recovery(action=action, **fields)
+
+            def numeric_rollback(start: int, reason: str):
+                nonlocal warm, rollbacks
+                if rollbacks >= policy.max_rollbacks:
+                    raise errors.SupervisorGivingUp(
+                        f"non-finite numerics persisted through "
+                        f"{policy.max_rollbacks} rollbacks (last: {reason})",
+                        ledger)
+                rollbacks += 1
+                warm = _rollback(warm, policy.rollback_l_factor)
+                recovery("rollback", reason=reason, failure_kind=errors.NUMERIC,
+                         from_iter=start, to_iter=int(warm.prior_iters),
+                         big_l=float(warm.big_l), source="supervisor")
+
             try:
-                heartbeat.beat(iter=int(warm.prior_iters), phase="exit")
-            except OSError:  # a dying filesystem must not mask the
-                pass         # real exit path
+                while int(warm.prior_iters) < total:
+                    start = int(warm.prior_iters)
+                    k = min(policy.segment_iters or total, total - start)
+                    if policy.max_wall_seconds is not None:
+                        elapsed = clock() - t_run0
+                        if elapsed > policy.max_wall_seconds:
+                            attempt_no += 1
+                            record_attempt(
+                                "deadline", start, 0, elapsed,
+                                error=(f"wall-clock budget "
+                                       f"{policy.max_wall_seconds:g}s "
+                                       "exceeded"),
+                                failure_kind="deadline")
+                            raise errors.SupervisorGivingUp(
+                                f"DEADLINE: wall-clock budget "
+                                f"{policy.max_wall_seconds:g}s exhausted "
+                                f"after {elapsed:.3f}s at iteration "
+                                f"{start} ({retries} retries, "
+                                f"{rollbacks} rollbacks so far); not "
+                                "retrying further", ledger)
+                    seg_span = (telemetry.trace_span(
+                        "segment", start_iter=start, iters=k)
+                        if telemetry is not None else None)
+                    with seg_span if seg_span is not None \
+                            else contextlib.nullcontext():
+                        # the boundary hooks are HOST-LOCAL work (no
+                        # collective), so they get their own child
+                        # span: in lockstep SPMD a straggler's delay
+                        # is absorbed into every PEER's next
+                        # collective — coupled segment spans tie — and
+                        # this span is where per-host skew stays
+                        # attributable (obs.timeline, the drills'
+                        # straggler checks).  Only opened when hooks
+                        # exist, so plain runs pay no extra records.
+                        boundary_span = (telemetry.trace_span(
+                            "boundary", start_iter=start)
+                            if telemetry is not None
+                            and (heartbeat is not None
+                                 or faults is not None
+                                 or monitor is not None) else None)
+                        hook_exc: Optional[BaseException] = None
+                        with boundary_span if boundary_span is not None \
+                                else contextlib.nullcontext():
+                            if heartbeat is not None:
+                                heartbeat.beat(iter=start,
+                                               phase="segment")
+                            if faults is not None or monitor is not None:
+                                try:
+                                    if faults is not None:
+                                        faults.before_segment(start)
+                                    if monitor is not None:
+                                        monitor.check()
+                                except Exception as e:  # noqa: BLE001 — classified below
+                                    hook_exc = e
+                                    if boundary_span is not None:
+                                        boundary_span.note(
+                                            status="error",
+                                            error=(f"{type(e).__name__}"
+                                                   f": {e}"))
+                        if hook_exc is not None:
+                            e = hook_exc
+                            attempt_no += 1
+                            kind = errors.classify_failure(e)
+                            record_attempt(
+                                "failed", start, 0, 0.0,
+                                error=f"{type(e).__name__}: {e}",
+                                failure_kind=kind)
+                            if seg_span is not None:
+                                seg_span.note(
+                                    status="error",
+                                    outcome="failed",
+                                    attempt=attempt_no,
+                                    error=f"{type(e).__name__}: {e}")
+                            if kind == errors.FATAL:
+                                # a fatal boundary fault (chaos-
+                                # injected config error, QuorumLost)
+                                # must give up TYPED, exactly like a
+                                # fatal segment failure — never a
+                                # bare traceback with the ledger lost
+                                raise errors.SupervisorGivingUp(
+                                    f"fatal failure at iteration "
+                                    f"{start}: {type(e).__name__}: "
+                                    f"{e}", ledger) from e
+                            if kind != errors.TRANSIENT:
+                                raise e
+                            seg_failures += 1
+                            retries += 1
+                            if seg_failures >= policy.max_attempts:
+                                raise errors.SupervisorGivingUp(
+                                    f"segment at iteration {start} "
+                                    f"failed {seg_failures} times "
+                                    f"(last: {e})", ledger) from e
+                            delay = schedule.next_delay(seg_failures)
+                            recovery("retry", reason=str(e),
+                                     failure_kind=kind,
+                                     attempt=seg_failures,
+                                     backoff_s=delay,
+                                     from_iter=start,
+                                     source="supervisor")
+                            if delay:
+                                sleep(delay)
+                            continue
+                        poisoned = (faults is not None
+                                    and faults.take_poison(start))
+
+                        attempt_no += 1
+                        t0 = time.perf_counter()
+                        try:
+                            res = retry_lib.run_with_watchdog(
+                                run_segment, (warm, k, poisoned), {},
+                                policy.attempt_timeout, f"agd@{start}")
+                        except errors.Preempted:
+                            raise
+                        except Exception as e:  # noqa: BLE001 — classified below
+                            dt = time.perf_counter() - t0
+                            kind = errors.classify_failure(e)
+                            record_attempt(
+                                "failed", start, 0, dt,
+                                error=f"{type(e).__name__}: {e}",
+                                failure_kind=kind)
+                            if seg_span is not None:
+                                seg_span.note(
+                                    status="error", outcome="failed",
+                                    attempt=attempt_no,
+                                    failure_kind=kind,
+                                    error=f"{type(e).__name__}: {e}")
+                            if kind == errors.NUMERIC:
+                                numeric_rollback(
+                                    start, f"{type(e).__name__}: {e}")
+                                seg_failures = 0
+                                continue
+                            if kind == errors.TRANSIENT:
+                                seg_failures += 1
+                                retries += 1
+                                if seg_failures >= policy.max_attempts:
+                                    raise errors.SupervisorGivingUp(
+                                        f"segment at iteration {start} "
+                                        f"failed {seg_failures} times "
+                                        f"(last: {e})", ledger) from e
+                                delay = schedule.next_delay(seg_failures)
+                                recovery("retry", reason=str(e),
+                                         failure_kind=kind,
+                                         attempt=seg_failures,
+                                         backoff_s=delay,
+                                         from_iter=start,
+                                         source="supervisor")
+                                if delay:
+                                    sleep(delay)
+                                continue
+                            raise errors.SupervisorGivingUp(
+                                f"fatal failure at iteration {start}: "
+                                f"{type(e).__name__}: {e}", ledger) from e
+                        dt = time.perf_counter() - t0
+
+                        if bool(res.aborted_non_finite):
+                            record_attempt("aborted_non_finite", start,
+                                           int(res.num_iters), dt,
+                                           failure_kind=errors.NUMERIC)
+                            if seg_span is not None:
+                                seg_span.note(
+                                    status="error",
+                                    outcome="aborted_non_finite",
+                                    attempt=attempt_no)
+                            numeric_rollback(
+                                start, "non-finite loss in segment")
+                            seg_failures = 0
+                            continue
+
+                        done = int(res.num_iters)
+                        record_attempt("ok", start, done, dt)
+                        if seg_span is not None:
+                            seg_span.note(outcome="ok",
+                                          attempt=attempt_no,
+                                          iters=done)
+                        # graftlint: disable=host-sync -- ONE device
+                        # read per SEGMENT boundary (the batching the
+                        # rule recommends), not a per-iteration sync
+                        hist.extend(
+                            np.asarray(res.loss_history)[:done].tolist())
+                        warm = ckpt.warm_from_result(res, start + done)
+                        converged = bool(res.converged)
+                        seg_failures = 0
+                        if checkpointer is not None:
+                            checkpointer.update(warm, hist,
+                                                converged=converged)
+                        if converged or done == 0:
+                            break
+            finally:
+                if checkpointer is not None:
+                    # terminal/abandon flush: whatever the exit path,
+                    # the last completed state is on disk before
+                    # handlers come off
+                    checkpointer.update(warm, hist, converged=converged,
+                                        aborted=aborted, force=True)
+                    checkpointer.uninstall_signal_handlers()
+                if heartbeat is not None:
+                    try:
+                        heartbeat.beat(iter=int(warm.prior_iters),
+                                       phase="exit")
+                    except OSError:  # a dying filesystem must not mask
+                        pass         # the real exit path
+    except errors.SupervisorGivingUp:
+        # the give-up ships with its last-seconds timeline: dump the
+        # run's flight ring (no-op without a recorder/destination)
+        flight_lib.dump_on_failure(telemetry, "supervisor_giving_up")
+        raise
 
     return SupervisedResult(
         weights=warm.x, loss_history=np.asarray(hist),

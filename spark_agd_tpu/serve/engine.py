@@ -30,6 +30,7 @@ rows are sliced off host-side before the caller sees them.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -398,11 +399,22 @@ class ServeEngine:
             padded[:n] = X
         with self._lock:
             generation = self._generation
-            out = prog.compiled(self._params, padded, prog.scratch)
-            # the donated scratch's buffer now IS the output; copy the
-            # result to host, then recycle the device buffer as the
-            # next call's scratch
-            host = jax.device_get(out)
+            # one causal ``engine_call`` span per batch (obs.trace):
+            # inherits the queue's serve_batch context through the
+            # context variable (same worker thread), so request →
+            # batch → engine reads as one chain in the timeline.
+            # Host-side only — the compiled program is untouched.
+            span = (self.telemetry.trace_span(
+                "engine_call", op=op, bucket=bucket,
+                generation=generation, tool="serve.engine")
+                if self.telemetry is not None else None)
+            with span if span is not None \
+                    else contextlib.nullcontext():
+                out = prog.compiled(self._params, padded, prog.scratch)
+                # the donated scratch's buffer now IS the output; copy
+                # the result to host, then recycle the device buffer
+                # as the next call's scratch
+                host = jax.device_get(out)
             prog.scratch = out
         return host[:n], generation, bucket
 

@@ -28,6 +28,7 @@ serving section and the drill's perf gate consume.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
@@ -37,6 +38,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
+from ..obs import flight as flight_lib, trace as trace_lib
 from ..resilience.errors import ServeOverloaded
 from .engine import ServeEngine
 
@@ -64,6 +66,13 @@ class _Request:
     future: Future
     t_submit: float
     squeeze: bool
+    # the submitting thread's trace context (obs.trace), captured at
+    # admission: the worker thread does NOT inherit the context
+    # variable, so the causal request→batch→engine chain is carried
+    # across the queue by hand — the explicit cross-thread propagation
+    # rule of docs/OBSERVABILITY.md §distributed-tracing
+    ctx: Optional[trace_lib.SpanContext] = None
+    t_submit_unix: float = 0.0
 
 
 class MicroBatchQueue:
@@ -161,7 +170,9 @@ class MicroBatchQueue:
         if op not in self.engine.ops:
             raise ValueError(f"op {op!r} not served (ops: "
                              f"{self.engine.ops})")
-        req = _Request(rows, op, Future(), time.monotonic(), squeeze)
+        req = _Request(rows, op, Future(), time.monotonic(), squeeze,
+                       ctx=trace_lib.current_context(),
+                       t_submit_unix=time.time())
         with self._cond:
             if self._stopping or not self._started:
                 raise RuntimeError(
@@ -174,6 +185,11 @@ class MicroBatchQueue:
                     self.telemetry.serve_request(
                         rows=n, op=op, status="rejected",
                         tool="serve.queue")
+                # the overload ships with its last-seconds timeline;
+                # rate-limited inside the recorder (one dump per
+                # reason per window, not one per rejected request)
+                flight_lib.dump_on_failure(self.telemetry,
+                                           "serve_overloaded")
                 raise ServeOverloaded(queued + n, self.max_queue_rows)
             self._pending.append(req)
             self._pending_rows += n
@@ -238,6 +254,15 @@ class MicroBatchQueue:
         # paths); loop or exit via _run's next _next_group call
         return None if self._stopping else []
 
+    def _request_contexts(self, group: List[_Request]):
+        """One pre-allocated request-span context per request, each a
+        child of its SUBMITTER's captured context (or a fresh root for
+        an untraced client) — minted before dispatch so the batch span
+        can parent under the first request."""
+        if self.telemetry is None:
+            return None
+        return [trace_lib.child_of(req.ctx) for req in group]
+
     def _dispatch(self, group: List[_Request]) -> None:
         if not group:
             return
@@ -245,11 +270,31 @@ class MicroBatchQueue:
         X = (group[0].rows if len(group) == 1
              else np.concatenate([r.rows for r in group], axis=0))
         batch_rows = X.shape[0]
+        req_ctxs = self._request_contexts(group)
+        # causal chain: request spans hang off their submitters; the
+        # coalesced batch span is a child of the FIRST request (a batch
+        # has one parent; the siblings link back via batch_span_id);
+        # the engine call inside inherits the batch context through
+        # the context variable (same worker thread)
+        batch_span = (self.telemetry.trace_span(
+            "serve_batch", parent=req_ctxs[0], op=op,
+            batch_rows=batch_rows, requests=len(group),
+            tool="serve.queue")
+            if req_ctxs is not None else None)
+        batch_span_id = None
         t_dispatch = time.monotonic()
         try:
-            values, generation, bucket = self.engine.serve_batch(X, op)
+            with batch_span if batch_span is not None \
+                    else contextlib.nullcontext() as bctx:
+                if bctx is not None:
+                    batch_span_id = bctx.span_id
+                values, generation, bucket = self.engine.serve_batch(
+                    X, op)
+                if batch_span is not None:
+                    batch_span.note(generation=generation,
+                                    bucket=bucket)
         except BaseException as e:  # noqa: BLE001 — forwarded to callers
-            self._fail_group(group, op, e)
+            self._fail_group(group, op, e, req_ctxs, batch_span_id)
             return
         t_done = time.monotonic()
         offset = 0
@@ -271,7 +316,7 @@ class MicroBatchQueue:
                 self._requests_done += 1
                 self._rows_done += res.rows
                 self._latencies_ms.append(res.latency_ms)
-        for req, res in results:
+        for i, (req, res) in enumerate(results):
             if self.telemetry is not None:
                 self.telemetry.serve_request(
                     rows=res.rows, op=op, status="ok",
@@ -280,18 +325,35 @@ class MicroBatchQueue:
                     queue_ms=round(res.queue_ms, 3),
                     latency_ms=round(res.latency_ms, 3),
                     tool="serve.queue")
+                self.telemetry.trace_point(
+                    "serve_request", seconds=res.latency_ms / 1e3,
+                    ctx=req_ctxs[i], t_start_unix=req.t_submit_unix,
+                    rows=res.rows, op=op, bucket=res.bucket,
+                    generation=res.generation,
+                    batch_span_id=batch_span_id, tool="serve.queue")
             req.future.set_result(res)
 
     def _fail_group(self, group: List[_Request], op: str,
-                    exc: BaseException) -> None:
+                    exc: BaseException, req_ctxs=None,
+                    batch_span_id=None) -> None:
         with self._cond:
             self._errors += len(group)
-        for req in group:
+        for i, req in enumerate(group):
             if self.telemetry is not None:
                 self.telemetry.serve_request(
                     rows=req.rows.shape[0], op=op, status="error",
                     error=f"{type(exc).__name__}: {exc}",
                     tool="serve.queue")
+                if req_ctxs is not None:
+                    self.telemetry.trace_point(
+                        "serve_request",
+                        seconds=time.monotonic() - req.t_submit,
+                        ctx=req_ctxs[i],
+                        t_start_unix=req.t_submit_unix, status="error",
+                        rows=req.rows.shape[0], op=op,
+                        error=f"{type(exc).__name__}: {exc}",
+                        batch_span_id=batch_span_id,
+                        tool="serve.queue")
             req.future.set_exception(exc)
 
     # -- stats / telemetry -------------------------------------------------

@@ -1,0 +1,960 @@
+"""Distributed tracing, flight recorder, and timeline analysis
+(``obs.trace`` / ``obs.flight`` / ``obs.timeline``) — context
+propagation edges (supervisor retry/rollback re-parenting, 2-process
+gloo cross-host join, serve hot-swap mid-trace, flight torn-tail
+truncation), the HLO-identical pin, and the ``tools/agd_trace.py``
+CLI.  All CPU, tier-1 (``trace`` marker)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_agd_tpu import api
+from spark_agd_tpu.core import agd, smooth as smooth_lib
+from spark_agd_tpu.data import synthetic
+from spark_agd_tpu.obs import (
+    FlightRecorder,
+    JSONLSink,
+    Telemetry,
+    flight,
+    schema,
+    timeline,
+    trace,
+    validate_record,
+)
+from spark_agd_tpu.obs.perfgate import compare_records
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox, SquaredL2Updater
+from spark_agd_tpu.resilience import (
+    FaultScript,
+    ResiliencePolicy,
+    SupervisorGivingUp,
+    faults,
+    run_agd_supervised,
+)
+from spark_agd_tpu.resilience.distributed import DistributedCheckpointer
+from spark_agd_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture(scope="module")
+def problem():
+    X, y = synthetic.generate_gd_input(2.0, -1.5, 200, 42)
+    X = synthetic.with_intercept_column(X).astype(np.float32)
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), jnp.asarray(X), jnp.asarray(y))
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    return build, dargs, px, rv, jnp.zeros(2, jnp.float32)
+
+
+def _supervise(problem, tel, *, iters=12, seg=4, faults_=None,
+               policy_kw=None, **kw):
+    build, dargs, px, rv, w0 = problem
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=iters)
+    policy = ResiliencePolicy(
+        max_attempts=3, backoff_base=0.0, jitter=0.0, seed=0,
+        segment_iters=seg, **(policy_kw or {}))
+    return run_agd_supervised(
+        prox=px, reg_value=rv, w0=w0, config=cfg,
+        staged=(build, dargs), policy=policy, telemetry=tel,
+        faults=faults_, stream_iterations=False, **kw)
+
+
+def _spans(tel, name=None):
+    out = timeline.collect_spans(tel.records)
+    return out if name is None else [s for s in out if s.name == name]
+
+
+# ---------------------------------------------------------------------------
+# SpanContext / propagation primitives
+# ---------------------------------------------------------------------------
+
+
+class TestSpanContext:
+    def test_ids_prefixed_and_unique(self):
+        tids = {trace.new_trace_id() for _ in range(64)}
+        sids = {trace.new_span_id() for _ in range(64)}
+        assert len(tids) == 64 and len(sids) == 64
+        assert all(t.startswith("t") for t in tids)
+        assert all(s.startswith("s") for s in sids)
+
+    def test_child_keeps_trace_sets_parent(self):
+        root = trace.new_root(process=3)
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_id == root.span_id
+        assert kid.span_id != root.span_id
+        assert kid.process == 3  # inherited unless overridden
+        assert root.child(process=1).process == 1
+
+    def test_child_of_none_is_fresh_root(self):
+        ctx = trace.child_of(None)
+        assert ctx.parent_id is None
+
+    def test_wire_round_trip(self):
+        root = trace.new_root(process=2)
+        assert trace.SpanContext.from_wire(root.to_wire()) == root
+
+    def test_env_round_trip(self):
+        root = trace.new_root()
+        env = {trace.TRACE_ENV: root.to_env_value()}
+        assert trace.from_env(env) == root
+        assert trace.from_env({}) is None
+        assert trace.from_env({trace.TRACE_ENV: "not json"}) is None
+
+    def test_activate_nests_and_restores(self):
+        assert trace.current_context() is None
+        a, b = trace.new_root(), trace.new_root()
+        with trace.activate(a):
+            assert trace.current_context() == a
+            with trace.activate(b):
+                assert trace.current_context() == b
+            assert trace.current_context() == a
+        assert trace.current_context() is None
+
+    def test_activate_none_noop(self):
+        with trace.activate(None) as got:
+            assert got is None
+            assert trace.current_context() is None
+
+    def test_threads_do_not_inherit(self):
+        seen = []
+        with trace.activate(trace.new_root()):
+            t = threading.Thread(
+                target=lambda: seen.append(trace.current_context()))
+            t.start()
+            t.join()
+        assert seen == [None]  # propagation is EXPLICIT by design
+
+
+# ---------------------------------------------------------------------------
+# Telemetry.trace_span / trace_point / trace_summary
+# ---------------------------------------------------------------------------
+
+
+class TestTracedSpans:
+    def test_open_close_pair_schema_valid(self):
+        tel = Telemetry()
+        with tel.trace_span("phase", tool="test"):
+            pass
+        spans = [r for r in tel.records if r["kind"] == "span"]
+        assert len(spans) == 2
+        opened, closed = spans
+        assert opened["status"] == "open" and opened["seconds"] == 0.0
+        assert closed["status"] == "ok" and closed["seconds"] >= 0
+        assert opened["span_id"] == closed["span_id"]
+        assert opened["trace_id"] == closed["trace_id"]
+        assert all(validate_record(r) == [] for r in spans)
+
+    def test_nesting_parents_and_trace_id(self):
+        tel = Telemetry()
+        with tel.trace_span("outer") as octx:
+            with tel.trace_span("inner") as ictx:
+                assert trace.current_context() == ictx
+            assert trace.current_context() == octx
+        inner = _spans(tel, "inner")[0]
+        outer = _spans(tel, "outer")[0]
+        assert inner.parent_id == outer.span_id
+        assert inner.trace_id == outer.trace_id
+
+    def test_explicit_parent_overrides_current(self):
+        tel = Telemetry()
+        other = trace.new_root()
+        with tel.trace_span("a"):
+            with tel.trace_span("b", parent=other):
+                pass
+        b = _spans(tel, "b")[0]
+        assert b.parent_id == other.span_id
+        assert b.trace_id == other.trace_id
+
+    def test_exception_marks_error_and_propagates(self):
+        tel = Telemetry()
+        with pytest.raises(RuntimeError, match="boom"):
+            with tel.trace_span("bad"):
+                raise RuntimeError("boom")
+        rec = _spans(tel, "bad")[0].record
+        assert rec["status"] == "error"
+        assert "RuntimeError: boom" in rec["error"]
+
+    def test_note_lands_on_close_record(self):
+        tel = Telemetry()
+        with tel.trace_span("seg") as _:
+            pass
+        tel2 = Telemetry()
+        span = tel2.trace_span("seg")
+        with span:
+            span.note(outcome="ok", attempt=2)
+        assert _spans(tel2, "seg")[0].record["outcome"] == "ok"
+        assert _spans(tel2, "seg")[0].record["attempt"] == 2
+
+    def test_open_record_flushed_immediately(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        tel = Telemetry([JSONLSink(path)])
+        span = tel.trace_span("live")
+        with span:
+            # mid-span: the open marker must already be ON DISK (the
+            # SIGKILL-visibility contract)
+            lines = open(path).read().strip().splitlines()
+            assert any(json.loads(ln).get("status") == "open"
+                       for ln in lines)
+
+    def test_trace_point_uses_given_ctx(self):
+        tel = Telemetry()
+        ctx = trace.new_root().child()
+        rec = tel.trace_point("req", seconds=0.25, ctx=ctx, rows=4,
+                              t_start_unix=123.0)
+        assert rec["span_id"] == ctx.span_id
+        assert rec["parent_id"] == ctx.parent_id
+        assert rec["seconds"] == 0.25 and rec["rows"] == 4
+        assert validate_record(rec) == []
+
+    def test_trace_summary_record_and_gauge(self):
+        tel = Telemetry()
+        rec = tel.trace_summary(trace_id="t1", spans=5,
+                                straggler_score=1.4, hosts=2)
+        assert validate_record(rec) == []
+        assert rec["kind"] == "trace_summary"
+        snap = tel.registry.snapshot()
+        assert snap["trace.straggler_score"] == 1.4
+
+    def test_selfcheck_covers_trace_summary(self):
+        ok, msgs = schema.selfcheck()
+        assert ok, msgs
+        assert any("trace_summary" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_telemetry_attaches_ring_by_default(self):
+        tel = Telemetry()
+        assert isinstance(tel.flight, FlightRecorder)
+        tel.emit(schema.span_record(tel.run_id, "x", 0.1))
+        assert tel.flight.seen >= 1
+        assert Telemetry(flight=False).flight is None
+
+    def test_ring_is_bounded(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.emit({"kind": "span", "i": i})
+        snap = rec.snapshot()
+        assert len(snap) == 4 and snap[-1]["i"] == 9 and rec.seen == 10
+
+    def test_dump_and_bit_identical_replay(self, tmp_path):
+        tel = Telemetry()
+        for i in range(6):
+            tel.emit(schema.span_record(tel.run_id, f"p{i}", 0.1 * i))
+        path = str(tmp_path / "f.bin")
+        out = tel.flight.dump(path, reason="test")
+        assert out == path
+        rep = flight.load_dump(path)
+        assert rep.reason is None and rep.torn_bytes == 0
+        assert rep.payloads == tel.flight.written
+        assert [r["name"] for r in rep.records if "name" in r] \
+            == [f"p{i}" for i in range(6)]
+
+    def test_torn_tail_truncation(self, tmp_path):
+        tel = Telemetry()
+        for i in range(8):
+            tel.emit(schema.span_record(tel.run_id, f"p{i}", 1.0))
+        path = str(tmp_path / "f.bin")
+        tel.flight.dump(path, reason="test")
+        committed = list(tel.flight.written)
+        # tear into the LAST record's payload: everything before must
+        # replay bit-identically, the tail must be detected
+        faults.truncate_file(
+            path, keep_bytes=os.path.getsize(path)
+            - len(committed[-1]) // 2)
+        rep = flight.load_dump(path)
+        assert rep.reason is not None and rep.torn_bytes > 0
+        assert len(rep.records) == len(committed) - 1
+        assert rep.payloads == committed[:-1]
+
+    def test_scrambled_midfile_stops_at_crc(self, tmp_path):
+        tel = Telemetry()
+        for i in range(8):
+            tel.emit(schema.span_record(tel.run_id, f"p{i}", 1.0))
+        path = str(tmp_path / "f.bin")
+        tel.flight.dump(path, reason="test")
+        faults.scramble_file(path, seed=3, n_bytes=8,
+                             offset=os.path.getsize(path) // 2)
+        rep = flight.load_dump(path)
+        assert rep.reason is not None
+        assert 0 < len(rep.records) < 8
+        assert rep.payloads == tel.flight.written[:len(rep.records)]
+
+    def test_wrong_magic_refused(self, tmp_path):
+        # a journal is NOT a flight dump: same frames, different magic
+        from spark_agd_tpu.resilience.journal import Journal
+
+        path = str(tmp_path / "j.wal")
+        with Journal(path) as j:
+            j.append({"kind": "attempt"})
+        rep = flight.load_dump(path)
+        assert not rep.records and "bad magic" in (rep.reason or "")
+
+    def test_dump_without_destination_is_noop(self):
+        rec = FlightRecorder()
+        rec.emit({"kind": "span"})
+        assert rec.dump(reason="x") is None  # no directory, no path
+
+    def test_empty_ring_never_dumps(self, tmp_path):
+        rec = FlightRecorder(directory=str(tmp_path))
+        assert rec.dump(reason="x") is None
+
+    def test_rate_limit_per_reason(self, tmp_path):
+        clock = [0.0]
+        rec = FlightRecorder(directory=str(tmp_path),
+                             min_dump_interval_s=5.0,
+                             clock=lambda: clock[0])
+        rec.emit({"kind": "span"})
+        assert rec.dump(reason="overload") is not None
+        assert rec.dump(reason="overload") is None      # suppressed
+        assert rec.dump(reason="other") is not None     # per-reason
+        assert rec.dump(reason="overload",
+                        force=True) is not None         # forced
+        clock[0] = 6.0
+        assert rec.dump(reason="overload") is not None  # window past
+
+    def test_dump_on_failure_emits_recovery_record(self, tmp_path):
+        tel = Telemetry(flight_dir=str(tmp_path))
+        tel.emit(schema.span_record(tel.run_id, "x", 0.1))
+        out = flight.dump_on_failure(tel, "unit_test")
+        assert out is not None and os.path.exists(out)
+        recs = [r for r in tel.records if r.get("kind") == "recovery"
+                and r.get("action") == "flight_dump"]
+        assert len(recs) == 1 and recs[0]["path"] == out
+        assert validate_record(recs[0]) == []
+
+    def test_dump_on_failure_without_recorder_or_dir(self, tmp_path):
+        assert flight.dump_on_failure(None, "x") is None
+        tel = Telemetry(flight=False)
+        assert flight.dump_on_failure(tel, "x") is None
+        tel2 = Telemetry()  # ring but no directory
+        tel2.emit({"kind": "span"})
+        assert flight.dump_on_failure(tel2, "x") is None
+
+
+# ---------------------------------------------------------------------------
+# Timeline analysis
+# ---------------------------------------------------------------------------
+
+
+def _mk_span(run_id, name, *, tid, sid, parent, proc, secs, t0,
+             status="ok", **fields):
+    rec = schema.span_record(run_id, name, secs)
+    rec.update(trace_id=tid, span_id=sid, parent_id=parent,
+               process=proc, status=status, t_start_unix=t0, **fields)
+    return rec
+
+
+def _synthetic_trace():
+    """Root on h0; three segments per host; h1's last span truncated;
+    h1 is the straggler."""
+    recs = [_mk_span("r", "run", tid="t1", sid="root", parent=None,
+                     proc=0, secs=10.0, t0=0.0)]
+    for proc, base in ((0, "a"), (1, "b")):
+        slow = 1.0 if proc == 1 else 0.1
+        for i in range(3):
+            recs.append(_mk_span(
+                "r", "segment", tid="t1", sid=f"{base}{i}",
+                parent="root", proc=proc, secs=slow,
+                t0=1.0 + i * slow))
+    recs.append(_mk_span("r", "dead", tid="t1", sid="b9",
+                         parent="root", proc=1, secs=0.0, t0=9.0,
+                         status="open"))
+    return recs
+
+
+class TestTimeline:
+    def test_collect_pairs_open_close(self):
+        tel = Telemetry()
+        with tel.trace_span("x"):
+            pass
+        spans = timeline.collect_spans(tel.records)
+        assert len(spans) == 1 and not spans[0].truncated
+
+    def test_lone_open_is_truncated(self):
+        spans = timeline.collect_spans(_synthetic_trace())
+        trunc = [s for s in spans if s.truncated]
+        assert [s.name for s in trunc] == ["dead"]
+
+    def test_forest_connected_and_hosts(self):
+        rep = timeline.analyze(_synthetic_trace())
+        assert rep.connected and rep.roots == 1
+        assert rep.hosts == [0, 1] and rep.truncated == 1
+        assert rep.spans == 8
+
+    def test_orphan_breaks_connectivity(self):
+        recs = _synthetic_trace()
+        recs.append(_mk_span("r", "lost", tid="t1", sid="z",
+                             parent="missing", proc=0, secs=0.1,
+                             t0=2.0))
+        rep = timeline.analyze(recs)
+        assert not rep.connected and rep.orphans == 1
+
+    def test_step_times_and_straggler(self):
+        times = timeline.per_host_step_times(_synthetic_trace())
+        assert sorted(times) == [0, 1]
+        assert len(times[0]) == 3 and len(times[1]) == 3
+        score = timeline.straggler_score(times)
+        # h1 steps 1.0s vs h0 0.1s: p95(h1)=1.0, median of per-host
+        # medians = (0.1+1.0)/2 = 0.55
+        assert score == pytest.approx(1.0 / 0.55, rel=1e-6)
+        assert timeline.slowest_host(times) == 1
+        table = timeline.host_step_table(times)
+        assert [r["process"] for r in table] == [0, 1]
+        assert table[1]["p95_s"] == pytest.approx(1.0)
+
+    def test_skip_first_drops_warmup(self):
+        recs = _synthetic_trace()
+        times = timeline.per_host_step_times(recs, skip_first=1)
+        assert all(len(ts) == 2 for ts in times.values())
+        assert timeline.per_host_step_times(recs, skip_first=5) == {}
+
+    def test_critical_path_follows_latest_end(self):
+        rep = timeline.analyze(_synthetic_trace())
+        # the truncated 'dead' span starts last (t0=9.0) — the path
+        # must end there, attributed to its host
+        assert [s.name for s in rep.critical_path] == ["run", "dead"]
+        assert rep.critical_host == 1
+
+    def test_critical_path_host_prefers_closed_seconds(self):
+        recs = [_mk_span("r", "run", tid="t1", sid="root", parent=None,
+                         proc=0, secs=5.0, t0=0.0),
+                _mk_span("r", "a", tid="t1", sid="a", parent="root",
+                         proc=1, secs=4.0, t0=0.5),
+                _mk_span("r", "b", tid="t1", sid="b", parent="a",
+                         proc=0, secs=0.5, t0=3.9)]
+        rep = timeline.analyze(recs)
+        assert [s.name for s in rep.critical_path] == ["run", "a", "b"]
+        assert rep.critical_host == 1  # 4.0s on h1 vs 0.5s on h0
+
+    def test_multi_root_picks_latest_ending(self):
+        recs = [_mk_span("r", "r1", tid="t1", sid="r1", parent=None,
+                         proc=0, secs=1.0, t0=0.0),
+                _mk_span("r", "r2", tid="t1", sid="r2", parent=None,
+                         proc=1, secs=1.0, t0=5.0)]
+        rep = timeline.analyze(recs)
+        assert rep.critical_path[0].name == "r2"
+        assert not rep.connected
+
+    def test_trace_ids_and_filter(self):
+        recs = _synthetic_trace()
+        recs.append(_mk_span("r", "other", tid="t2", sid="o",
+                             parent=None, proc=0, secs=0.1, t0=0.0))
+        assert timeline.trace_ids(recs) == ["t1", "t2"]
+        assert timeline.analyze(recs, "t2").spans == 1
+        assert timeline.analyze([]) is None
+
+    def test_chrome_export_loads(self):
+        chrome = timeline.to_chrome_trace(_synthetic_trace())
+        blob = json.loads(json.dumps(chrome))
+        events = blob["traceEvents"]
+        x = [e for e in events if e["ph"] == "X"]
+        meta = [e for e in events if e["ph"] == "M"]
+        assert len(x) == 8 and len(meta) == 2
+        assert {e["pid"] for e in x} == {0, 1}
+        dead = next(e for e in x if e["name"] == "dead")
+        assert dead["args"]["truncated"] is True
+        assert all(e["dur"] >= 1.0 for e in x)
+
+    def test_summary_fields_validate(self):
+        rep = timeline.analyze(_synthetic_trace())
+        tel = Telemetry()
+        rec = tel.trace_summary(**rep.summary_fields(), tool="test")
+        assert validate_record(rec) == []
+        assert rec["truncated"] == 1 and rec["hosts"] == 2
+
+    def test_render_tree_marks_truncation(self):
+        spans = timeline.collect_spans(_synthetic_trace())
+        roots, _ = timeline.build_forest(spans)
+        text = timeline.render_tree(roots)
+        assert "run [h0]" in text and "TRUNCATED" in text
+
+
+# ---------------------------------------------------------------------------
+# Supervisor propagation edges
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisorTracing:
+    def test_plain_run_one_tree(self, problem):
+        tel = Telemetry()
+        res = _supervise(problem, tel)
+        assert res.num_iters == 12
+        rep = timeline.analyze(tel.records)
+        assert rep is not None and rep.connected
+        runs = _spans(tel, "supervised_run")
+        segs = _spans(tel, "segment")
+        assert len(runs) == 1 and len(segs) == 3
+        assert all(s.parent_id == runs[0].span_id for s in segs)
+        assert [s.record.get("outcome") for s in segs] == ["ok"] * 3
+        assert all(validate_record(s.record) == [] for s in segs)
+
+    def test_retry_reparents_to_run_root(self, problem):
+        tel = Telemetry()
+        res = _supervise(problem, tel,
+                         faults_=FaultScript(device_loss_at_iter=4))
+        assert res.retries == 1 and res.num_iters == 12
+        runs = _spans(tel, "supervised_run")
+        segs = _spans(tel, "segment")
+        at4 = [s for s in segs if s.record.get("start_iter") == 4]
+        assert len(at4) == 2  # failed boundary attempt + the retry
+        assert {s.record.get("outcome") for s in at4} \
+            == {"failed", "ok"}
+        # RE-PARENTING: the retry hangs off the run root, not off the
+        # failed attempt's span
+        assert all(s.parent_id == runs[0].span_id for s in at4)
+        failed = next(s for s in at4
+                      if s.record["outcome"] == "failed")
+        assert failed.status == "error"
+        assert "SimulatedDeviceLoss" in failed.record.get("error", "")
+
+    def test_boundary_spans_are_host_local_children(self, problem):
+        """Hooks get a host-local ``boundary`` child span per segment
+        — the span skew attribution reads (lockstep peers absorb a
+        straggler's delay into their collectives, so ``segment`` spans
+        tie; ``boundary`` spans don't).  Plain runs (no hooks) emit
+        none."""
+        tel = Telemetry()
+        _supervise(problem, tel)
+        assert _spans(tel, "boundary") == []  # no hooks, no records
+        tel2 = Telemetry()
+        _supervise(problem, tel2,
+                   faults_=FaultScript(device_loss_at_iter=4))
+        segs = {s.span_id for s in _spans(tel2, "segment")}
+        bounds = _spans(tel2, "boundary")
+        assert len(bounds) == 4  # one per attempt (3 ok + 1 failed)
+        assert all(b.parent_id in segs for b in bounds)
+        failed = [b for b in bounds if b.status == "error"]
+        assert len(failed) == 1
+        assert "SimulatedDeviceLoss" in failed[0].record["error"]
+        # all four CLOSED (incl. the errored one); only truncated
+        # opens are excluded from step aggregation
+        times = timeline.per_host_step_times(tel2.records,
+                                             name="boundary")
+        assert set(times) == {0} and len(times[0]) == 4
+
+    def test_rollback_reparents_to_run_root(self, problem):
+        tel = Telemetry()
+        res = _supervise(problem, tel,
+                         faults_=FaultScript(nan_at_iter=8))
+        assert res.rollbacks == 1 and res.num_iters == 12
+        runs = _spans(tel, "supervised_run")
+        at8 = [s for s in _spans(tel, "segment")
+               if s.record.get("start_iter") == 8]
+        assert {s.record.get("outcome") for s in at8} \
+            == {"aborted_non_finite", "ok"}
+        assert all(s.parent_id == runs[0].span_id for s in at8)
+
+    def test_giving_up_dumps_flight(self, problem, tmp_path):
+        tel = Telemetry(flight_dir=str(tmp_path))
+        with pytest.raises(SupervisorGivingUp):
+            _supervise(problem, tel,
+                       faults_=FaultScript(nan_at_iter=4),
+                       policy_kw={"max_rollbacks": 0})
+        run = _spans(tel, "supervised_run")[0]
+        assert run.status == "error"
+        dumps = [r for r in tel.records if r.get("kind") == "recovery"
+                 and r.get("action") == "flight_dump"]
+        assert len(dumps) == 1
+        rep = flight.load_dump(dumps[0]["path"])
+        assert rep.reason is None and rep.records
+        # the dump carries the run's last seconds: the aborted attempt
+        assert any(r.get("kind") == "attempt"
+                   and r.get("outcome") == "aborted_non_finite"
+                   for r in rep.records)
+
+    def test_ckpt_commit_spans_under_run(self, problem, tmp_path):
+        tel = Telemetry()
+        build, dargs, px, rv, w0 = problem
+        fp = ckpt.problem_fingerprint(np.zeros(2, np.float32),
+                                      agd.AGDConfig(num_iterations=12))
+        dc = DistributedCheckpointer(
+            str(tmp_path / "ck"), every_iters=4, keep=3,
+            fingerprint=fp, telemetry=tel, process_index=0,
+            process_count=1)
+        _supervise(problem, tel, checkpointer=dc)
+        runs = _spans(tel, "supervised_run")
+        segs = _spans(tel, "segment")
+        commits = _spans(tel, "ckpt_commit")
+        assert len(commits) >= 2
+        # in-loop commits are children of the segment they closed; the
+        # terminal force-flush hangs off the run root — either way the
+        # whole run is ONE connected tree
+        allowed = {runs[0].span_id} | {s.span_id for s in segs}
+        assert all(c.parent_id in allowed for c in commits)
+        assert any(c.parent_id != runs[0].span_id for c in commits)
+        assert all(isinstance(c.record.get("generation"), int)
+                   for c in commits)
+        rep = timeline.analyze(tel.records)
+        assert rep.connected
+
+    def test_cross_process_context_adoption(self, problem):
+        """A supervised run inside an adopted (wire-form) context must
+        hang its run span under the foreign root."""
+        tel = Telemetry()
+        foreign = trace.new_root(process=0)
+        env = {trace.TRACE_ENV: foreign.to_env_value()}
+        with trace.activate(trace.from_env(env)):
+            _supervise(problem, tel)
+        run = _spans(tel, "supervised_run")[0]
+        assert run.parent_id == foreign.span_id
+        assert run.trace_id == foreign.trace_id
+
+    def test_tracing_is_hlo_identical(self, problem):
+        """The pin: tracing + flight machinery changes NOTHING about
+        the compiled program (no callback, byte-identical HLO text)."""
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(64, 8)).astype(np.float32)
+        y = (X @ rng.normal(size=8) > 0).astype(np.float32)
+        w0 = np.zeros(8, np.float32)
+        plain = api.make_runner((X, y), LogisticGradient(),
+                                SquaredL2Updater(), reg_param=0.1,
+                                num_iterations=5, mesh=False)
+        base_text = plain.lower_step(w0).as_text()
+        tel = Telemetry(flight_dir=None)
+        with tel.trace_span("outer"):
+            traced = api.make_runner((X, y), LogisticGradient(),
+                                     SquaredL2Updater(), reg_param=0.1,
+                                     num_iterations=5, mesh=False)
+            traced_text = traced.lower_step(w0).as_text()
+        assert traced_text == base_text
+        assert "callback" not in traced_text
+
+
+# ---------------------------------------------------------------------------
+# Serve-plane propagation edges
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_parts():
+    from spark_agd_tpu.models.glm import LogisticRegressionModel
+
+    def make(seed):
+        r = np.random.default_rng(seed)
+        return LogisticRegressionModel(
+            r.normal(size=6).astype(np.float32), 0.1)
+
+    return make
+
+
+class TestServeTracing:
+    def _engine_queue(self, make, tel, **qkw):
+        from spark_agd_tpu.serve import MicroBatchQueue, ServeEngine
+
+        eng = ServeEngine(make(1), generation=1, max_batch=8,
+                          min_bucket=4, telemetry=tel)
+        qkw.setdefault("max_wait_us", 500)
+        return eng, MicroBatchQueue(eng, telemetry=tel, **qkw)
+
+    def test_request_batch_engine_chain(self, serve_parts):
+        tel = Telemetry()
+        eng, q = self._engine_queue(serve_parts, tel)
+        root = trace.new_root()
+        with q:
+            with trace.activate(root):
+                futs = [q.submit(np.ones((n, 6), np.float32))
+                        for n in (3, 2)]
+            for f in futs:
+                f.result(timeout=30)
+        spans = timeline.collect_spans(tel.records)
+        reqs = [s for s in spans if s.name == "serve_request"]
+        batches = [s for s in spans if s.name == "serve_batch"]
+        calls = [s for s in spans if s.name == "engine_call"]
+        assert len(reqs) == 2 and batches and calls
+        assert all(s.parent_id == root.span_id for s in reqs)
+        req_ids = {s.span_id for s in reqs}
+        assert all(b.parent_id in req_ids for b in batches)
+        batch_ids = {b.span_id for b in batches}
+        assert all(c.parent_id in batch_ids for c in calls)
+        # siblings link back to the batch they rode in
+        assert all(s.record.get("batch_span_id") in batch_ids
+                   for s in reqs)
+        assert {s.trace_id for s in reqs + batches + calls} \
+            == {root.trace_id}
+
+    def test_untraced_client_gets_fresh_roots(self, serve_parts):
+        tel = Telemetry()
+        eng, q = self._engine_queue(serve_parts, tel)
+        with q:
+            q.submit(np.ones((2, 6), np.float32)).result(timeout=30)
+        reqs = [s for s in timeline.collect_spans(tel.records)
+                if s.name == "serve_request"]
+        assert len(reqs) == 1 and reqs[0].parent_id is None
+
+    def test_hot_swap_mid_trace(self, serve_parts):
+        tel = Telemetry()
+        eng, q = self._engine_queue(serve_parts, tel)
+        root = trace.new_root()
+        with q:
+            with trace.activate(root):
+                q.submit(np.ones((2, 6), np.float32)).result(timeout=30)
+                eng.bind(serve_parts(2), 2)
+                q.submit(np.ones((2, 6), np.float32)).result(timeout=30)
+        reqs = [s for s in timeline.collect_spans(tel.records)
+                if s.name == "serve_request"]
+        assert {s.record.get("generation") for s in reqs} == {1, 2}
+        assert {s.trace_id for s in reqs} == {root.trace_id}
+        # with the root span itself on record, the swap never broke
+        # the tree: one root, zero orphans
+        tel.trace_point("client_root", seconds=0.0, ctx=root)
+        rep = timeline.analyze(tel.records, root.trace_id)
+        assert rep.connected and rep.orphans == 0
+
+    def test_engine_failure_marks_request_spans_error(self,
+                                                     serve_parts):
+        tel = Telemetry()
+        eng, q = self._engine_queue(serve_parts, tel)
+
+        def boom(X, op="predict"):
+            raise RuntimeError("engine down")
+
+        eng.serve_batch = boom
+        root = trace.new_root()
+        with q:
+            with trace.activate(root):
+                fut = q.submit(np.ones((2, 6), np.float32))
+            with pytest.raises(RuntimeError, match="engine down"):
+                fut.result(timeout=30)
+        reqs = [s for s in timeline.collect_spans(tel.records)
+                if s.name == "serve_request"]
+        assert reqs and all(s.status == "error" for s in reqs)
+        assert all(s.parent_id == root.span_id for s in reqs)
+
+    def test_overload_dumps_flight(self, serve_parts, tmp_path):
+        from spark_agd_tpu.resilience.errors import ServeOverloaded
+
+        tel = Telemetry(flight_dir=str(tmp_path))
+        eng, q = self._engine_queue(serve_parts, tel,
+                                    max_queue_rows=4,
+                                    max_wait_us=300_000)
+        rejected = 0
+        with q:
+            futs = []
+            for _ in range(8):
+                try:
+                    futs.append(q.submit(np.ones((2, 6), np.float32)))
+                except ServeOverloaded:
+                    rejected += 1
+            for f in futs:
+                f.result(timeout=30)
+        assert rejected > 0
+        assert tel.flight.dumps and os.path.exists(tel.flight.dumps[0])
+        rep = flight.load_dump(tel.flight.dumps[0])
+        assert rep.reason is None and rep.records
+
+
+# ---------------------------------------------------------------------------
+# Perf gate on the skew metric
+# ---------------------------------------------------------------------------
+
+
+class TestStragglerGate:
+    KEY = {"tool": "bench", "name": "fit", "algorithm": "agd"}
+
+    def _run(self, score):
+        return dict(schema.run_record(run_id="x",
+                                      straggler_score=score,
+                                      **self.KEY))
+
+    def test_skew_regression_fails_gate(self):
+        gate = compare_records([self._run(1.1)], [self._run(2.0)])
+        bad = [d for d in gate.regressions
+               if d.metric == "straggler_score"]
+        assert len(bad) == 1 and not gate.ok
+
+    def test_balanced_passes(self):
+        gate = compare_records([self._run(1.1)], [self._run(1.15)])
+        assert not [d for d in gate.regressions
+                    if d.metric == "straggler_score"]
+
+
+# ---------------------------------------------------------------------------
+# 2-process gloo cross-host trace join
+# ---------------------------------------------------------------------------
+
+
+_CHILD_SRC = '''
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+addr, nproc, pid, workdir = (sys.argv[1], int(sys.argv[2]),
+                             int(sys.argv[3]), sys.argv[4])
+from spark_agd_tpu.parallel import multihost as mh
+mh.initialize(addr, nproc, pid)
+assert jax.process_count() == nproc
+from spark_agd_tpu.obs import JSONLSink, Telemetry, trace
+import numpy as np
+tel = Telemetry([JSONLSink(mh.host_suffixed(
+    os.path.join(workdir, "join.jsonl")))])
+with trace.activate(trace.from_env()):
+    with tel.trace_span("host_run", pid=pid):
+        with tel.trace_span("segment", start_iter=0):
+            # a REAL cross-host barrier inside the span
+            rows = mh.process_allgather_int64([pid + 1])
+            assert rows.shape[0] == nproc, rows
+tel.flush(); tel.close()
+print(f"TRACE_JOIN_OK pid={pid}", flush=True)
+'''
+
+
+@pytest.mark.dist_fault
+class TestCrossHostJoin:
+    def test_two_process_trace_joins(self, tmp_path):
+        child = tmp_path / "join_child.py"
+        child.write_text(_CHILD_SRC)
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        root = trace.new_root()
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__)))] + env.get("PYTHONPATH",
+                                       "").split(os.pathsep))
+        env[trace.TRACE_ENV] = root.to_env_value()
+        procs = [subprocess.Popen(
+            [sys.executable, str(child), f"localhost:{port}", "2",
+             str(i), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+            for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                outs.append((p.returncode, out.decode(), err.decode()))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for i, (rc, out, err) in enumerate(outs):
+            assert rc == 0 and "TRACE_JOIN_OK" in out, (i, rc, err)
+        # the parent owns the root: emit it, then join the streams
+        tel = Telemetry(
+            [JSONLSink(str(tmp_path / "join.parent.jsonl"))])
+        rec = tel.trace_point("cross_host_drill", seconds=0.0,
+                              ctx=root)
+        tel.close()
+        records = [rec]
+        for name in ("join.h000.jsonl", "join.h001.jsonl"):
+            records.extend(schema.read_jsonl(str(tmp_path / name)))
+        rep = timeline.analyze(records, root.trace_id)
+        assert rep is not None and rep.connected, vars(rep)
+        assert rep.hosts == [0, 1]
+        runs = [s for s in timeline.collect_spans(records,
+                                                  root.trace_id)
+                if s.name == "host_run"]
+        assert len(runs) == 2
+        assert {s.process for s in runs} == {0, 1}
+        assert all(s.parent_id == root.span_id for s in runs)
+
+
+# ---------------------------------------------------------------------------
+# CLI consumers
+# ---------------------------------------------------------------------------
+
+
+class TestCLI:
+    def _write_jsonl(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        with open(path, "w") as f:
+            for rec in _synthetic_trace():
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+    def _run_tool(self, name, argv):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            f"_{name}_under_test",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "tools", f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main(argv)
+
+    def test_agd_trace_reports_and_exports(self, tmp_path, capsys):
+        path = self._write_jsonl(tmp_path)
+        chrome = str(tmp_path / "chrome.json")
+        rc = self._run_tool("agd_trace", [path, "--chrome", chrome])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "straggler score" in out and "critical path" in out
+        assert "truncated: dead [h1]" in out
+        blob = json.load(open(chrome))
+        assert len(blob["traceEvents"]) >= 8
+
+    def test_agd_trace_flight_input(self, tmp_path, capsys):
+        tel = Telemetry()
+        for rec in _synthetic_trace():
+            tel.emit(rec)
+        dump = str(tmp_path / "f.bin")
+        tel.flight.dump(dump, reason="t")
+        empty = str(tmp_path / "empty.jsonl")
+        open(empty, "w").close()
+        rc = self._run_tool("agd_trace", [empty, "--flight", dump])
+        assert rc == 0
+        assert "critical path" in capsys.readouterr().out
+
+    def test_agd_trace_no_spans_exits_1(self, tmp_path, capsys):
+        path = str(tmp_path / "r.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(schema.span_record("r", "x", 0.1))
+                    + "\n")
+        assert self._run_tool("agd_trace", [path]) == 1
+        assert self._run_tool(
+            "agd_trace", [self._write_jsonl(tmp_path),
+                          "--trace", "nope"]) == 1
+
+    def test_agd_report_trace_section(self, tmp_path, capsys):
+        path = self._write_jsonl(tmp_path)
+        rc = self._run_tool("agd_report", [path])
+        out = capsys.readouterr().out
+        assert rc == 0 and "== tracing ==" in out
+        assert "straggler score" in out
+        assert "critical path" in out
+
+    def test_agd_report_trace_filter(self, tmp_path, capsys):
+        path = self._write_jsonl(tmp_path)
+        rc = self._run_tool("agd_report",
+                            [path, "--trace", "missing"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "== tracing ==" not in out
+
+    def test_agd_report_flight_pointer(self, tmp_path, capsys):
+        path = str(tmp_path / "r.jsonl")
+        recs = _synthetic_trace()
+        recs.append({"schema_version": 1, "kind": "recovery",
+                     "run_id": "r", "action": "flight_dump",
+                     "path": "/tmp/flight-x.bin", "reason": "test"})
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+        assert self._run_tool("agd_report", [path]) == 0
+        out = capsys.readouterr().out
+        assert "flight-recorder dumps" in out
+        assert "/tmp/flight-x.bin" in out

@@ -66,7 +66,7 @@ def _kind(rec: dict) -> Optional[str]:
     k = rec.get("kind")
     if k in ("run", "iteration", "span", "metrics", "attempt",
              "recovery", "numerics_failure", "contract_pin",
-             "serve_request", "serve_latency"):
+             "serve_request", "serve_latency", "trace_summary"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -226,6 +226,65 @@ def summarize_contract_pins(pins: List[dict]) -> str:
     return _table(headers, rows)
 
 
+def summarize_tracing(records: List[dict], recoveries: List[dict],
+                      trace_filter: Optional[str] = None) -> Optional[str]:
+    """The trace/straggler rollup (``obs.timeline`` over traced span
+    records, plus ``flight_dump`` recovery records): per trace — span/
+    host/truncation counts, the per-host step-time table, the critical
+    path with its host attribution, the straggler score, and pointers
+    to any flight-recorder dumps written by failure paths.  None when
+    nothing was traced (the section only appears when it has content).
+    ``trace_filter`` narrows to one trace id (the ``--trace`` flag)."""
+    try:
+        from spark_agd_tpu.obs import timeline
+    except ImportError:
+        return None
+    ids = timeline.trace_ids(records)
+    if trace_filter is not None:
+        ids = [t for t in ids if t == trace_filter]
+    if not ids:
+        return None
+    lines: List[str] = []
+    for tid in ids:
+        rep = timeline.analyze(records, tid)
+        if rep is None:
+            continue
+        lines.append(
+            f"trace {tid}: spans={rep.spans} hosts={rep.hosts} "
+            f"truncated={rep.truncated} "
+            f"connected={'yes' if rep.connected else 'NO'}")
+        table = timeline.host_step_table(rep.step_times)
+        if table:
+            rows = [[f"h{r['process']}", str(r["steps"]),
+                     _fmt(r["mean_s"], 4), _fmt(r["p50_s"], 4),
+                     _fmt(r["p95_s"], 4), _fmt(r["max_s"], 4)]
+                    for r in table]
+            lines.append(_table(
+                ["host", "steps", "mean_s", "p50_s", "p95_s", "max_s"],
+                rows))
+        if rep.straggler_score is not None:
+            lines.append(f"straggler score: {rep.straggler_score:.3f} "
+                         f"(slowest host h{rep.slowest_host}; lower "
+                         "is better)")
+        if rep.critical_path:
+            chain = " -> ".join(
+                f"{s.name}[h{s.process}]" for s in rep.critical_path)
+            lines.append(
+                f"critical path (attributed to h{rep.critical_host}): "
+                f"{chain}")
+        lines.append("")
+    dumps = [r for r in recoveries if r.get("action") == "flight_dump"]
+    if dumps:
+        lines.append("flight-recorder dumps (inspect with "
+                     "tools/agd_trace.py --flight PATH):")
+        for rec in dumps:
+            lines.append(f"  {rec.get('path', '?')}  "
+                         f"(reason: {rec.get('reason', '?')})")
+    while lines and not lines[-1]:
+        lines.pop()
+    return "\n".join(lines) if lines else None
+
+
 def summarize_serving(requests: List[dict], latencies: List[dict],
                       recoveries: List[dict]) -> str:
     """The serving rollup (``serve_request`` / ``serve_latency``
@@ -350,6 +409,10 @@ def main(argv=None) -> int:
                         "render a side-by-side timing/convergence diff "
                         "(report-only; the failing gate is "
                         "tools/perf_gate.py)")
+    p.add_argument("--trace", default=None, metavar="TRACE_ID",
+                   help="narrow the trace/straggler section to one "
+                        "trace id (full timeline analysis lives in "
+                        "tools/agd_trace.py)")
     args = p.parse_args(argv)
 
     if args.compare:
@@ -418,6 +481,10 @@ def main(argv=None) -> int:
         print(f"\n== serving ({len(serve_reqs)} requests, "
               f"{len(serve_lats)} latency rollups) ==")
         print(summarize_serving(serve_reqs, serve_lats, recoveries))
+    tracing = summarize_tracing(records, recoveries, args.trace)
+    if tracing:
+        print("\n== tracing ==")
+        print(tracing)
     if unknown:
         print(f"\nnote: {unknown} record(s) of unknown shape ignored")
 

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Per-host timeline analysis of traced run records — the consumer CLI
+of ``obs.trace``/``obs.timeline``.
+
+Feed it any mix of run-record JSONLs and flight-recorder dumps
+(``AGDFDR01`` files from ``obs.flight``, e.g. a ``--flight`` dump that
+shipped with a ``SupervisorGivingUp``), and it reconstructs the causal
+span tree and prints, per trace:
+
+- the tree summary (spans, hosts, roots, truncated spans — a truncated
+  span is where a host DIED mid-span),
+- the per-host step-time table over ``segment`` spans (count / mean /
+  p50 / p95 / max seconds per rank),
+- the **straggler score** — max over hosts of that host's p95 step
+  time, divided by the median step time over all samples (lower is
+  better, ~1.0 balanced; ``obs.perfgate`` gates runs on this number),
+- the **critical path** — the root→leaf chain of spans that bounded
+  the wall clock, with its host attribution.
+
+``--chrome OUT.json`` additionally exports Chrome trace-event JSON:
+open ``chrome://tracing`` (or https://ui.perfetto.dev) and load the
+file — one row per host, spans nested by time, truncated spans
+clipped where the host died.
+
+Usage::
+
+    python tools/agd_trace.py RUN.jsonl [MORE.jsonl ...]
+        [--flight DUMP.bin ...] [--trace TRACE_ID]
+        [--chrome OUT.json] [--step-span segment] [-v]
+
+Exit 0 when at least one traced span was found (and any requested
+export was written); 1 otherwise.  See ``docs/OBSERVABILITY.md``
+§distributed-tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_jsonl(paths):
+    """(records, n_bad): tolerant line-by-line parse, like
+    tools/agd_report.py."""
+    records, bad = [], 0
+    for path in paths:
+        try:
+            f = open(path)
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            bad += 1
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records, bad
+
+
+def _fmt_s(v) -> str:
+    return f"{v * 1e3:.1f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def _table(headers, rows):
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+
+    def line(cells):
+        return "  ".join(c.ljust(w)
+                         for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def report_trace(records, trace_id, *, step_span, skip_first,
+                 verbose) -> bool:
+    from spark_agd_tpu.obs import timeline
+
+    rep = timeline.analyze(records, trace_id, step_span=step_span,
+                           skip_first=skip_first)
+    if rep is None:
+        return False
+    print(f"== trace {rep.trace_id} ==")
+    print(f"spans={rep.spans} hosts={rep.hosts} roots={rep.roots} "
+          f"truncated={rep.truncated} "
+          f"connected={'yes' if rep.connected else 'NO'}"
+          + ("" if rep.connected else
+             f" ({rep.roots} roots, {rep.orphans} orphaned spans — "
+             "a stream is missing, or the tree is broken)"))
+    if rep.truncated:
+        spans = timeline.collect_spans(records, rep.trace_id)
+        for s in spans:
+            if s.truncated:
+                print(f"  truncated: {s.name} [h{s.process}] — the "
+                      "emitting process died inside this span")
+    if rep.step_times:
+        rows = [[f"h{r['process']}", str(r["steps"]),
+                 _fmt_s(r["total_s"]), _fmt_s(r["mean_s"]),
+                 _fmt_s(r["p50_s"]), _fmt_s(r["p95_s"]),
+                 _fmt_s(r["max_s"])]
+                for r in timeline.host_step_table(rep.step_times)]
+        print(f"\nper-host step times ({step_span!r} spans):")
+        print(_table(["host", "steps", "total", "mean", "p50", "p95",
+                      "max"], rows))
+        if rep.straggler_score is not None:
+            print(f"straggler score: {rep.straggler_score:.3f} "
+                  f"(slowest host: h{rep.slowest_host}; ~1.0 is "
+                  "balanced, lower is better)")
+    path = rep.critical_path
+    if path:
+        chain = " -> ".join(
+            f"{s.name}[h{s.process}"
+            + ("," + ("?" if s.truncated else _fmt_s(s.seconds)) + "]")
+            for s in path)
+        host = rep.critical_host
+        print(f"\ncritical path ({len(path)} spans, "
+              f"{_fmt_s(rep.critical_path_s) if rep.critical_path_s is not None else '?'}, "
+              f"attributed to h{host}):")
+        print(f"  {chain}")
+    if verbose:
+        roots, _ = timeline.build_forest(
+            timeline.collect_spans(records, rep.trace_id))
+        print("\ntree:")
+        print(timeline.render_tree(roots))
+    print()
+    return True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/agd_trace.py",
+        description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+", metavar="FILE.jsonl",
+                   help="run-record JSONL file(s)")
+    p.add_argument("--flight", action="append", default=[],
+                   metavar="DUMP.bin",
+                   help="flight-recorder dump(s) to include "
+                        "(obs.flight AGDFDR01 files; replayed up to "
+                        "any torn tail)")
+    p.add_argument("--trace", default=None, metavar="TRACE_ID",
+                   help="analyze only this trace id (default: every "
+                        "trace found)")
+    p.add_argument("--chrome", default=None, metavar="OUT.json",
+                   help="write Chrome trace-event JSON for "
+                        "chrome://tracing / Perfetto")
+    p.add_argument("--step-span", default="segment",
+                   help="span name aggregated for the per-host "
+                        "step-time table (default: segment)")
+    p.add_argument("--skip-first", type=int, default=0,
+                   metavar="N",
+                   help="drop each host's first N steps from the "
+                        "skew stats (the first segment carries "
+                        "trace+compile warmup; pass 1 for steady-"
+                        "state skew)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print the full span tree")
+    args = p.parse_args(argv)
+
+    records, bad = _load_jsonl(args.paths)
+    if bad:
+        print(f"note: {bad} unparsable line(s)/file(s) skipped",
+              file=sys.stderr)
+    if args.flight:
+        from spark_agd_tpu.obs import flight
+
+        for path in args.flight:
+            rep = flight.load_dump(path)
+            if rep.reason:
+                print(f"note: {path}: replay stopped early "
+                      f"({rep.reason}; {rep.torn_bytes} torn bytes "
+                      "dropped)", file=sys.stderr)
+            records.extend(rep.records)
+
+    from spark_agd_tpu.obs import timeline
+
+    ids = timeline.trace_ids(records)
+    if args.trace is not None:
+        ids = [t for t in ids if t == args.trace]
+    if not ids:
+        print("no traced spans found"
+              + (f" for trace {args.trace!r}" if args.trace else "")
+              + " — was the run traced? (Telemetry.trace_span)",
+              file=sys.stderr)
+        return 1
+
+    any_reported = False
+    for tid in ids:
+        any_reported |= report_trace(records, tid,
+                                     step_span=args.step_span,
+                                     skip_first=args.skip_first,
+                                     verbose=args.verbose)
+
+    if args.chrome is not None:
+        chrome = timeline.to_chrome_trace(records, args.trace)
+        with open(args.chrome, "w") as f:
+            json.dump(chrome, f)
+        print(f"chrome trace ({len(chrome['traceEvents'])} events) "
+              f"written to {args.chrome} — load in chrome://tracing "
+              "or ui.perfetto.dev")
+    return 0 if any_reported else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,18 @@ parent) validates against the canonical ``obs.schema``, with
 ``heartbeat``, failed/ok ``attempt``, and the expected ``recovery``
 actions all present.  Any miss prints the reason and exits 1.
 
+The drill additionally proves the TRACING stack (``obs.trace`` /
+``obs.flight`` / ``obs.timeline``): the parent's root span context is
+propagated to both SPMD children through ``AGD_TRACE_CONTEXT``, so
+every stream must assemble into ONE connected span tree spanning both
+hosts, with the SIGKILL visible as a truncated span; the surviving
+host carries scripted ``slow_host`` chaos faults and the per-host
+step-time analysis must attribute both the straggler and the critical
+path to it; the parent's flight recorder dumps on the host loss and
+the dump — torn mid-record by the drill — must replay bit-identically
+up to the torn tail; and ``tools/agd_trace.py`` must exit 0 emitting
+loadable Chrome trace-event JSON over the same streams.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/dist_fault_drill.py [-v] [--out DIR]
@@ -156,8 +168,29 @@ def child_main(args) -> int:
             partitions=ingest.local_partitions(paths))
         if args.pid == args.kill_pid:
             kwargs["faults"] = FaultScript(sigkill_at_iter=args.kill_at)
+        elif args.slow_s > 0:
+            # the SURVIVOR plays the straggler: a scripted slow_host
+            # chaos fault sleeps at every boundary up to the kill, so
+            # this host's segment spans are measurably longer and the
+            # timeline analysis must attribute the critical path here
+            from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                        ScheduledFault)
 
-    res = run_agd_supervised(**kwargs)
+            kwargs["faults"] = ChaosSchedule(
+                [ScheduledFault(kind="slow_host", at_iter=b,
+                                payload=args.slow_s)
+                 for b in range(args.segment, args.kill_at + 1,
+                                args.segment)],
+                telemetry=tel)
+
+    # join the parent's causal trace (obs.trace): the parent publishes
+    # its root span context through AGD_TRACE_CONTEXT, so both hosts'
+    # supervised_run spans — and every segment/ckpt_commit under them —
+    # become one tree spanning the whole drill
+    from spark_agd_tpu.obs import trace as trace_lib
+
+    with trace_lib.activate(trace_lib.from_env()):
+        res = run_agd_supervised(**kwargs)
     tel.flush()
     if args.phase == "baseline" and args.pid == 0:
         with open(os.path.join(args.workdir, "baseline.json"), "w") as f:
@@ -188,7 +221,8 @@ def _spawn_children(args, phase: str, port: int):
              "--pid", str(i), "--workdir", args.workdir,
              "--iters", str(args.iters), "--segment", str(args.segment),
              "--kill-at", str(args.kill_at),
-             "--kill-pid", str(args.kill_pid)],
+             "--kill-pid", str(args.kill_pid),
+             "--slow-s", str(args.slow_s)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
         for i in range(2)
     ]
@@ -230,6 +264,21 @@ def parent_main(args) -> int:
             os.path.join(args.workdir, "parts", f"part-{k}.libsvm"),
             X, y)
 
+    # parent telemetry + the drill's ROOT trace span: its context is
+    # published through AGD_TRACE_CONTEXT so every child process joins
+    # the same causal tree (obs.trace), and a flight recorder rides the
+    # parent bus for the host-loss dump
+    from spark_agd_tpu.obs import (JSONLSink, Telemetry, flight as
+                                   flight_lib, schema, timeline,
+                                   trace as trace_lib)
+
+    parent_jsonl = os.path.join(args.workdir, "drill-parent.jsonl")
+    tel = Telemetry([JSONLSink(parent_jsonl)], flight_dir=args.workdir)
+    root_span = tel.trace_span("dist_fault_drill",
+                               tool="dist_fault_drill")
+    root_ctx = root_span.__enter__()
+    os.environ[trace_lib.TRACE_ENV] = root_ctx.to_env_value()
+
     # -- phase 1: uninterrupted 2-process baseline ------------------------
     procs = _spawn_children(args, "baseline", _free_port())
     outs = _reap(procs, timeout=420)
@@ -254,11 +303,8 @@ def parent_main(args) -> int:
           f"{args.kill_at} (rc={killed_rc})")
 
     # host-loss detection: the dead host's heartbeat file goes stale
-    from spark_agd_tpu.obs import JSONLSink, Telemetry, schema
     from spark_agd_tpu.resilience import HostLost, HostMonitor
 
-    parent_jsonl = os.path.join(args.workdir, "drill-parent.jsonl")
-    tel = Telemetry([JSONLSink(parent_jsonl)])
     monitor = HostMonitor(
         os.path.join(args.workdir, "hb", "killed"),
         expected=[args.kill_pid], stale_after_s=2.0, telemetry=tel)
@@ -272,6 +318,35 @@ def parent_main(args) -> int:
             lost = e
     check(lost is not None and lost.process_index == args.kill_pid,
           f"heartbeat monitor detected the lost host ({lost})")
+
+    # the host loss ships with the parent's last-seconds timeline: dump
+    # the flight ring, then TEAR the dump's tail (the same byte
+    # violence phase 3 applies to a shard) and prove the replay is
+    # bit-identical up to the torn tail — the flight recorder's whole
+    # contract in one check
+    from spark_agd_tpu.resilience import faults as faults_lib
+
+    tel.metrics_snapshot(tool="dist_fault_drill")  # ring holds >= 3
+    dump_path = flight_lib.dump_on_failure(tel, "host_lost")
+    check(dump_path is not None and os.path.exists(dump_path),
+          f"flight recorder dumped on host loss ({dump_path})")
+    if dump_path is not None:
+        committed = list(tel.flight.written)
+        # tear HALF of the last record's payload off — every earlier
+        # record must survive, the tail must be detected, byte-for-byte
+        keep = (os.path.getsize(dump_path)
+                - max(1, len(committed[-1]) // 2))
+        faults_lib.truncate_file(dump_path, keep_bytes=keep)
+        replayed = flight_lib.load_dump(dump_path)
+        check(replayed.torn_bytes > 0 and replayed.reason is not None,
+              f"torn flight-dump tail detected ({replayed.reason}; "
+              f"{replayed.torn_bytes} bytes dropped)")
+        check(len(replayed.payloads) == len(committed) - 1
+              and replayed.payloads
+              == committed[:len(replayed.payloads)],
+              f"flight dump replays bit-identically up to the torn "
+              f"tail ({len(replayed.payloads)}/{len(committed)} "
+              "records recovered)")
 
     # reap the survivor (blocked in a collective against a dead peer —
     # on real capacity the relaunch replaces the whole job the same way)
@@ -328,8 +403,103 @@ def parent_main(args) -> int:
           f"2-process baseline {base_loss:.12f} "
           f"(|diff| = {diff:.2e} <= {args.tol:g})")
 
-    # -- the JSONL evidence, across every host's stream -------------------
+    # -- the causal-tree evidence (obs.trace / obs.timeline) --------------
+    root_span.__exit__(None, None, None)
+    tel.flush()
     jsonls = sorted(glob.glob(os.path.join(args.workdir, "drill-*.jsonl*")))
+    all_records = []
+    per_file = {}
+    for path in jsonls:
+        recs = schema.read_jsonl(path)
+        per_file[path] = recs
+        all_records.extend(recs)
+
+    tree = timeline.analyze(all_records, root_ctx.trace_id)
+    check(tree is not None and tree.connected,
+          "one CONNECTED span tree across every stream (single root, "
+          "zero orphans)"
+          + ("" if tree is None else
+             f" — spans={tree.spans} roots={tree.roots}"))
+    if tree is not None:
+        check(set(tree.hosts) >= {0, 1},
+              f"the tree spans both hosts (hosts={tree.hosts})")
+        check(tree.truncated >= 1,
+              f"the SIGKILL is visible as a truncated span "
+              f"({tree.truncated} truncated)")
+        killed_stream = [
+            r for path, recs in per_file.items()
+            if f"drill-killed.h{args.kill_pid:03d}" in path
+            for r in recs]
+        killed_spans = timeline.collect_spans(killed_stream,
+                                              root_ctx.trace_id)
+        check(any(s.truncated for s in killed_spans),
+              "the killed host's own stream ends in a truncated span")
+
+    # per-host skew: the surviving host carried scripted slow_host
+    # faults, so the step-time analysis of the killed phase must
+    # attribute both the straggler and the critical path to it
+    slow_host = 1 - args.kill_pid
+    killed_records = [
+        r for path, recs in per_file.items()
+        if "drill-killed." in os.path.basename(path) for r in recs]
+    if args.slow_s > 0:
+        chaos_hits = [r for r in killed_records
+                      if r.get("kind") == "chaos"
+                      and r.get("fault") == "slow_host"]
+        check(len(chaos_hits) >= 1,
+              f"scripted slow_host chaos faults fired and are on "
+              f"record (x{len(chaos_hits)})")
+        # skew is attributed on the HOST-LOCAL ``boundary`` spans: in
+        # lockstep SPMD the peer's next collective absorbs a
+        # straggler's delay, so the coupled ``segment`` spans tie —
+        # the boundary span is where the sleep actually lives
+        skew = timeline.analyze(killed_records, root_ctx.trace_id,
+                                step_span="boundary")
+        check(skew is not None and skew.slowest_host == slow_host
+              and (skew.straggler_score or 0) > 1.5,
+              f"per-host boundary step times name host {slow_host} "
+              "the straggler"
+              + ("" if skew is None else
+                 f" (slowest={skew.slowest_host}, "
+                 f"score={skew.straggler_score})"))
+        check(skew is not None and skew.critical_host == slow_host,
+              f"critical-path host attribution matches the injected "
+              f"slow_host fault (host {slow_host})"
+              + ("" if skew is None else
+                 f" (attributed to {skew.critical_host})"))
+
+    # the CLI consumer: tools/agd_trace.py must analyze the same
+    # streams and export loadable Chrome trace-event JSON
+    chrome_path = os.path.join(args.workdir, "chrome.json")
+    cli = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "agd_trace.py")]
+        + jsonls + ["--chrome", chrome_path, "--skip-first", "1"],
+        capture_output=True, text=True, timeout=120)
+    chrome_ok = False
+    n_events = 0
+    if cli.returncode == 0 and os.path.exists(chrome_path):
+        try:
+            with open(chrome_path) as f:
+                n_events = len(json.load(f)["traceEvents"])
+            chrome_ok = n_events > 0
+        except (ValueError, KeyError):
+            chrome_ok = False
+    check(chrome_ok,
+          f"tools/agd_trace.py exits 0 and emits loadable Chrome "
+          f"trace JSON (rc={cli.returncode}, {n_events} events)"
+          + ("" if cli.returncode == 0 else
+             f"\n{cli.stderr[-1000:]}"))
+
+    # the analysis rollup itself goes on record as a trace_summary
+    if tree is not None:
+        tel.trace_summary(**tree.summary_fields(),
+                          tool="dist_fault_drill")
+    tel.flush()
+
+    # -- the JSONL evidence, across every host's stream (re-read: the
+    # trace_summary emitted above must validate too) ----------------------
     records = []
     for path in jsonls:
         records.extend(schema.read_jsonl(path))
@@ -382,7 +552,9 @@ def _verdict(failures, args, diff=None) -> int:
     print("DIST FAULT DRILL PASSED: SIGKILLed host detected via "
           "heartbeats, torn generation refused, elastic 1-process "
           "resume reached the 2-process baseline"
-          + (f" (diff {diff:.2e})" if diff is not None else ""))
+          + (f" (diff {diff:.2e})" if diff is not None else "")
+          + "; one connected cross-host span tree, kill truncated, "
+            "straggler attributed, flight dump replayed bit-identical")
     return 0
 
 
@@ -410,6 +582,11 @@ def main(argv=None) -> int:
                    help="which of the two processes dies (default 1; "
                         "0 also works — every generation is already "
                         "committed)")
+    p.add_argument("--slow-s", type=float, default=0.25,
+                   help="scripted slow_host sleep per boundary on the "
+                        "SURVIVING host of the killed phase (default "
+                        "0.25; 0 disables the straggler-attribution "
+                        "checks)")
     p.add_argument("--tol", type=float, default=1e-6,
                    help="|resumed loss - baseline| bound (default 1e-6)")
     p.add_argument("--out", default=None,

@@ -27,7 +27,14 @@ One process, four phases against a logistic model served on CPU:
 
 PASS (exit 0) additionally requires every record in the emitted JSONL
 (serve_request / serve_latency / recovery / run) to validate against
-the canonical ``obs.schema``.  Any miss prints the reason and exits 1.
+the canonical ``obs.schema``; that the soak's traced spans
+(``obs.trace``) assemble into ONE connected causal tree — every
+request span a child of the soak root (explicit cross-thread
+propagation through the queue), every batch span under a request,
+every engine_call under a batch, with BOTH generations visible on
+request spans across the mid-trace hot swap; and that the overload
+leg's automatic flight-recorder dump (``obs.flight``) replays clean
+and bit-identical.  Any miss prints the reason and exits 1.
 
 Usage::
 
@@ -80,7 +87,9 @@ def main(argv=None) -> int:
     import numpy as np
 
     from spark_agd_tpu.models.glm import LogisticRegressionModel
-    from spark_agd_tpu.obs import JSONLSink, Telemetry, schema
+    from spark_agd_tpu.obs import (JSONLSink, Telemetry, flight as
+                                   flight_lib, schema, timeline,
+                                   trace as trace_lib)
     from spark_agd_tpu.obs.perfgate import compare_records
     from spark_agd_tpu.resilience.errors import (TRANSIENT,
                                                  ServeOverloaded,
@@ -102,7 +111,7 @@ def main(argv=None) -> int:
     out_dir = args.out or tempfile.mkdtemp(prefix="serve_drill_")
     os.makedirs(out_dir, exist_ok=True)
     jsonl = os.path.join(out_dir, "serve_drill.jsonl")
-    telemetry = Telemetry([JSONLSink(jsonl)])
+    telemetry = Telemetry([JSONLSink(jsonl)], flight_dir=out_dir)
     rng = np.random.default_rng(args.seed)
     D = args.features
 
@@ -166,25 +175,33 @@ def main(argv=None) -> int:
             registry.publish(models[2])
             registry.refresh(engine)
 
+    # the soak runs under ONE root trace span; client threads do not
+    # inherit the context variable, so each adopts the root context
+    # explicitly (trace.activate) — the cross-thread propagation rule
+    # the queue then carries through its worker
+    soak_span = telemetry.trace_span("serve_soak", tool="serve_drill")
+    root_ctx = soak_span.__enter__()
+
     def client(idx):
         crng = np.random.default_rng(1000 + idx)
-        for i in range(args.requests):
-            n = int(crng.integers(1, args.max_batch + 1))
-            op = "predict_proba" if (i % 3) else "predict"
-            X = crng.normal(size=(n, D)).astype(np.float32)
-            try:
-                res = queue.submit(X, op).result(timeout=60)
-            except Exception:  # noqa: BLE001 — counted, not raised
+        with trace_lib.activate(root_ctx):
+            for i in range(args.requests):
+                n = int(crng.integers(1, args.max_batch + 1))
+                op = "predict_proba" if (i % 3) else "predict"
+                X = crng.normal(size=(n, D)).astype(np.float32)
+                try:
+                    res = queue.submit(X, op).result(timeout=60)
+                except Exception:  # noqa: BLE001 — counted, not raised
+                    with lock:
+                        served["dropped"] += 1
+                    continue
+                want = reference(res.generation, X, op)
+                good = bool(np.allclose(res.value, want, atol=1e-5))
                 with lock:
-                    served["dropped"] += 1
-                continue
-            want = reference(res.generation, X, op)
-            good = bool(np.allclose(res.value, want, atol=1e-5))
-            with lock:
-                served["n"] += 1
-                served["mismatch"] += 0 if good else 1
-                served_generations.add(res.generation)
-            maybe_swap()
+                    served["n"] += 1
+                    served["mismatch"] += 0 if good else 1
+                    served_generations.add(res.generation)
+                maybe_swap()
 
     threads = [threading.Thread(target=client, args=(i,))
                for i in range(args.clients)]
@@ -195,6 +212,7 @@ def main(argv=None) -> int:
     queue.emit_latency()
     summary = queue.latency_summary()
     queue.stop()
+    soak_span.__exit__(None, None, None)
 
     total = args.clients * args.requests
     check(served["n"] == total and served["dropped"] == 0,
@@ -241,6 +259,20 @@ def main(argv=None) -> int:
           f"every admitted request completed after the overload "
           f"({drained}/{len(admitted)})")
 
+    # the overload must have dumped the flight ring (obs.flight), and
+    # the dump must replay bit-identically — the queue's typed shed and
+    # its post-mortem evidence are one mechanism
+    dumps = list(telemetry.flight.dumps)
+    check(len(dumps) >= 1 and os.path.exists(dumps[-1]),
+          f"ServeOverloaded dumped the flight recorder ({dumps})")
+    if dumps:
+        replayed = flight_lib.load_dump(dumps[-1])
+        check(replayed.reason is None and replayed.records
+              and replayed.payloads == telemetry.flight.written,
+              f"flight dump replays clean and bit-identical "
+              f"({len(replayed.records)} records, "
+              f"reason={replayed.reason})")
+
     # -- phase 4: tail latency through the real perf gate ----------------
     key = {"tool": "serve_drill", "name": "logistic_soak",
            "algorithm": "serve"}
@@ -263,6 +295,45 @@ def main(argv=None) -> int:
              " — REGRESSIONS: " + "; ".join(
                  f"{d.metric} {d.candidate} vs budget {d.baseline}"
                  for d in gate.regressions)))
+    # -- the causal tree: request -> batch -> engine under one root ------
+    telemetry.flush()
+    records = schema.read_jsonl(jsonl)
+    tree = timeline.analyze(records, root_ctx.trace_id)
+    check(tree is not None and tree.connected,
+          "the soak's spans form ONE connected causal tree"
+          + ("" if tree is None else
+             f" (spans={tree.spans}, roots={tree.roots})"))
+    soak_spans = timeline.collect_spans(records, root_ctx.trace_id)
+    by_name = {}
+    for s in soak_spans:
+        by_name.setdefault(s.name, []).append(s)
+    req_spans = by_name.get("serve_request", [])
+    batch_spans = by_name.get("serve_batch", [])
+    engine_spans = by_name.get("engine_call", [])
+    check(len(req_spans) == total,
+          f"one request span per soak request "
+          f"({len(req_spans)}/{total}), each parented to the "
+          "submitting client's context")
+    check(all(s.parent_id == root_ctx.span_id for s in req_spans),
+          "every request span is a child of the soak root (explicit "
+          "cross-thread propagation held)")
+    req_ids = {s.span_id for s in req_spans}
+    batch_ids = {s.span_id for s in batch_spans}
+    check(batch_spans
+          and all(s.parent_id in req_ids for s in batch_spans),
+          f"every batch span ({len(batch_spans)}) parents under a "
+          "request span")
+    check(engine_spans
+          and all(s.parent_id in batch_ids for s in engine_spans),
+          f"every engine_call span ({len(engine_spans)}) parents "
+          "under a batch span")
+    span_gens = {s.record.get("generation") for s in req_spans}
+    check(span_gens == {1, 2},
+          f"the hot swap happened MID-TRACE: request spans carry both "
+          f"generations ({sorted(g for g in span_gens if g)})")
+    if tree is not None:
+        telemetry.trace_summary(**tree.summary_fields(),
+                                tool="serve_drill")
     telemetry.close()
 
     # -- every emitted record must be schema-valid -----------------------
