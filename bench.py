@@ -1361,10 +1361,16 @@ def _stamp_schema(rec):
     record (schema_version/kind/run_id/tool added, nothing overwritten —
     the replay path and every existing BENCH_* reader see a superset),
     plus environment provenance (jax/jaxlib versions, backend, device
-    kind/count — what ``tools/perf_gate.py`` refuses cross-environment
-    comparisons on).  Failure-isolated: the one-parseable-line contract
-    survives a broken import, and the provenance block survives a dead
-    backend (it only ever ADDS keys, setdefault semantics)."""
+    kind/count, AND the hardened host half — cpu count, loadavg,
+    governor/turbo, cgroup CPU quota from ``obs.scaling.
+    host_fingerprint`` — what ``tools/perf_gate.py`` /
+    ``tools/agd_bench.py`` refuse cross-environment comparisons on).
+    The host fields need no backend, so even the wedged-tunnel degraded
+    paths stamp the full bench-record environment the BENCH_r01–r05
+    contamination story lacked.  Failure-isolated: the
+    one-parseable-line contract survives a broken import, and the
+    provenance block survives a dead backend (it only ever ADDS keys,
+    setdefault semantics)."""
     try:
         from spark_agd_tpu.obs import schema
 

@@ -31,7 +31,7 @@ import numpy as np
 from spark_agd_tpu import api
 from spark_agd_tpu.core import lbfgs as lbfgs_core
 from spark_agd_tpu.models import mlp as mlp_lib
-from spark_agd_tpu.obs import introspect, schema
+from spark_agd_tpu.obs import introspect, scaling as scaling_lib, schema
 from spark_agd_tpu.ops import losses, prox
 
 from . import datasets
@@ -517,6 +517,141 @@ def run_config(config: BenchConfig, scale: float, iters: int,
     return rec
 
 
+def ladder_rungs(n_devices: int,
+                 max_devices: Optional[int] = None) -> list:
+    """The weak-scaling ladder's mesh sizes: powers of two 1→N (plus N
+    itself when it is not a power of two) — the MLPerf-style sweep
+    shape (arXiv 1909.09756), bounded by the visible device count."""
+    limit = n_devices if max_devices is None \
+        else max(1, min(n_devices, max_devices))
+    rungs, k = [], 1
+    while k <= limit:
+        rungs.append(k)
+        k *= 2
+    if rungs[-1] != limit:
+        rungs.append(limit)
+    return rungs
+
+
+def _ladder_mesh(k: int):
+    """The rung's mesh: a plain ``data``-axis mesh over the first ``k``
+    devices single-process; the hybrid ICI×DCN layout
+    (``parallel.multihost.make_hybrid_mesh``) when the rung spans every
+    device of a multi-process job, so gradient psums ride ICI inside
+    each slice and only the replica reduction crosses DCN."""
+    import jax
+
+    from spark_agd_tpu.parallel import mesh as mesh_lib, multihost
+
+    n_proc = jax.process_count()
+    if n_proc > 1 and k == len(jax.devices()) and k % n_proc == 0:
+        return multihost.make_hybrid_mesh({"data": k // n_proc},
+                                          {"data": n_proc})
+    return mesh_lib.make_mesh({"data": k}, devices=jax.devices()[:k])
+
+
+def run_ladder(config: BenchConfig, *, scale_per_device: float,
+               iters: int, convergence_tol: float = 0.0,
+               max_devices: Optional[int] = None,
+               sentinel: Optional[scaling_lib.ContentionSentinel] = None,
+               telemetry=None, eps: float = 1e-3) -> dict:
+    """One weak-scaling ladder over mesh shapes 1→N for ``config``:
+    per rung the dataset grows proportionally to the device count
+    (fixed per-device work — ideal scaling holds seconds-per-iteration
+    constant), the steady-state fit is timed under the host-contention
+    sentinel, and the compiled program's FLOPs / HBM / collective
+    census rides along from ``obs.introspect``.  Returns ONE stamped
+    ``scaling_curve`` record with per-point efficiency, the fitted
+    serial fraction, the per-point contention verdicts, and the full
+    environment fingerprint + ``env_key`` — the trustworthy answer to
+    "does this scale?" that single-number BENCH rows never were."""
+    import jax
+
+    from spark_agd_tpu.parallel import mesh as mesh_lib
+
+    sentinel = sentinel or scaling_lib.ContentionSentinel()
+    rungs = ladder_rungs(len(jax.devices()), max_devices)
+    points = []
+    rows_per_device = None
+    for k in rungs:
+        mesh = _ladder_mesh(k)
+        t0 = time.perf_counter()
+        X, y = config.make_data(scale_per_device * k)
+        batch = mesh_lib.shard_batch(mesh, X, y)
+        w0 = config.make_w0(X)
+        gen_s = time.perf_counter() - t0
+        n_rows = int(X.shape[0])
+        if rows_per_device is None:
+            rows_per_device = n_rows
+        log(f"[{config.name}] ladder rung devices={k} rows={n_rows} "
+            f"data prepared in {gen_s:.1f}s")
+        fit = api.make_runner(batch, config.gradient(),
+                              config.updater(), mesh=mesh,
+                              convergence_tol=convergence_tol,
+                              num_iterations=iters,
+                              reg_param=config.reg_param)
+        t0 = time.perf_counter()
+        res = fit(w0)
+        jax.block_until_ready(res.weights)
+        compile_s = time.perf_counter() - t0
+        with sentinel.watch() as watch:
+            t0 = time.perf_counter()
+            res = fit(w0)
+            jax.block_until_ready(res.weights)
+            run_s = time.perf_counter() - t0
+        cost = introspect.analyze_runner(fit, w0, label=config.name)
+        n_iters = int(res.num_iters)
+        hist = np.asarray(res.loss_history)[:n_iters]
+        converged = bool(res.converged)
+        point = {
+            "devices": k,
+            "mesh_shape": {str(a): int(s)
+                           for a, s in dict(mesh.shape).items()},
+            "rows": n_rows,
+            "iters": n_iters,
+            "wall_s": round(run_s, 6),
+            "sec_per_iter": round(run_s / max(1, n_iters), 6),
+            "iters_per_sec": round(n_iters / run_s, 2),
+            "compile_s": round(max(0.0, compile_s - run_s), 2),
+            "final_loss": round(float(hist[-1]), 6),
+            "converged": converged,
+            "flops": cost.flops,
+            "bytes_accessed": cost.bytes_accessed,
+            "peak_hbm_bytes": cost.peak_hbm_bytes,
+            "collectives": cost.collectives,
+            "contention": watch.report,
+        }
+        # a tolerance claim only when the rung stopped under its own
+        # rule — the same honest-convergence split as run_config
+        if convergence_tol > 0 and converged:
+            point["iters_to_tol"] = n_iters
+        w2e = wall_to_eps(hist, run_s / max(1, n_iters), eps)
+        if converged and w2e is not None:
+            point["wall_to_eps_s"] = round(w2e, 4)
+        points.append(point)
+
+    extra = scaling_lib.curve_fields(points)
+    pts = extra.pop("points")
+    env = introspect.environment_fingerprint()
+    extra.update(env)
+    extra.update(
+        algorithm="agd",
+        rows_per_device=int(rows_per_device or 0),
+        iters=iters,
+        ladder=",".join(str(k) for k in rungs),
+        spin_baseline_s=round(float(sentinel.probe.baseline_s), 6),
+        env_key=scaling_lib.environment_key(env),
+    )
+    if telemetry is not None:
+        rec = telemetry.scaling_curve(name=config.name, points=pts,
+                                      **extra)
+    else:
+        rec = schema.scaling_curve_record(schema.new_run_id(),
+                                          config.name, pts, **extra)
+    return schema.stamp(rec, tool="benchmarks.run",
+                        kind="scaling_curve")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", type=int, default=0,
@@ -570,6 +705,22 @@ def main(argv=None):
     p.add_argument("--out", type=str, default=None,
                    help="also append each record to this file as a JSON "
                         "line (e.g. BENCH_CONFIGS_r02.json)")
+    p.add_argument("--ladder", action="store_true",
+                   help="run the weak-scaling ladder instead of the "
+                        "single-mesh passes: sweep mesh shapes 1->N "
+                        "devices with the dataset growing per rung "
+                        "(fixed per-device work), emit ONE "
+                        "scaling_curve record per config with "
+                        "efficiency / serial-fraction / contention "
+                        "fields (obs.scaling; gate with "
+                        "tools/agd_bench.py)")
+    p.add_argument("--scale-per-device", type=float, default=None,
+                   help="ladder: per-device row-count scale (the rung "
+                        "at k devices generates scale*k); default "
+                        "--scale, else 0.002")
+    p.add_argument("--ladder-devices", type=int, default=None,
+                   help="ladder: cap the largest rung (default: every "
+                        "visible device)")
     args = p.parse_args(argv)
 
     import jax
@@ -584,6 +735,9 @@ def main(argv=None):
     if bad:
         p.error(f"unknown dtype(s) {bad}; choose from f32, bf16")
     out_f = open(args.out, "a") if args.out else None
+    # one sentinel (one spin-probe calibration, before any timed work)
+    # shared by every config's ladder
+    sentinel = scaling_lib.ContentionSentinel() if args.ladder else None
     failures = 0
     for cfg in selected:
         scale = args.scale if args.scale is not None else (
@@ -606,6 +760,26 @@ def main(argv=None):
                 out_f.write(json.dumps(rec) + "\n")
                 out_f.flush()
 
+        if args.ladder:
+            spd = args.scale_per_device
+            if spd is None:
+                spd = args.scale if args.scale is not None else 0.002
+            try:
+                rec = run_ladder(
+                    cfg, scale_per_device=spd, iters=args.iters,
+                    convergence_tol=args.tol,
+                    max_devices=args.ladder_devices,
+                    sentinel=sentinel)
+            except Exception as e:  # noqa: BLE001 — one config's dead
+                # ladder must not take down the others
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                rec = {"config": cfg.idx, "name": cfg.name,
+                       "error": f"ladder: {type(e).__name__}: {e}"[:500]}
+                failures += 1
+            emit(rec)
+            continue
         varied = args.provenance and cfg.varied_nnz_ok
         try:
             data = (cfg.make_data(scale, varied_nnz=True) if varied

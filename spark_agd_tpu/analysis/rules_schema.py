@@ -65,6 +65,8 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     "trace_span": (("name",), frozenset()),
     "trace_point": (("name",), frozenset({"seconds"})),
     "trace_summary": ((), frozenset({"trace_id", "spans"})),
+    # one weak-scaling ladder (obs.scaling / benchmarks.run.run_ladder)
+    "scaling_curve": ((), frozenset({"name", "points"})),
 }
 
 

@@ -48,6 +48,7 @@ from . import (  # noqa: F401
     flight,
     introspect,
     perfgate,
+    scaling,
     schema,
     timeline,
     trace,
@@ -62,6 +63,18 @@ from .introspect import (  # noqa: F401
     collective_census,
     count_ops,
     environment_fingerprint,
+)
+from .scaling import (  # noqa: F401
+    ContentionPolicy,
+    ContentionSentinel,
+    CurvePolicy,
+    CurveVerdict,
+    SpinProbe,
+    check_curve,
+    environment_key,
+    fit_serial_fraction,
+    host_fingerprint,
+    weak_scaling_efficiency,
 )
 from .schema import (  # noqa: F401
     SCHEMA_VERSION,

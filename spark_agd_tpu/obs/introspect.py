@@ -231,15 +231,23 @@ def _backend_initialized() -> bool:
 def environment_fingerprint(mesh=None, *,
                             only_if_initialized: bool = False) -> dict:
     """The run-record environment-provenance fields: jax/jaxlib
-    versions, backend, device kind/count, process count, and (given a
+    versions, backend, device kind/count, process count, (given a
     ``Mesh``) the mesh shape — what ``obs.perfgate`` refuses to compare
-    across.
+    across — plus the hardened host half (``obs.scaling.
+    host_fingerprint``): cpu count, 1-minute loadavg, cpufreq governor
+    and turbo state, and the container-cgroup CPU quota.  The host
+    fields need no backend, so ``bench.py``'s degraded paths stamp them
+    too; the BENCH_r01–r05 contamination story is exactly the drift
+    these fields make visible.
 
     Touches the backend (``jax.devices()``) — unless
     ``only_if_initialized=True`` and no backend exists yet, in which
-    case only the version fields are returned (the bench watchdog's
-    error path must never block on instantiating a wedged backend)."""
+    case only the version + host fields are returned (the bench
+    watchdog's error path must never block on instantiating a wedged
+    backend)."""
     import jax
+
+    from . import scaling as _scaling
 
     try:
         import jaxlib
@@ -251,6 +259,7 @@ def environment_fingerprint(mesh=None, *,
         "jax_version": jax.__version__,
         "jaxlib_version": jaxlib_version,
     }
+    out.update(_scaling.host_fingerprint())
     if only_if_initialized and not _backend_initialized():
         return out
     devs = jax.devices()

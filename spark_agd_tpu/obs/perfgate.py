@@ -22,9 +22,19 @@ costs).  Relative thresholds are configurable per metric; collective
 counts gate on an *absolute* allowed increase (default 0 — a new
 collective in the hot program is never noise).  Environments must
 match: a gate between records whose provenance fields (platform,
-device kind/count, jax/jaxlib version, mesh shape) differ is refused
-unless explicitly allowed — cross-environment "regressions" are
-hardware deltas, not code deltas.
+device kind/count, jax/jaxlib version, mesh shape, and the hardened
+host identity — cpu count / governor / turbo / cgroup quota) differ is
+refused unless explicitly allowed — cross-environment "regressions"
+are hardware deltas, not code deltas.
+
+**Curve-shape gating** (:func:`gate_scaling`): ``scaling_curve``
+records (the ``benchmarks/run.py --ladder`` weak-scaling ladder) gate
+on the SHAPE of the efficiency curve — per-point efficiency floor,
+monotonicity, fitted serial-fraction ceiling, per-point deltas vs a
+paired baseline curve — and REFUSE (exit 2, with a typed
+``scaling_gate`` record) contention-contaminated or cross-environment
+comparisons, per the BENCH_r01–r05 post-mortem: a poisoned comparison
+is worse than none.
 
 Deliberately dependency-free (stdlib only), like ``obs.schema``: the
 CI entry point ``tools/perf_gate.py`` must run anywhere the artifacts
@@ -37,6 +47,7 @@ import dataclasses
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import scaling as scaling_lib
 from . import schema
 
 # metric -> (direction, default relative threshold).  direction "lower"
@@ -74,9 +85,29 @@ COLLECTIVES_METRIC = "collectives"
 DEFAULT_COLLECTIVE_SLACK = 0.0
 
 # run-record fields that define the measurement environment; a
-# mismatch on any present-on-both-sides field refuses the comparison
+# mismatch on any present-on-both-sides field refuses the comparison.
+# The host-identity tail (cpu count / governor / turbo / cgroup quota,
+# from obs.scaling.host_fingerprint) is the BENCH_r01–r05 lesson:
+# environment drift nobody stamped is indistinguishable from a code
+# regression.
 ENV_FIELDS = ("platform", "device_kind", "n_devices", "jax_version",
-              "jaxlib_version", "n_processes", "mesh_shape")
+              "jaxlib_version", "n_processes", "mesh_shape",
+              "cpu_count", "cpu_governor", "cpu_turbo",
+              "cgroup_cpu_quota")
+
+# scaling-curve env identity: a curve spans mesh shapes, so mesh_shape
+# is a per-point fact, not curve identity
+CURVE_ENV_FIELDS = tuple(f for f in ENV_FIELDS if f != "mesh_shape")
+
+# curve-vs-baseline per-point metrics: sec_per_iter is the weak-scaling
+# quantity itself; efficiency the normalized shape
+CURVE_POINT_METRICS: Dict[str, Tuple[str, float]] = {
+    "sec_per_iter": ("lower", 0.15),
+    "efficiency": ("higher", 0.10),
+}
+# curve-level: the fitted serial fraction gates on ABSOLUTE increase
+# (relative change near s=0 is meaningless noise)
+SERIAL_FRACTION_SLACK = 0.05
 
 _RUN_KEY_FIELDS = ("tool", "name", "config", "algorithm", "dtype",
                    "pallas")
@@ -314,6 +345,213 @@ def gate_files(baseline_path: str, candidate_path: str,
     JSONLs."""
     return compare_records(load_records(baseline_path),
                            load_records(candidate_path), **kwargs)
+
+
+_CURVE_KEY_FIELDS = ("tool", "name", "algorithm")
+
+
+def split_curves(records: List[dict]) -> Dict[str, dict]:
+    """The ``scaling_curve`` records of a record list, keyed by
+    identity; multiple records per key keep the LAST (the freshest
+    ladder in an append-style history)."""
+    out: Dict[str, dict] = {}
+    for rec in records:
+        if isinstance(rec, dict) and rec.get("kind") == "scaling_curve":
+            out[_key(rec, _CURVE_KEY_FIELDS)] = rec
+    return out
+
+
+@dataclasses.dataclass
+class ScalingGateResult:
+    """The curve-shape gate's outcome: ``verdicts`` one per candidate
+    curve (shape violations = exit 1), ``refusals`` typed reasons the
+    gate would not compare at all (contaminated points, cross-
+    environment baselines, quarantined records = exit 2), ``deltas``
+    the per-point baseline comparison when a baseline was given."""
+
+    verdicts: List[Tuple[str, scaling_lib.CurveVerdict]]
+    refusals: List[str]
+    deltas: List[Delta]
+    unmatched: List[str]
+    allow_cross_env: bool = False
+
+    @property
+    def shape_failures(self) -> List[str]:
+        return [f for _, v in self.verdicts for f in v.failures]
+
+    @property
+    def regressions(self) -> List[Delta]:
+        return [d for d in self.deltas if d.status == "regression"]
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.refusals) and not self.allow_cross_env
+
+    @property
+    def ok(self) -> bool:
+        return not (self.refused or self.shape_failures
+                    or self.regressions)
+
+    def exit_code(self) -> int:
+        """0 pass, 1 shape/regression failure, 2 refused."""
+        if self.refused:
+            return 2
+        return 0 if not (self.shape_failures or self.regressions) else 1
+
+    def status(self) -> str:
+        return ("refused" if self.refused
+                else "fail" if self.shape_failures or self.regressions
+                else "pass")
+
+    def record(self, run_id: Optional[str] = None,
+               tool: str = "agd_bench") -> dict:
+        """The gate's outcome as one TYPED, schema-stamped run record —
+        what ``tools/agd_bench.py`` emits instead of a bare exit code,
+        so a refusal is machine-readable evidence, not silence."""
+        return schema.stamp({
+            "name": "scaling_gate",
+            "gate_status": self.status(),
+            "curves": len(self.verdicts),
+            "refusals": list(self.refusals),
+            "shape_failures": self.shape_failures,
+            "regressions": len(self.regressions),
+        }, tool=tool, kind="run", run_id=run_id)
+
+
+def _curve_refusals(key: str, rec: dict,
+                    policy: scaling_lib.CurvePolicy,
+                    verdict: scaling_lib.CurveVerdict,
+                    side: str) -> List[str]:
+    out = []
+    if policy.contention.refuse_contended:
+        out.extend(f"[{side}] {msg}" for msg in verdict.contended)
+    gaps = scaling_lib.provenance_gaps(rec)
+    if gaps:
+        out.append(f"[{side}] {key}: quarantined — " + "; ".join(gaps))
+    return out
+
+
+def gate_scaling(
+    candidate: List[dict],
+    baseline: Optional[List[dict]] = None,
+    *,
+    policy: Optional[scaling_lib.CurvePolicy] = None,
+    thresholds: Optional[Dict[str, float]] = None,
+    allow_cross_env: bool = False,
+) -> ScalingGateResult:
+    """Gate ``scaling_curve`` records on CURVE SHAPE (efficiency floor
+    per point, monotonicity, fitted serial-fraction ceiling) and — when
+    ``baseline`` records are given — per-point deltas against the
+    paired baseline curve.
+
+    Refuses (exit 2) instead of comparing garbage: candidate or
+    baseline curves with contention-contaminated points (under the
+    policy's ``refuse_contended``), provenance-quarantined records
+    (``obs.scaling.provenance_gaps``), and baseline pairs whose
+    :data:`CURVE_ENV_FIELDS` disagree.  ``allow_cross_env`` downgrades
+    every refusal to a note, mirroring the run-record gate."""
+    policy = policy or scaling_lib.CurvePolicy()
+    thresholds = dict(thresholds or {})
+    c_curves = split_curves(candidate)
+    b_curves = split_curves(baseline or [])
+
+    verdicts: List[Tuple[str, scaling_lib.CurveVerdict]] = []
+    refusals: List[str] = []
+    deltas: List[Delta] = []
+
+    if not c_curves:
+        refusals.append("no scaling_curve records in the candidate — "
+                        "nothing to gate")
+    for key in sorted(c_curves):
+        rec = c_curves[key]
+        verdict = scaling_lib.check_curve(rec, policy)
+        verdicts.append((key, verdict))
+        refusals.extend(_curve_refusals(key, rec, policy, verdict,
+                                        "candidate"))
+
+    for key in sorted(set(b_curves) & set(c_curves)):
+        b, c = b_curves[key], c_curves[key]
+        b_verdict = scaling_lib.check_curve(b, policy)
+        refusals.extend(_curve_refusals(key, b, policy, b_verdict,
+                                        "baseline"))
+        for f in CURVE_ENV_FIELDS:
+            bv, cv = b.get(f), c.get(f)
+            if bv is not None and cv is not None and bv != cv:
+                refusals.append(
+                    f"{key}: cross-environment comparison — {f} "
+                    f"differs (baseline {bv!r} vs candidate {cv!r})")
+        b_pts = {int(p.get("devices", 0)): p
+                 for p in scaling_lib.sorted_points(b.get("points") or [])}
+        c_sorted = scaling_lib.sorted_points(c.get("points") or [])
+        c_eff = dict(zip((int(p.get("devices", 0)) for p in c_sorted),
+                         scaling_lib.weak_scaling_efficiency(c_sorted)))
+        b_sorted = scaling_lib.sorted_points(b.get("points") or [])
+        b_eff = dict(zip((int(p.get("devices", 0)) for p in b_sorted),
+                         scaling_lib.weak_scaling_efficiency(b_sorted)))
+        for cp in c_sorted:
+            k = int(cp.get("devices", 0))
+            bp = b_pts.get(k)
+            if bp is None:
+                continue
+            pkey = f"{key} devices={k}"
+            for metric, (direction,
+                         default_thr) in CURVE_POINT_METRICS.items():
+                thr = thresholds.get(metric, default_thr)
+                if metric == "efficiency":
+                    bv, cv = b_eff.get(k), c_eff.get(k)
+                else:
+                    bv = scaling_lib.point_time(bp)
+                    cv = scaling_lib.point_time(cp)
+                _compare_metric(pkey, metric, direction, bv, cv, thr,
+                                deltas)
+        bs = scaling_lib.fit_serial_fraction(b_sorted)
+        cs = scaling_lib.fit_serial_fraction(c_sorted)
+        slack = thresholds.get("serial_fraction", SERIAL_FRACTION_SLACK)
+        if bs is not None and cs is not None:
+            worse = cs - bs
+            status = ("regression" if worse > slack
+                      else "improved" if worse < -slack else "ok")
+            deltas.append(Delta(key, "serial_fraction", bs, cs, worse,
+                                slack, status))
+
+    unmatched = sorted(set(b_curves) - set(c_curves)) if b_curves else []
+    return ScalingGateResult(verdicts=verdicts, refusals=refusals,
+                             deltas=deltas, unmatched=unmatched,
+                             allow_cross_env=allow_cross_env)
+
+
+def format_scaling_report(result: ScalingGateResult) -> str:
+    """Human-readable curve-shape gate report (the failure output of
+    ``tools/agd_bench.py gate``)."""
+    lines: List[str] = []
+    if result.refusals:
+        head = ("SCALING GATE REFUSED" if result.refused
+                else "refusals waived by --allow-cross-env")
+        lines.append(head + ":")
+        lines.extend("  " + r for r in result.refusals)
+        lines.append("")
+    for key, v in result.verdicts:
+        eff = ", ".join("-" if e is None else f"{e:.3f}"
+                        for e in v.efficiency)
+        sf = ("-" if v.serial_fraction is None
+              else f"{v.serial_fraction:.3f}")
+        lines.append(f"{key}: efficiency [{eff}] serial_fraction {sf} "
+                     + ("OK" if not v.failures else
+                        f"{len(v.failures)} shape failure(s)"))
+        lines.extend("  " + f for f in v.failures)
+    if result.deltas:
+        lines.append("")
+        lines.append(format_deltas(result.deltas, only_compared=True))
+    if result.unmatched:
+        lines.append(f"note: {len(result.unmatched)} baseline-only "
+                     "curve(s) not compared: "
+                     + "; ".join(result.unmatched[:4]))
+    if not result.refused:
+        lines.append("SCALING GATE: "
+                     + ("pass" if result.exit_code() == 0 else
+                        f"FAIL ({len(result.shape_failures)} shape, "
+                        f"{len(result.regressions)} regression(s))"))
+    return "\n".join(lines)
 
 
 def format_report(result: GateResult, *, verbose: bool = False) -> str:

@@ -1,0 +1,598 @@
+"""The scaling observatory: weak-scaling curves you can trust.
+
+The north-star question — "does this scale?" — has no answer in a
+single number.  Per the MLPerf-on-TPU-pods lesson (PAPERS.md,
+arXiv 1909.09756), a scaling claim is an *efficiency curve over mesh
+shapes*; and per the Spark-ML profiling study (arXiv 1612.01437),
+unobserved host interference is the dominant confounder — the
+BENCH_r01–r05 trajectory was poisoned exactly this way (host
+contention and environment drift nobody measured).  This module is the
+stdlib-only analysis half of the answer; the ladder that *produces*
+curves lives in ``benchmarks/run.py`` (and ``tools/agd_bench.py``
+drives both from the command line):
+
+- **host fingerprint** (:func:`host_fingerprint`): cpu count, loadavg,
+  cpufreq governor / turbo state, container-cgroup CPU quota — the
+  environment facts ``obs.introspect.environment_fingerprint`` now
+  stamps onto every record, readable with no jax backend;
+- **contention sentinel** (:class:`ContentionSentinel`): loadavg /
+  hypervisor-steal / RSS sampled before, during, and after each ladder
+  point, plus a calibrated :class:`SpinProbe` whose interference score
+  measures *this process's* actual slowdown — every point carries its
+  own contamination verdict;
+- **curve math**: weak-scaling efficiency per point
+  (:func:`weak_scaling_efficiency`) and a fitted serial fraction
+  (:func:`fit_serial_fraction`, the Gustafson-form least-squares fit);
+- **curve-shape verdicts** (:class:`CurvePolicy` /
+  :func:`check_curve`): efficiency floor per point, monotonicity, and
+  a serial-fraction ceiling — what ``obs.perfgate.gate_scaling`` gates
+  on instead of single numbers;
+- **provenance keys** (:func:`environment_key`): the stable hash
+  ``tools/agd_bench.py`` keys its history JSONL on, so two records can
+  only ever be compared when they were measured on the same
+  environment.
+
+Stdlib-only by contract (like ``obs.schema`` / ``obs.perfgate``): the
+gate and the validator must run anywhere the artifacts exist, with or
+without a working jax install.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# host facts (all best-effort: a field is absent/None where the kernel
+# surface is unreadable, never a raised error)
+# ---------------------------------------------------------------------------
+
+_GOVERNOR_PATH = "/sys/devices/system/cpu/cpu0/cpufreq/scaling_governor"
+_NO_TURBO_PATH = "/sys/devices/system/cpu/intel_pstate/no_turbo"
+_BOOST_PATH = "/sys/devices/system/cpu/cpufreq/boost"
+_CGROUP_V2_PATH = "/sys/fs/cgroup/cpu.max"
+_CGROUP_V1_QUOTA = "/sys/fs/cgroup/cpu/cpu.cfs_quota_us"
+_CGROUP_V1_PERIOD = "/sys/fs/cgroup/cpu/cpu.cfs_period_us"
+
+
+def _read_text(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def read_cpu_governor() -> Optional[str]:
+    """The cpufreq governor ("performance"/"powersave"/…), or None where
+    cpufreq is not exposed (most containers)."""
+    return _read_text(_GOVERNOR_PATH)
+
+
+def read_turbo_state() -> Optional[str]:
+    """"on"/"off" for the boost-clock state, None where unreadable.
+    Turbo drift between two measurements makes their wall clocks
+    incomparable, which is why this rides the environment key."""
+    no_turbo = _read_text(_NO_TURBO_PATH)
+    if no_turbo is not None:
+        return "off" if no_turbo == "1" else "on"
+    boost = _read_text(_BOOST_PATH)
+    if boost is not None:
+        return "on" if boost == "1" else "off"
+    return None
+
+
+def read_cgroup_cpu_quota() -> Optional[object]:
+    """Container CPU quota in CPUs (float), the string "unlimited", or
+    None where no cgroup controller is readable.  A 4-CPU-quota
+    container and a bare 64-core host must never be compared."""
+    v2 = _read_text(_CGROUP_V2_PATH)
+    if v2 is not None:
+        parts = v2.split()
+        if parts and parts[0] == "max":
+            return "unlimited"
+        if len(parts) == 2:
+            try:
+                return round(float(parts[0]) / float(parts[1]), 3)
+            except (ValueError, ZeroDivisionError):
+                return None
+    quota = _read_text(_CGROUP_V1_QUOTA)
+    period = _read_text(_CGROUP_V1_PERIOD)
+    if quota is not None and period is not None:
+        try:
+            q, p = float(quota), float(period)
+        except ValueError:
+            return None
+        if q < 0:
+            return "unlimited"
+        if p > 0:
+            return round(q / p, 3)
+    return None
+
+
+def read_steal_ticks() -> Optional[int]:
+    """Cumulative hypervisor-steal ticks from ``/proc/stat`` (field 8 of
+    the aggregate cpu line) — a nonzero delta across a timed region
+    means the VM itself was descheduled while we measured."""
+    stat = _read_text("/proc/stat")
+    if not stat:
+        return None
+    first = stat.splitlines()[0].split()
+    if first[:1] != ["cpu"] or len(first) < 9:
+        return None
+    try:
+        return int(first[8])
+    except ValueError:
+        return None
+
+
+def read_rss_kb() -> Optional[int]:
+    """This process's resident set (kB) from ``/proc/self/status``."""
+    status = _read_text("/proc/self/status")
+    if not status:
+        return None
+    for line in status.splitlines():
+        if line.startswith("VmRSS:"):
+            parts = line.split()
+            if len(parts) >= 2:
+                try:
+                    return int(parts[1])
+                except ValueError:
+                    return None
+    return None
+
+
+def read_loadavg() -> Optional[float]:
+    """1-minute loadavg, None on platforms without it."""
+    try:
+        return round(os.getloadavg()[0], 3)
+    except (OSError, AttributeError):
+        return None
+
+
+def host_fingerprint() -> dict:
+    """The host half of ``environment_fingerprint()``: readable with no
+    jax backend (so ``bench.py``'s wedged-tunnel error path stamps it
+    too).  Absent-where-unreadable; ``loadavg_1m`` is measurement-time
+    state (a contention signal), the rest are environment identity —
+    only the identity fields enter :func:`environment_key`."""
+    out: dict = {"cpu_count": os.cpu_count()}
+    load = read_loadavg()
+    if load is not None:
+        out["loadavg_1m"] = load
+    gov = read_cpu_governor()
+    if gov is not None:
+        out["cpu_governor"] = gov
+    turbo = read_turbo_state()
+    if turbo is not None:
+        out["cpu_turbo"] = turbo
+    quota = read_cgroup_cpu_quota()
+    if quota is not None:
+        out["cgroup_cpu_quota"] = quota
+    return out
+
+
+# the environment-identity fields a history key is derived from: stable
+# per machine+container+toolchain, excluding measurement-time state
+# (loadavg, steal — those are the sentinel's job, not identity)
+ENV_KEY_FIELDS = ("platform", "device_kind", "n_devices", "n_processes",
+                  "jax_version", "jaxlib_version", "cpu_count",
+                  "cpu_governor", "cpu_turbo", "cgroup_cpu_quota")
+
+
+def environment_key(fields: dict) -> str:
+    """Stable provenance key over the identity subset of an environment
+    fingerprint — what ``tools/agd_bench.py`` keys its history JSONL on.
+    Records with different keys are never silently compared."""
+    ident = {f: fields[f] for f in ENV_KEY_FIELDS if f in fields}
+    digest = hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()).hexdigest()
+    return f"env-{digest[:12]}"
+
+
+# ---------------------------------------------------------------------------
+# the contention sentinel
+# ---------------------------------------------------------------------------
+
+
+class SpinProbe:
+    """A calibrated fixed-work spin loop: the direct measurement of
+    "how much slower does CPU work run right now vs the quiet
+    baseline".  loadavg and steal see *other* processes; the probe sees
+    what actually happens to THIS process's timeslices — the quantity a
+    benchmark number is poisoned by.
+
+    ``calibrate()`` takes the min over repeats as the quiet baseline
+    (min is robust to one-off blips; sustained interference inflates
+    every repeat, including the min).  ``score()`` is the fractional
+    slowdown of a fresh min-of-repeats measurement, clamped at 0."""
+
+    def __init__(self, work: int = 200_000):
+        self.work = int(work)
+        self.baseline_s: Optional[float] = None
+
+    def _spin(self) -> float:
+        # deterministic integer xorshift — no allocation, no FP, the
+        # same instruction stream every call
+        x, n = 0x9E3779B97F4A7C15, self.work
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x ^= (x << 13) & 0xFFFFFFFFFFFFFFFF
+            x ^= x >> 7
+            x ^= (x << 17) & 0xFFFFFFFFFFFFFFFF
+        dt = time.perf_counter() - t0
+        # keep the accumulator observable so the loop cannot be elided
+        self._last = x
+        return dt
+
+    def calibrate(self, repeats: int = 5) -> float:
+        self.baseline_s = min(self._spin() for _ in range(max(1, repeats)))
+        return self.baseline_s
+
+    def score(self, repeats: int = 3) -> float:
+        if self.baseline_s is None:
+            self.calibrate()
+        best = min(self._spin() for _ in range(max(1, repeats)))
+        return max(0.0, best / self.baseline_s - 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class ContentionPolicy:
+    """When is a ladder point contaminated?  Thresholds are generous by
+    default — the sentinel must flag a genuinely-busy host, not fail a
+    CI box for breathing."""
+
+    max_spin_score: float = 0.75   # probe ran >1.75x its calibrated time
+    max_steal_ticks: int = 50      # hypervisor descheduled us mid-point
+    max_loadavg_jump: float = 4.0  # 1-min load rose by more than this
+    # curve-level: refuse gating/comparison outright when any point is
+    # flagged (set False to gate shape anyway, e.g. in noisy CI)
+    refuse_contended: bool = True
+
+
+def flag_contention(report: dict,
+                    policy: Optional[ContentionPolicy] = None
+                    ) -> Tuple[bool, List[str]]:
+    """Apply a :class:`ContentionPolicy` to one sentinel report dict.
+    Returns ``(flagged, reasons)``; unreadable fields never flag."""
+    policy = policy or ContentionPolicy()
+    reasons: List[str] = []
+    spin = report.get("spin_score")
+    if isinstance(spin, (int, float)) and spin > policy.max_spin_score:
+        reasons.append(f"spin-probe interference score {spin:.2f} > "
+                       f"{policy.max_spin_score:g}")
+    steal = report.get("steal_ticks")
+    if isinstance(steal, int) and steal > policy.max_steal_ticks:
+        reasons.append(f"hypervisor steal {steal} ticks > "
+                       f"{policy.max_steal_ticks}")
+    before = report.get("loadavg_before")
+    during = report.get("loadavg_during_max")
+    if isinstance(before, (int, float)) and isinstance(during,
+                                                       (int, float)):
+        jump = during - before
+        if jump > policy.max_loadavg_jump:
+            reasons.append(f"loadavg jumped +{jump:.2f} > "
+                           f"{policy.max_loadavg_jump:g} mid-point")
+    return bool(reasons), reasons
+
+
+class _Watch:
+    """One watched ladder point: snapshots host state on entry and
+    exit, samples loadavg/RSS from a background thread while the timed
+    region runs, and spin-probes on both sides of it (never inside —
+    the probe must not perturb the measurement it guards)."""
+
+    def __init__(self, sentinel: "ContentionSentinel"):
+        self._s = sentinel
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._during_load: List[float] = []
+        self._during_rss: List[int] = []
+        self.report: Optional[dict] = None
+
+    def _sample_loop(self):
+        while not self._stop.wait(self._s.sample_interval_s):
+            load = read_loadavg()
+            if load is not None:
+                self._during_load.append(load)
+            rss = read_rss_kb()
+            if rss is not None:
+                self._during_rss.append(rss)
+
+    def __enter__(self):
+        self._spin_before = self._s.probe.score()
+        self._load_before = read_loadavg()
+        self._steal_before = read_steal_ticks()
+        self._rss_before = read_rss_kb()
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._sample_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        seconds = time.perf_counter() - self._t0
+        load_after = read_loadavg()
+        steal_after = read_steal_ticks()
+        rss_after = read_rss_kb()
+        spin_after = self._s.probe.score()
+        rss_all = [v for v in (self._rss_before, rss_after) if v is not None]
+        rss_all.extend(self._during_rss)
+        load_during = list(self._during_load)
+        if load_after is not None:
+            load_during.append(load_after)
+        report = {
+            "seconds": round(seconds, 4),
+            "loadavg_before": self._load_before,
+            "loadavg_during_max": (round(max(load_during), 3)
+                                   if load_during else None),
+            "loadavg_after": load_after,
+            "steal_ticks": (steal_after - self._steal_before
+                            if None not in (steal_after,
+                                            self._steal_before) else None),
+            "rss_peak_kb": max(rss_all) if rss_all else None,
+            "spin_score_before": round(self._spin_before, 4),
+            "spin_score_after": round(spin_after, 4),
+            "spin_score": round(max(self._spin_before, spin_after), 4),
+        }
+        flagged, reasons = flag_contention(report, self._s.policy)
+        report["flagged"] = flagged
+        if reasons:
+            report["reasons"] = reasons
+        self.report = report
+        return False
+
+
+class ContentionSentinel:
+    """The host-contention sentinel one ladder shares across its
+    points: calibrates the spin probe ONCE up front (before any timed
+    work), then wraps each point in a :meth:`watch` whose report lands
+    inside the point's record — so every number carries the evidence
+    for (or against) its own trustworthiness."""
+
+    def __init__(self, probe: Optional[SpinProbe] = None,
+                 policy: Optional[ContentionPolicy] = None,
+                 sample_interval_s: float = 0.2):
+        self.probe = probe or SpinProbe()
+        self.policy = policy or ContentionPolicy()
+        self.sample_interval_s = float(sample_interval_s)
+        if self.probe.baseline_s is None:
+            self.probe.calibrate()
+
+    def watch(self) -> _Watch:
+        return _Watch(self)
+
+
+# ---------------------------------------------------------------------------
+# curve math
+# ---------------------------------------------------------------------------
+
+
+def point_time(point: dict) -> Optional[float]:
+    """One point's steady-state seconds-per-iteration — the weak-scaling
+    quantity (fixed per-device work: ideal scaling holds it constant as
+    devices grow).  Falls back to wall/iters when ``sec_per_iter`` is
+    absent; None when nothing usable is present."""
+    spi = point.get("sec_per_iter")
+    if isinstance(spi, (int, float)) and not isinstance(spi, bool) \
+            and spi > 0:
+        return float(spi)
+    wall, iters = point.get("wall_s"), point.get("iters")
+    if isinstance(wall, (int, float)) and not isinstance(wall, bool) \
+            and isinstance(iters, int) and iters > 0 and wall > 0:
+        return float(wall) / iters
+    return None
+
+
+def sorted_points(points: Sequence[dict]) -> List[dict]:
+    """Points in ladder order (ascending device count)."""
+    return sorted(points, key=lambda p: int(p.get("devices", 0)))
+
+
+def weak_scaling_efficiency(points: Sequence[dict]
+                            ) -> List[Optional[float]]:
+    """Per-point weak-scaling efficiency ``t_1 / t_k`` (1.0 at the
+    1-device reference by construction, lower as overhead grows).
+    ``None`` where a point has no usable time."""
+    pts = sorted_points(points)
+    if not pts:
+        return []
+    t1 = point_time(pts[0])
+    out: List[Optional[float]] = []
+    for p in pts:
+        tk = point_time(p)
+        out.append(None if t1 is None or tk is None
+                   else round(t1 / tk, 4))
+    return out
+
+
+def fit_serial_fraction(points: Sequence[dict]) -> Optional[float]:
+    """Least-squares serial fraction ``s`` of the Gustafson weak-scaling
+    model ``t_k = t_1 * ((1 - s) + s*k)``: the non-parallelizable share
+    of the per-point work, fitted over every point with a usable time.
+    0 is a perfectly scalable workload; the curve-shape gate puts a
+    ceiling on it.  Closed form: with ``r_k = t_k/t_1``,
+    ``s = Σ (k-1)(r_k - 1) / Σ (k-1)^2``, clamped to [0, 1].  None with
+    fewer than two usable points."""
+    pts = sorted_points(points)
+    if not pts:
+        return None
+    t1 = point_time(pts[0])
+    if t1 is None:
+        return None
+    num = den = 0.0
+    usable = 0
+    for p in pts:
+        k = int(p.get("devices", 0))
+        tk = point_time(p)
+        if tk is None or k < 1:
+            continue
+        usable += 1
+        num += (k - 1) * (tk / t1 - 1.0)
+        den += (k - 1) ** 2
+    if usable < 2 or den == 0:
+        return None
+    return round(min(1.0, max(0.0, num / den)), 4)
+
+
+def curve_fields(points: Sequence[dict]) -> dict:
+    """The derived curve-level fields of a ``scaling_curve`` record:
+    ordered points, per-point efficiency, fitted serial fraction, and
+    the contention census.  Callers add identity (name/algorithm), the
+    environment fingerprint, and the schema stamp."""
+    pts = sorted_points(points)
+    eff = weak_scaling_efficiency(pts)
+    flagged = sum(1 for p in pts
+                  if (p.get("contention") or {}).get("flagged"))
+    out = {
+        "points": list(pts),
+        "n_points": len(pts),
+        "max_devices": int(pts[-1]["devices"]) if pts else 0,
+        "efficiency": eff,
+        "contention_flagged": flagged,
+    }
+    s = fit_serial_fraction(pts)
+    if s is not None:
+        out["serial_fraction"] = s
+    return out
+
+
+# ---------------------------------------------------------------------------
+# curve-shape verdicts
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CurvePolicy:
+    """What shape must a trustworthy weak-scaling curve have?
+
+    - ``min_efficiency``: every point's efficiency floor — the headline
+      "does this scale" number (MLPerf weak-scaling framing);
+    - ``monotone_slack``: efficiency is physically non-increasing in
+      device count; a RISE beyond this slack is a measurement artifact
+      (the 1-device reference was itself contended, or the run is
+      noise) and fails the curve's shape rather than flattering it;
+    - ``max_serial_fraction``: ceiling on the fitted Gustafson serial
+      fraction — the quantity that caps every future mesh size;
+    - ``contention``: the per-point contamination policy; with
+      ``refuse_contended`` the gate REFUSES (exit 2) rather than
+      gating poisoned data.
+    """
+
+    min_efficiency: float = 0.5
+    monotone_slack: float = 0.10
+    max_serial_fraction: float = 0.30
+    contention: ContentionPolicy = dataclasses.field(
+        default_factory=ContentionPolicy)
+
+
+@dataclasses.dataclass
+class CurveVerdict:
+    """One curve's shape verdict: ``failures`` are shape violations
+    (gate exit 1), ``contended`` are contaminated points (refusal
+    material, gate exit 2 under ``refuse_contended``)."""
+
+    name: str
+    failures: List[str]
+    contended: List[str]
+    efficiency: List[Optional[float]]
+    serial_fraction: Optional[float]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures and not self.contended
+
+
+def check_curve(rec: dict,
+                policy: Optional[CurvePolicy] = None) -> CurveVerdict:
+    """Shape-check one ``scaling_curve`` record against a
+    :class:`CurvePolicy` — efficiency floor per point, monotonicity,
+    serial-fraction ceiling, and the per-point contention census."""
+    policy = policy or CurvePolicy()
+    name = str(rec.get("name", "?"))
+    pts = sorted_points(rec.get("points") or [])
+    eff = rec.get("efficiency")
+    if not isinstance(eff, list) or len(eff) != len(pts):
+        eff = weak_scaling_efficiency(pts)
+    s = rec.get("serial_fraction")
+    if not isinstance(s, (int, float)) or isinstance(s, bool):
+        s = fit_serial_fraction(pts)
+    failures: List[str] = []
+    contended: List[str] = []
+    if len(pts) < 2:
+        failures.append(f"{name}: {len(pts)} point(s) — a curve needs "
+                        "at least 2 mesh shapes")
+    prev_eff: Optional[float] = None
+    for p, e in zip(pts, eff):
+        k = p.get("devices", "?")
+        cont = p.get("contention") or {}
+        if cont.get("flagged"):
+            why = "; ".join(cont.get("reasons", [])) or "flagged"
+            contended.append(f"{name}: point devices={k} is "
+                             f"contention-contaminated ({why})")
+        if e is None:
+            failures.append(f"{name}: point devices={k} has no usable "
+                            "time (wall_s/iters or sec_per_iter)")
+            continue
+        if e < policy.min_efficiency:
+            failures.append(
+                f"{name}: efficiency {e:.3f} at devices={k} below the "
+                f"{policy.min_efficiency:g} floor")
+        if prev_eff is not None and e > prev_eff + policy.monotone_slack:
+            failures.append(
+                f"{name}: non-monotone — efficiency rose {prev_eff:.3f}"
+                f" -> {e:.3f} at devices={k} (beyond the "
+                f"{policy.monotone_slack:g} slack; the smaller rung was "
+                "likely itself contended)")
+        prev_eff = e
+    if s is not None and s > policy.max_serial_fraction:
+        failures.append(f"{name}: fitted serial fraction {s:.3f} above "
+                        f"the {policy.max_serial_fraction:g} ceiling")
+    return CurveVerdict(name=name, failures=failures,
+                        contended=contended, efficiency=list(eff),
+                        serial_fraction=(round(float(s), 4)
+                                         if isinstance(s, (int, float))
+                                         and not isinstance(s, bool)
+                                         else None))
+
+
+# ---------------------------------------------------------------------------
+# provenance validation (the legacy-artifact quarantine)
+# ---------------------------------------------------------------------------
+
+# what a record must carry to participate in history comparisons
+_PROVENANCE_FIELDS = ("platform", "jax_version", "jaxlib_version")
+
+
+def provenance_gaps(rec: dict) -> List[str]:
+    """Why a record may NOT enter history comparisons: missing
+    environment provenance, or (for scaling curves) points without a
+    contention report.  An empty list means the record is trusted.
+    Legacy ``BENCH_r0*.json`` wrapper rows (``{"n", "cmd", "rc",
+    "tail"}`` driver logs, pre-schema) are quarantined wholesale."""
+    if not isinstance(rec, dict):
+        return ["not a record (not a JSON object)"]
+    if {"cmd", "rc"} <= set(rec) and "kind" not in rec:
+        return ["legacy bench driver log (pre-schema wrapper row: no "
+                "kind, no provenance, no measurements to compare)"]
+    gaps = [f"missing provenance field '{f}'"
+            for f in _PROVENANCE_FIELDS if rec.get(f) is None]
+    if rec.get("kind") == "scaling_curve":
+        pts = rec.get("points") or []
+        bare = [str(p.get("devices", "?")) for p in pts
+                if not isinstance(p.get("contention"), dict)]
+        if bare:
+            gaps.append("point(s) devices=" + ",".join(bare)
+                        + " carry no contention report")
+        if "env_key" not in rec:
+            gaps.append("missing env_key (append via tools/agd_bench.py"
+                        " so history stays provenance-keyed)")
+    return gaps

@@ -38,7 +38,8 @@ SCHEMA_VERSION = 1
 KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "numerics_failure", "attempt", "recovery", "heartbeat",
          "chaos", "journal_replay", "degraded", "contract_pin",
-         "serve_request", "serve_latency", "trace_summary")
+         "serve_request", "serve_latency", "trace_summary",
+         "scaling_curve")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
@@ -101,6 +102,13 @@ _REQUIRED: Dict[str, dict] = {
     # reconstructed span count; hosts/critical path/straggler score
     # ride as optionals
     "trace_summary": {"run_id": str, "trace_id": str, "spans": int},
+    # one weak-scaling ladder (obs.scaling / benchmarks.run.run_ladder):
+    # ``points`` is the ordered per-mesh-shape measurement list (each a
+    # dict with devices/wall/sec_per_iter/program cost/contention);
+    # efficiency, serial fraction, and the environment fingerprint ride
+    # as optionals — the record family obs.perfgate gates on curve
+    # SHAPE, not single numbers
+    "scaling_curve": {"run_id": str, "name": str, "points": list},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -126,6 +134,13 @@ _OPTIONAL: Dict[str, dict] = {
         # per-host skew (obs.timeline.straggler_score over the run's
         # trace): the perf gate's lower-is-better skew metric
         "straggler_score": _OPT_NUM, "hosts": int,
+        # hardened host-environment provenance (obs.scaling.
+        # host_fingerprint, merged into environment_fingerprint):
+        # identity fields enter the history env_key; loadavg_1m is
+        # measurement-time state for the contention sentinel
+        "cpu_count": (int, type(None)), "loadavg_1m": _NUM,
+        "cpu_governor": str, "cpu_turbo": str,
+        "cgroup_cpu_quota": (_NUM + (str,)), "env_key": str,
     },
     "iteration": {"L": _NUM, "theta": _NUM, "step": _NUM,
                   "restarted": bool, "accepted": bool,
@@ -213,6 +228,20 @@ _OPTIONAL: Dict[str, dict] = {
         "connected": bool, "critical_path_s": _OPT_NUM,
         "critical_path": list, "straggler_score": _OPT_NUM,
         "slowest_host": (int, type(None)), "step_span": str,
+        "algorithm": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "scaling_curve": {
+        "n_points": int, "max_devices": int, "efficiency": list,
+        "serial_fraction": _OPT_NUM, "contention_flagged": int,
+        "rows_per_device": int, "iters": int, "ladder": str,
+        "spin_baseline_s": _NUM, "env_key": str,
+        # the environment fingerprint rides flat so the gate's refusal
+        # logic reads curves and runs identically
+        "platform": str, "device_kind": str, "n_devices": int,
+        "jax_version": str, "jaxlib_version": str, "n_processes": int,
+        "mesh_shape": dict, "cpu_count": (int, type(None)),
+        "loadavg_1m": _NUM, "cpu_governor": str, "cpu_turbo": str,
+        "cgroup_cpu_quota": (_NUM + (str,)),
         "algorithm": str, "tool": str, "timestamp_unix": _NUM,
     },
 }
@@ -429,6 +458,18 @@ def trace_summary_record(run_id: str, trace_id: str, spans: int,
             "spans": int(spans), **fields}
 
 
+def scaling_curve_record(run_id: str, name: str, points: list,
+                         **fields) -> dict:
+    """One weak-scaling ladder (``obs.scaling`` + ``benchmarks.run.
+    run_ladder``): ``points`` is the ordered per-mesh-shape measurement
+    list; efficiency/serial-fraction/contention and the environment
+    fingerprint ride as optional fields — what ``obs.perfgate.
+    gate_scaling`` gates on curve shape."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "scaling_curve",
+            "run_id": run_id, "name": str(name),
+            "points": list(points), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -576,6 +617,36 @@ EXAMPLE_SERVE_LATENCY_RECORD = {
     "tool": "serve.queue",
 }
 
+EXAMPLE_SCALING_CURVE_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "scaling_curve",
+    "run_id": "r18c2d3e4-1a2b-0", "name": "logistic_l2_rcv1like",
+    "algorithm": "agd", "tool": "benchmarks.run",
+    "points": [
+        {"devices": 1, "rows": 256, "iters": 8, "wall_s": 0.41,
+         "sec_per_iter": 0.0512, "iters_per_sec": 19.5,
+         "converged": False, "flops": 528383.0,
+         "bytes_accessed": 65580.0, "peak_hbm_bytes": 32788,
+         "collectives": {"all-reduce": 0},
+         "contention": {"flagged": False, "spin_score": 0.02,
+                        "steal_ticks": 0, "loadavg_before": 0.4,
+                        "loadavg_during_max": 0.5}},
+        {"devices": 2, "rows": 512, "iters": 8, "wall_s": 0.44,
+         "sec_per_iter": 0.0550, "iters_per_sec": 18.2,
+         "converged": False, "flops": 528383.0,
+         "bytes_accessed": 65580.0, "peak_hbm_bytes": 32788,
+         "collectives": {"all-reduce": 3},
+         "contention": {"flagged": False, "spin_score": 0.03,
+                        "steal_ticks": 0, "loadavg_before": 0.5,
+                        "loadavg_during_max": 0.5}},
+    ],
+    "n_points": 2, "max_devices": 2, "efficiency": [1.0, 0.9309],
+    "serial_fraction": 0.0742, "contention_flagged": 0,
+    "rows_per_device": 256, "iters": 8, "ladder": "1,2",
+    "env_key": "env-9f2ab34c11d0", "platform": "cpu", "n_devices": 8,
+    "cpu_count": 8, "loadavg_1m": 0.42, "cgroup_cpu_quota": 8.0,
+    "timestamp_unix": 1754000000.0,
+}
+
 # the kind-keyed table selfcheck iterates — graftlint's schema-drift
 # rule cross-checks that EVERY registered kind appears here (and has a
 # Telemetry helper), so a new kind cannot land without selfcheck
@@ -597,6 +668,7 @@ EXAMPLES: Dict[str, dict] = {
     "serve_request": EXAMPLE_SERVE_REQUEST_RECORD,
     "serve_latency": EXAMPLE_SERVE_LATENCY_RECORD,
     "trace_summary": EXAMPLE_TRACE_SUMMARY_RECORD,
+    "scaling_curve": EXAMPLE_SCALING_CURVE_RECORD,
 }
 
 

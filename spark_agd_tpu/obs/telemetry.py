@@ -391,6 +391,32 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def scaling_curve(self, *, name: str, points: list,
+                      **fields) -> dict:
+        """Emit (and return) a ``scaling_curve`` record — one
+        weak-scaling ladder (``obs.scaling`` / ``benchmarks.run.
+        run_ladder``) — mirroring the headline shape numbers into
+        gauges (``scaling.<name>.efficiency_floor`` — the curve's
+        worst point — and ``scaling.<name>.serial_fraction``) and
+        counting contention-contaminated points
+        (``scaling.contended_points``), so a ladder's trust story
+        rides every run summary."""
+        eff = [e for e in (fields.get("efficiency") or [])
+               if isinstance(e, (int, float)) and not isinstance(e, bool)]
+        if eff:
+            self.registry.gauge(
+                f"scaling.{name}.efficiency_floor").set(min(eff))
+        s = fields.get("serial_fraction")
+        if isinstance(s, (int, float)) and not isinstance(s, bool):
+            self.registry.gauge(f"scaling.{name}.serial_fraction").set(s)
+        flagged = fields.get("contention_flagged")
+        if isinstance(flagged, int) and flagged:
+            self.registry.counter("scaling.contended_points").inc(flagged)
+        rec = schema.scaling_curve_record(self.run_id, name, points,
+                                          **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

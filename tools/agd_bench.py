@@ -1,0 +1,419 @@
+#!/usr/bin/env python
+"""Scaling-observatory CLI: run the weak-scaling ladder, keep a
+provenance-keyed history, and gate on curve SHAPE.
+
+The BENCH_r01–r05 trajectory died of exactly two diseases: host
+contention nobody measured, and environment drift nobody stamped.
+This tool is the antidote — every command either produces a
+``scaling_curve`` record with its own contamination evidence
+(``obs.scaling``'s contention sentinel + hardened environment
+fingerprint) or REFUSES to compare records that lack it.
+
+Usage::
+
+    # run a 1->4 virtual-device CPU ladder, append to history
+    python tools/agd_bench.py run --config 1 --devices 4 \\
+        --scale-per-device 0.002 --iters 10 --history SCALING.jsonl
+
+    # gate the newest curves on shape (and vs same-env history)
+    python tools/agd_bench.py gate SCALING.jsonl --history SCALING.jsonl
+    python tools/agd_bench.py gate CAND.jsonl --baseline BASE.jsonl
+
+    # side-by-side curve report (never fails)
+    python tools/agd_bench.py compare BASE.jsonl CAND.jsonl
+
+    # audit legacy artifacts: who may enter history comparisons?
+    python tools/agd_bench.py validate BENCH_r0*.json SCALING.jsonl
+
+Exit codes: 0 pass, 1 shape failure / regression / ladder error, 2
+refused — cross-environment or contention-contaminated comparison
+(typed: the gate prints ONE machine-readable ``scaling_gate`` run
+record naming every refusal), or unreadable input.  ``validate`` and
+``compare`` are reports (0/2 only): quarantined records are listed and
+EXCLUDED from history comparisons instead of crashing the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _load_any(path: str) -> Tuple[List[dict], List[str]]:
+    """(records, notes): JSONL line-by-line, falling back to one whole-
+    file JSON object/array — the shape the legacy pretty-printed
+    ``BENCH_r0*.json`` driver logs use."""
+    notes: List[str] = []
+    with open(path) as f:
+        text = f.read()
+    records: List[dict] = []
+    ok_lines = 0
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            records = []
+            ok_lines = 0
+            break
+        if isinstance(rec, dict):
+            records.append(rec)
+            ok_lines += 1
+    if not ok_lines:
+        try:
+            whole = json.loads(text)
+        except json.JSONDecodeError:
+            notes.append(f"{path}: neither JSONL nor a JSON document")
+            return [], notes
+        if isinstance(whole, dict):
+            records = [whole]
+        elif isinstance(whole, list):
+            records = [r for r in whole if isinstance(r, dict)]
+            if len(records) != len(whole):
+                notes.append(f"{path}: {len(whole) - len(records)} "
+                             "non-object entries ignored")
+        else:
+            notes.append(f"{path}: top-level JSON is neither object "
+                         "nor array")
+    return records, notes
+
+
+def _policy_from_args(args):
+    from spark_agd_tpu.obs import scaling
+
+    contention = scaling.ContentionPolicy(
+        refuse_contended=not getattr(args, "no_refuse_contended", False))
+    return scaling.CurvePolicy(
+        min_efficiency=args.min_efficiency,
+        monotone_slack=args.monotone_slack,
+        max_serial_fraction=args.max_serial_fraction,
+        contention=contention)
+
+
+def _add_policy_args(p):
+    p.add_argument("--min-efficiency", type=float, default=0.5,
+                   help="per-point weak-scaling efficiency floor "
+                        "(default 0.5)")
+    p.add_argument("--monotone-slack", type=float, default=0.10,
+                   help="max efficiency RISE between consecutive rungs "
+                        "before the curve is non-monotone (default 0.1)")
+    p.add_argument("--max-serial-fraction", type=float, default=0.30,
+                   help="ceiling on the fitted Gustafson serial "
+                        "fraction (default 0.3)")
+    p.add_argument("--no-refuse-contended", action="store_true",
+                   help="gate shape even when points are contention-"
+                        "flagged (default: refuse, exit 2)")
+    p.add_argument("--allow-cross-env", action="store_true",
+                   help="compare even when environment provenance "
+                        "differs (refusals become notes)")
+
+
+def _trusted_history(records: List[dict], env_key: Optional[str]
+                     ) -> Tuple[List[dict], List[str]]:
+    """History records allowed into a comparison: provenance-complete
+    ``scaling_curve`` rows whose ``env_key`` matches the candidate's.
+    Everything else is quarantined with a reason — never crashed on,
+    never silently compared."""
+    from spark_agd_tpu.obs import scaling
+
+    trusted: List[dict] = []
+    quarantined: List[str] = []
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("kind") != "scaling_curve":
+            continue
+        gaps = scaling.provenance_gaps(rec)
+        if gaps:
+            quarantined.append(
+                f"{rec.get('name', '?')}: " + "; ".join(gaps))
+            continue
+        if env_key is not None and rec.get("env_key") != env_key:
+            quarantined.append(
+                f"{rec.get('name', '?')}: different environment "
+                f"({rec.get('env_key')} != candidate {env_key})")
+            continue
+        trusted.append(rec)
+    return trusted, quarantined
+
+
+# ---------------------------------------------------------------------------
+# run
+# ---------------------------------------------------------------------------
+
+
+def cmd_run(args) -> int:
+    if args.platform == "cpu":
+        # must land before backend instantiation (sitecustomize already
+        # imported jax; config.update still works pre-backend — the
+        # tests/conftest.py recipe)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", args.devices)
+        except AttributeError:  # older jaxlib: the XLA flag it replaced
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{args.devices}")
+
+    from benchmarks import run as bench_run
+    from spark_agd_tpu.obs import schema
+
+    configs = [c for c in bench_run.CONFIGS
+               if args.config in (0, c.idx)]
+    if not configs:
+        log(f"unknown config {args.config}")
+        return 2
+    failures = 0
+    sentinel = None
+    for cfg in configs:
+        if sentinel is None:
+            from spark_agd_tpu.obs import scaling
+
+            sentinel = scaling.ContentionSentinel()
+        try:
+            rec = bench_run.run_ladder(
+                cfg, scale_per_device=args.scale_per_device,
+                iters=args.iters, convergence_tol=args.tol,
+                max_devices=args.max_devices, sentinel=sentinel)
+        except Exception as e:  # noqa: BLE001 — one config's dead ladder
+            # must not take down the others; the record carries the error
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            rec = schema.stamp(
+                {"name": cfg.name,
+                 "error": f"ladder: {type(e).__name__}: {e}"[:500]},
+                tool="agd_bench")
+            failures += 1
+        errs = schema.validate_record(json.loads(json.dumps(rec)))
+        if errs:
+            log(f"[{cfg.name}] record failed schema validation: {errs}")
+            failures += 1
+        print(json.dumps(rec), flush=True)
+        for path in filter(None, (args.history, args.out)):
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# gate / compare
+# ---------------------------------------------------------------------------
+
+
+def cmd_gate(args) -> int:
+    from spark_agd_tpu.obs import perfgate
+
+    try:
+        candidate, notes = _load_any(args.candidate)
+    except OSError as e:
+        log(f"agd_bench: cannot read candidate: {e}")
+        return 2
+    for n in notes:
+        log(f"note: {n}")
+
+    baseline: Optional[List[dict]] = None
+    if args.baseline:
+        try:
+            baseline, b_notes = _load_any(args.baseline)
+        except OSError as e:
+            log(f"agd_bench: cannot read baseline: {e}")
+            return 2
+        for n in b_notes:
+            log(f"note: {n}")
+    elif args.history:
+        try:
+            history, h_notes = _load_any(args.history)
+        except OSError as e:
+            log(f"agd_bench: cannot read history: {e}")
+            return 2
+        for n in h_notes:
+            log(f"note: {n}")
+        curves = perfgate.split_curves(candidate)
+        env_keys = {rec.get("env_key") for rec in curves.values()}
+        env_key = env_keys.pop() if len(env_keys) == 1 else None
+        # the candidate's own (newest) history rows must not become
+        # their own baseline: drop records with a candidate run_id
+        cand_ids = {rec.get("run_id") for rec in curves.values()}
+        history = [r for r in history
+                   if r.get("run_id") not in cand_ids]
+        baseline, quarantined = _trusted_history(history, env_key)
+        for q in quarantined:
+            log(f"quarantined from history comparison: {q}")
+        if not baseline:
+            log("note: no same-environment trusted history — gating "
+                "curve shape only")
+            baseline = None
+
+    result = perfgate.gate_scaling(
+        candidate, baseline, policy=_policy_from_args(args),
+        allow_cross_env=args.allow_cross_env)
+    print(perfgate.format_scaling_report(result))
+    # the TYPED outcome record: one machine-readable line, so a refusal
+    # is evidence in the artifact stream, not a silent exit code
+    rec = result.record()
+    print(json.dumps(rec), flush=True)
+    if args.record:
+        with open(args.record, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return result.exit_code()
+
+
+def cmd_compare(args) -> int:
+    from spark_agd_tpu.obs import perfgate, scaling
+
+    try:
+        base, b_notes = _load_any(args.baseline)
+        cand, c_notes = _load_any(args.candidate)
+    except OSError as e:
+        log(f"agd_bench: cannot read records: {e}")
+        return 2
+    for n in b_notes + c_notes:
+        log(f"note: {n}")
+    # report-only: policy never fails a compare, so disable refusals
+    policy = scaling.CurvePolicy(
+        min_efficiency=0.0, monotone_slack=10.0, max_serial_fraction=1.0,
+        contention=scaling.ContentionPolicy(refuse_contended=False))
+    result = perfgate.gate_scaling(cand, base, policy=policy,
+                                   allow_cross_env=True)
+    print(f"== scaling compare: {args.baseline} (baseline) vs "
+          f"{args.candidate} (candidate) ==")
+    print(perfgate.format_scaling_report(result))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# validate
+# ---------------------------------------------------------------------------
+
+
+def cmd_validate(args) -> int:
+    from spark_agd_tpu.obs import scaling
+
+    paths = args.paths or sorted(glob.glob("BENCH_r0*.json"))
+    if not paths:
+        log("agd_bench validate: no files given and no BENCH_r0*.json "
+            "in the working directory")
+        return 2
+    unreadable = 0
+    n_trusted = n_quarantined = 0
+    for path in paths:
+        try:
+            records, notes = _load_any(path)
+        except OSError as e:
+            log(f"cannot read {path}: {e}")
+            unreadable += 1
+            continue
+        for n in notes:
+            log(f"note: {n}")
+        if not records:
+            print(f"{path}: QUARANTINED (no parseable records)")
+            n_quarantined += 1
+            continue
+        for i, rec in enumerate(records, 1):
+            where = path if len(records) == 1 else f"{path}#{i}"
+            gaps = scaling.provenance_gaps(rec)
+            label = (rec.get("kind") or "pre-schema")
+            name = rec.get("name") or rec.get("metric") or "-"
+            if gaps:
+                n_quarantined += 1
+                print(f"{where}: QUARANTINED [{label}] "
+                      + "; ".join(gaps))
+            else:
+                n_trusted += 1
+                print(f"{where}: trusted [{label}] name={name} "
+                      f"env_key={rec.get('env_key', '-')}")
+    print(f"\nvalidate: {n_trusted} trusted, {n_quarantined} "
+          f"quarantined (quarantined records are excluded from "
+          f"history comparisons, never compared silently)")
+    return 2 if unreadable else 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/agd_bench.py",
+        description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("run", help="run the weak-scaling ladder and "
+                                    "append scaling_curve records")
+    pr.add_argument("--config", type=int, default=1,
+                    help="benchmarks.run config index 1-5; 0 = all "
+                         "(default 1)")
+    pr.add_argument("--iters", type=int, default=10)
+    pr.add_argument("--tol", type=float, default=0.0,
+                    help="AGD convergence_tol; >0 also records "
+                         "iters_to_tol per rung")
+    pr.add_argument("--scale-per-device", type=float, default=0.002,
+                    help="per-device row scale (rung k generates "
+                         "scale*k; default 0.002)")
+    pr.add_argument("--max-devices", type=int, default=None,
+                    help="cap the largest rung (default: all visible)")
+    pr.add_argument("--devices", type=int, default=4,
+                    help="with --platform cpu: virtual host device "
+                         "count to expose (default 4)")
+    pr.add_argument("--platform", choices=("cpu", "keep"),
+                    default="cpu",
+                    help="cpu (default): force the CPU backend with "
+                         "--devices virtual devices; keep: use the "
+                         "already-configured backend (TPU windows)")
+    pr.add_argument("--history", type=str, default=None,
+                    help="append each record to this provenance-keyed "
+                         "history JSONL")
+    pr.add_argument("--out", type=str, default=None,
+                    help="also append each record to this file")
+    pr.set_defaults(fn=cmd_run)
+
+    pg = sub.add_parser("gate", help="gate scaling_curve records on "
+                                     "curve shape (exit 0/1/2)")
+    pg.add_argument("candidate", metavar="CANDIDATE.jsonl")
+    pg.add_argument("--baseline", type=str, default=None,
+                    help="explicit baseline curve file")
+    pg.add_argument("--history", type=str, default=None,
+                    help="history JSONL: the trusted same-environment "
+                         "rows become the baseline; everything else is "
+                         "quarantined with a reason")
+    pg.add_argument("--record", type=str, default=None,
+                    help="also append the typed scaling_gate outcome "
+                         "record to this file")
+    _add_policy_args(pg)
+    pg.set_defaults(fn=cmd_gate)
+
+    pc = sub.add_parser("compare", help="side-by-side curve report "
+                                        "(never fails)")
+    pc.add_argument("baseline", metavar="BASE.jsonl")
+    pc.add_argument("candidate", metavar="CAND.jsonl")
+    pc.set_defaults(fn=cmd_compare)
+
+    pv = sub.add_parser(
+        "validate",
+        help="report which records carry full provenance/contention "
+             "fields; quarantine the rest (legacy BENCH_r0*.json aware)")
+    pv.add_argument("paths", nargs="*", metavar="FILE",
+                    help="default: BENCH_r0*.json in the working "
+                         "directory")
+    pv.set_defaults(fn=cmd_validate)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,7 +66,8 @@ def _kind(rec: dict) -> Optional[str]:
     k = rec.get("kind")
     if k in ("run", "iteration", "span", "metrics", "attempt",
              "recovery", "numerics_failure", "contract_pin",
-             "serve_request", "serve_latency", "trace_summary"):
+             "serve_request", "serve_latency", "trace_summary",
+             "scaling_curve"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -327,6 +328,51 @@ def summarize_serving(requests: List[dict], latencies: List[dict],
     return _table(headers, rows)
 
 
+def summarize_scaling(curves: List[dict]) -> str:
+    """The scaling rollup (``scaling_curve`` records from
+    ``benchmarks.run.run_ladder`` / ``tools/agd_bench.py``): one block
+    per ladder — the per-rung efficiency table with each point's
+    contention verdict, the fitted serial fraction, and the
+    environment key the history comparisons pair on.  The MLPerf-pods
+    framing: a scaling claim IS this table, not any single row of it."""
+    blocks = []
+    for rec in curves:
+        points = rec.get("points") or []
+        eff = rec.get("efficiency") or [None] * len(points)
+        head = (f"ladder {rec.get('name', '?')} "
+                f"[{rec.get('algorithm', '?')}] "
+                f"run {_fmt(rec.get('run_id', '-'))[:18]}: "
+                f"{len(points)} rung(s), serial fraction "
+                f"{_fmt(rec.get('serial_fraction'))}, env_key "
+                f"{rec.get('env_key', '-')}")
+        flagged = rec.get("contention_flagged")
+        if flagged:
+            head += f"  [{flagged} CONTENTION-FLAGGED point(s)]"
+        rows = []
+        for p, e in zip(points, eff):
+            cont = p.get("contention") or {}
+            verdict = ("CONTENDED" if cont.get("flagged")
+                       else "clean" if cont else "-")
+            spin = cont.get("spin_score")
+            if spin is not None:
+                verdict += f" (spin {_fmt(spin, 3)})"
+            rows.append([
+                str(p.get("devices", "?")),
+                _fmt(p.get("rows")),
+                _fmt(p.get("sec_per_iter"), 4),
+                _fmt(p.get("iters_per_sec"), 4),
+                _fmt(e, 4),
+                _fmt(p.get("flops"), 4),
+                _fmt(sum((p.get("collectives") or {}).values())),
+                verdict,
+            ])
+        table = _table(["devices", "rows", "sec/iter", "iters/s",
+                        "efficiency", "flops", "collectives",
+                        "contention"], rows)
+        blocks.append(head + "\n" + table)
+    return "\n\n".join(blocks)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -413,6 +459,10 @@ def main(argv=None) -> int:
                    help="narrow the trace/straggler section to one "
                         "trace id (full timeline analysis lives in "
                         "tools/agd_trace.py)")
+    p.add_argument("--scaling", action="store_true",
+                   help="print only the == scaling == rollup "
+                        "(scaling_curve records; the gate lives in "
+                        "tools/agd_bench.py)")
     args = p.parse_args(argv)
 
     if args.compare:
@@ -430,7 +480,7 @@ def main(argv=None) -> int:
 
     runs, spans = [], []
     attempts, recoveries, numerics, pins = [], [], [], []
-    serve_reqs, serve_lats = [], []
+    serve_reqs, serve_lats, curves = [], [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -453,8 +503,18 @@ def main(argv=None) -> int:
             serve_reqs.append(rec)
         elif k == "serve_latency":
             serve_lats.append(rec)
+        elif k == "scaling_curve":
+            curves.append(rec)
         elif k is None:
             unknown += 1
+
+    if args.scaling:
+        if not curves:
+            print("no scaling_curve records found", file=sys.stderr)
+            return 1
+        print(f"== scaling ({len(curves)} ladder(s)) ==")
+        print(summarize_scaling(curves))
+        return 0
 
     if runs:
         print(f"== runs ({len(runs)}) ==")
@@ -481,6 +541,9 @@ def main(argv=None) -> int:
         print(f"\n== serving ({len(serve_reqs)} requests, "
               f"{len(serve_lats)} latency rollups) ==")
         print(summarize_serving(serve_reqs, serve_lats, recoveries))
+    if curves:
+        print(f"\n== scaling ({len(curves)} ladder(s)) ==")
+        print(summarize_scaling(curves))
     tracing = summarize_tracing(records, recoveries, args.trace)
     if tracing:
         print("\n== tracing ==")
