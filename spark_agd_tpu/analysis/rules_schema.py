@@ -67,6 +67,10 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     "trace_summary": ((), frozenset({"trace_id", "spans"})),
     # one weak-scaling ladder (obs.scaling / benchmarks.run.run_ladder)
     "scaling_curve": ((), frozenset({"name", "points"})),
+    # the straggler scheduler (resilience.scheduler): one skew sync and
+    # one applied generation-boundary rebalance
+    "skew_estimate": ((), frozenset({"skew"})),
+    "rebalance": ((), frozenset({"at_iter"})),
 }
 
 

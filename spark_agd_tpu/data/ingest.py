@@ -156,6 +156,8 @@ def from_partitioned_files(
     retries: Optional[retry_lib.RetryPolicy] = None,
     telemetry=None,
     validate=False,
+    assignment: Optional[Sequence[str]] = None,
+    pad_to_rows: Optional[int] = None,
 ) -> mesh_lib.ShardedBatch:
     """Load one LIBSVM partition set into a mesh-sharded batch.
 
@@ -179,6 +181,17 @@ def from_partitioned_files(
     ``data.invalid_records`` telemetry counter — either way the model
     never silently trains on garbage.
 
+    ``assignment`` (optional): an EXPLICIT partition list for THIS host
+    instead of the round-robin rule — the straggler scheduler's
+    weighted re-split (``resilience.scheduler``) re-ingests through
+    this seat (an empty list is legal: the host contributes only
+    mask-0 padding rows but keeps its replicated carry and its place
+    in every collective).  ``pad_to_rows`` (optional, multi-process
+    assembly only) PINS the per-host block height instead of the
+    allgather-max: every assignment up to that many rows produces the
+    SAME global array shape, so a generation-boundary rebalance swaps
+    data arguments without re-tracing a single program.
+
     Returns a :class:`~spark_agd_tpu.parallel.mesh.ShardedBatch` whose
     mask excludes inter-host padding rows; feed it straight to
     ``api.run`` / ``dist_smooth.make_dist_smooth``.
@@ -190,14 +203,15 @@ def from_partitioned_files(
     mesh = mesh if mesh is not None else mesh_lib.make_mesh(
         {axis: len(jax.devices())})
 
-    parts = [loader(p, n_features=n_features) for p in local_partitions(paths)]
+    mine = (sorted(str(p) for p in assignment)
+            if assignment is not None else local_partitions(paths))
+    parts = [loader(p, n_features=n_features) for p in mine]
     d = n_features or _allgather_max(
         max((part.n_features for part in parts), default=0))
     if d == 0:
         raise ValueError("could not infer n_features (all partitions "
                          "empty on this host and none given)")
-    parts = _validated_parts(local_partitions(paths), parts, d,
-                             validate, telemetry)
+    parts = _validated_parts(mine, parts, d, validate, telemetry)
 
     ys, Xs = [], []
     for part in parts:
@@ -225,8 +239,20 @@ def from_partitioned_files(
             f"by {jax.process_count()} processes; per-host shard assembly "
             f"needs an even device-per-process split")
     per_host_quantum = n_dev_axis // jax.process_count()
-    rows_host = _allgather_max(n_local)
-    rows_host = -(-rows_host // per_host_quantum) * per_host_quantum
+    if pad_to_rows is not None:
+        rows_host = int(pad_to_rows)
+        if rows_host < n_local:
+            raise ValueError(
+                f"pad_to_rows={rows_host} < this host's {n_local} "
+                "rows; the pinned block height must fit every "
+                "assignment")
+        if rows_host % per_host_quantum:
+            raise ValueError(
+                f"pad_to_rows={rows_host} must be a multiple of the "
+                f"per-host device quantum {per_host_quantum}")
+    else:
+        rows_host = _allgather_max(n_local)
+        rows_host = -(-rows_host // per_host_quantum) * per_host_quantum
     pad = rows_host - n_local
     mask_local = np.concatenate(
         [np.ones(n_local, np.float32), np.zeros(pad, np.float32)])

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import scaling as scaling_lib
 from . import schema
+from . import timeline as timeline_lib
 
 # metric -> (direction, default relative threshold).  direction "lower"
 # = smaller is better; "higher" = larger is better.  The candidate
@@ -551,6 +552,168 @@ def format_scaling_report(result: ScalingGateResult) -> str:
                      + ("pass" if result.exit_code() == 0 else
                         f"FAIL ({len(result.shape_failures)} shape, "
                         f"{len(result.regressions)} regression(s))"))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Rebalance-effectiveness gate (resilience.scheduler)
+# ---------------------------------------------------------------------------
+
+# the host-local step span the rebalance gate scores (in lockstep SPMD
+# the coupled "segment" spans tie; skew lives in the boundary spans —
+# the same attribution rule tools/dist_fault_drill.py pins)
+REBALANCE_STEP_SPAN = "boundary"
+
+# spans shorter than this floor are host noise, not work: without it
+# the straggler score of two idle hosts is a ratio of scheduler jitter
+REBALANCE_FLOOR_S = 1e-3
+
+
+@dataclasses.dataclass
+class RebalanceGateResult:
+    """The rebalance-effectiveness gate's outcome: a run that carries
+    ``rebalance`` recovery actions must show its post-rebalance
+    straggler score BELOW the pre-rebalance value.  ``refusals``
+    (missing spans, one-sided samples) are typed exit-2 conditions —
+    per the repo's gating doctrine, a comparison that cannot be made
+    honestly is refused, not passed."""
+
+    rebalances: List[dict]
+    rebalance_iter: Optional[int]
+    pre_score: Optional[float]
+    post_score: Optional[float]
+    pre_steps: Dict[int, int]
+    post_steps: Dict[int, int]
+    refusals: List[str]
+    margin: float = 0.0
+
+    @property
+    def improved(self) -> bool:
+        return (self.pre_score is not None
+                and self.post_score is not None
+                and self.post_score
+                <= self.pre_score * (1.0 - self.margin)
+                and self.post_score < self.pre_score)
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.refusals)
+
+    @property
+    def ok(self) -> bool:
+        if self.refused:
+            return False
+        return self.improved if self.rebalances else True
+
+    def exit_code(self) -> int:
+        """0 pass, 1 rebalance did not lower the straggler score,
+        2 refused (spans missing / nothing comparable)."""
+        if self.refused:
+            return 2
+        return 0 if self.ok else 1
+
+
+def gate_rebalance(records: List[dict], *,
+                   step_span: str = REBALANCE_STEP_SPAN,
+                   min_steps: int = 2,
+                   margin: float = 0.0,
+                   floor_s: float = REBALANCE_FLOOR_S,
+                   require_rebalance: bool = False
+                   ) -> RebalanceGateResult:
+    """Gate rebalance effectiveness over one run's records: split the
+    host-local ``step_span`` spans at the FIRST rebalance boundary and
+    require the post-rebalance ``obs.timeline.straggler_score`` below
+    the pre-rebalance one.  Spans are floored at ``floor_s`` (see
+    :data:`REBALANCE_FLOOR_S`).  Without rebalance records the gate
+    passes vacuously unless ``require_rebalance`` (then: typed
+    refusal).  Missing or one-sided spans refuse (exit 2) — the
+    claim "the rebalance helped" cannot be graded without timings on
+    both sides."""
+    rebalances = [r for r in records if isinstance(r, dict)
+                  and ((r.get("kind") == "recovery"
+                        and r.get("action") == "rebalance")
+                       or r.get("kind") == "rebalance")]
+    iters = [v for r in rebalances
+             if isinstance(v := r.get("at_iter", r.get("from_iter")),
+                           int) and not isinstance(v, bool)]
+    refusals: List[str] = []
+    if not rebalances:
+        if require_rebalance:
+            refusals.append("no rebalance records in the stream — "
+                            "nothing to gate")
+        return RebalanceGateResult(
+            rebalances=[], rebalance_iter=None, pre_score=None,
+            post_score=None, pre_steps={}, post_steps={},
+            refusals=refusals, margin=margin)
+    if not iters:
+        refusals.append("rebalance records carry no at_iter/from_iter "
+                        "— cannot place the boundary")
+        return RebalanceGateResult(
+            rebalances=rebalances, rebalance_iter=None, pre_score=None,
+            post_score=None, pre_steps={}, post_steps={},
+            refusals=refusals, margin=margin)
+    boundary = min(iters)
+
+    pre: Dict[int, List[float]] = {}
+    post: Dict[int, List[float]] = {}
+    for s in timeline_lib.collect_spans(records):
+        if s.name != step_span or s.truncated:
+            continue
+        it = s.record.get("start_iter")
+        if not isinstance(it, int) or isinstance(it, bool):
+            continue
+        side = pre if it < boundary else post
+        side.setdefault(s.process, []).append(
+            max(float(s.seconds), floor_s))
+    if not pre and not post:
+        refusals.append(
+            f"no closed {step_span!r} spans with start_iter in the "
+            "stream — run with telemetry/tracing to grade a rebalance")
+    else:
+        for label, side in (("pre", pre), ("post", post)):
+            short = [p for p, ts in sorted(side.items())
+                     if len(ts) < min_steps]
+            if not side:
+                refusals.append(f"no {label}-rebalance {step_span!r} "
+                                "spans")
+            elif short:
+                refusals.append(
+                    f"{label}-rebalance side has < {min_steps} "
+                    f"samples for host(s) {short}")
+    pre_score = timeline_lib.straggler_score(pre) if pre else None
+    post_score = timeline_lib.straggler_score(post) if post else None
+    if not refusals and (pre_score is None or post_score is None):
+        refusals.append("straggler score not computable on both sides "
+                        "(degenerate timings)")
+    return RebalanceGateResult(
+        rebalances=rebalances, rebalance_iter=boundary,
+        pre_score=pre_score, post_score=post_score,
+        pre_steps={p: len(ts) for p, ts in sorted(pre.items())},
+        post_steps={p: len(ts) for p, ts in sorted(post.items())},
+        refusals=refusals, margin=margin)
+
+
+def format_rebalance_report(result: RebalanceGateResult) -> str:
+    """Human-readable rebalance-gate report (the failure output of
+    ``tools/perf_gate.py --rebalance``)."""
+    lines: List[str] = []
+    if result.refusals:
+        lines.append("REBALANCE GATE REFUSED:")
+        lines.extend("  " + r for r in result.refusals)
+        return "\n".join(lines)
+    if not result.rebalances:
+        return ("REBALANCE GATE: pass (no rebalance records — nothing "
+                "to gate)")
+    lines.append(
+        f"rebalance at iteration {result.rebalance_iter} "
+        f"({len(result.rebalances)} record(s)); straggler score "
+        f"{_fmt(result.pre_score)} -> {_fmt(result.post_score)} "
+        f"(pre {result.pre_steps} / post {result.post_steps} steps)")
+    lines.append("REBALANCE GATE: "
+                 + ("pass (post-rebalance straggler score is lower)"
+                    if result.ok else
+                    "FAIL (rebalance did not lower the straggler "
+                    "score)"))
     return "\n".join(lines)
 
 

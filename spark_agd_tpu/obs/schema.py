@@ -39,17 +39,20 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "numerics_failure", "attempt", "recovery", "heartbeat",
          "chaos", "journal_replay", "degraded", "contract_pin",
          "serve_request", "serve_latency", "trace_summary",
-         "scaling_curve")
+         "scaling_curve", "skew_estimate", "rebalance")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
 # canonical set for consumers.  ``hot_swap`` is the serving registry's
 # generation swap (serve.registry); ``flight_dump`` records a flight-
-# recorder dump written by a failure path (obs.flight).
+# recorder dump written by a failure path (obs.flight); ``rebalance``
+# and ``speculative_exec`` are the straggler scheduler's actions
+# (resilience.scheduler).
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
                     "host_lost", "elastic_resume", "degraded_continue",
-                    "hot_swap", "flight_dump")
+                    "hot_swap", "flight_dump", "rebalance",
+                    "speculative_exec")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -109,6 +112,16 @@ _REQUIRED: Dict[str, dict] = {
     # as optionals — the record family obs.perfgate gates on curve
     # SHAPE, not single numbers
     "scaling_curve": {"run_id": str, "name": str, "points": list},
+    # one skew sync of the straggler scheduler (resilience.scheduler.
+    # SkewTracker): ``skew`` is max per-host boundary cost over the
+    # median (1.0 balanced); speeds/straggler/hysteresis ride as
+    # optionals
+    "skew_estimate": {"run_id": str, "skew": _NUM},
+    # one applied generation-boundary rebalance decision (resilience.
+    # scheduler.StragglerScheduler): ``at_iter`` is the boundary it was
+    # decided at; the before/after per-host partition counts ride as
+    # optionals
+    "rebalance": {"run_id": str, "at_iter": int},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -181,6 +194,11 @@ _OPTIONAL: Dict[str, dict] = {
         "backoff_s": _NUM, "from_iter": int, "to_iter": int,
         "big_l": _NUM, "path": str, "generation": int,
         "process": int, "process_count": int, "saved_process_count": int,
+        # the speculative_exec action's accounting (resilience.
+        # scheduler.resolve_speculation)
+        "outcome": str, "matched": bool, "iters": int,
+        "seconds": _NUM, "fleet_seconds": _NUM, "max_diff": _NUM,
+        "straggler": int,
         "source": str, "algorithm": str, "tool": str,
         "timestamp_unix": _NUM,
     },
@@ -243,6 +261,20 @@ _OPTIONAL: Dict[str, dict] = {
         "loadavg_1m": _NUM, "cpu_governor": str, "cpu_turbo": str,
         "cgroup_cpu_quota": (_NUM + (str,)),
         "algorithm": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "skew_estimate": {
+        "speeds": dict, "straggler": (int, type(None)),
+        "consecutive": int, "persistent": bool, "iter": int,
+        "window_segments": int, "threshold": _NUM,
+        "hb_slow": list, "process": int, "source": str,
+        "algorithm": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "rebalance": {
+        "speeds": dict, "skew": _NUM, "straggler": (int, type(None)),
+        "before": dict, "after": dict, "moved": int,
+        "generation": int, "process": int, "reason": str,
+        "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
     },
 }
 
@@ -470,6 +502,26 @@ def scaling_curve_record(run_id: str, name: str, points: list,
             "points": list(points), **fields}
 
 
+def skew_estimate_record(run_id: str, skew: float, **fields) -> dict:
+    """One skew sync of the straggler scheduler
+    (``resilience.scheduler``): ``skew`` is the max per-host boundary
+    cost over the fleet median (1.0 = balanced); ``speeds`` the
+    relative per-host estimates, ``straggler``/``consecutive``/
+    ``persistent`` the hysteresis state."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "skew_estimate",
+            "run_id": run_id, "skew": float(skew), **fields}
+
+
+def rebalance_record(run_id: str, at_iter: int, **fields) -> dict:
+    """One applied generation-boundary rebalance
+    (``resilience.scheduler``): ``at_iter`` the boundary it was decided
+    at; ``before``/``after`` the per-host partition counts, ``moved``
+    how many partitions changed hands, ``generation`` the manifest
+    generation the new assignment commits under."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "rebalance",
+            "run_id": run_id, "at_iter": int(at_iter), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -647,6 +699,24 @@ EXAMPLE_SCALING_CURVE_RECORD = {
     "timestamp_unix": 1754000000.0,
 }
 
+EXAMPLE_SKEW_ESTIMATE_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "skew_estimate",
+    "run_id": "r18c2d3e4-1a2b-0", "skew": 4.82,
+    "speeds": {"0": 1.0, "1": 0.21}, "straggler": 1,
+    "consecutive": 2, "persistent": False, "iter": 12,
+    "window_segments": 1, "threshold": 1.5, "hb_slow": [1],
+    "process": 0, "source": "scheduler",
+}
+
+EXAMPLE_REBALANCE_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "rebalance",
+    "run_id": "r18c2d3e4-1a2b-0", "at_iter": 12,
+    "speeds": {"0": 1.0, "1": 0.21}, "skew": 4.82, "straggler": 1,
+    "before": {"0": 6, "1": 6}, "after": {"0": 11, "1": 1},
+    "moved": 5, "generation": 4, "process": 0,
+    "source": "scheduler",
+}
+
 # the kind-keyed table selfcheck iterates — graftlint's schema-drift
 # rule cross-checks that EVERY registered kind appears here (and has a
 # Telemetry helper), so a new kind cannot land without selfcheck
@@ -669,6 +739,8 @@ EXAMPLES: Dict[str, dict] = {
     "serve_latency": EXAMPLE_SERVE_LATENCY_RECORD,
     "trace_summary": EXAMPLE_TRACE_SUMMARY_RECORD,
     "scaling_curve": EXAMPLE_SCALING_CURVE_RECORD,
+    "skew_estimate": EXAMPLE_SKEW_ESTIMATE_RECORD,
+    "rebalance": EXAMPLE_REBALANCE_RECORD,
 }
 
 

@@ -417,6 +417,26 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def skew_estimate(self, *, skew: float, **fields) -> dict:
+        """Emit (and return) a ``skew_estimate`` record — one skew sync
+        of the straggler scheduler (``resilience.scheduler``) —
+        mirroring the skew into the ``sched.skew`` gauge so per-host
+        imbalance rides every run summary's metrics snapshot."""
+        self.registry.gauge("sched.skew").set(float(skew))
+        rec = schema.skew_estimate_record(self.run_id, skew, **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def rebalance(self, *, at_iter: int, **fields) -> dict:
+        """Emit (and return) a ``rebalance`` record — one applied
+        generation-boundary rebalance decision
+        (``resilience.scheduler``) — and count it
+        (``sched.rebalances``)."""
+        self.registry.counter("sched.rebalances").inc()
+        rec = schema.rebalance_record(self.run_id, at_iter, **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

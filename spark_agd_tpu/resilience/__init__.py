@@ -42,7 +42,15 @@ pytrees plus three scalars.  Four modules (guide:
   ``DegradePolicy`` decides whether the survivors may keep training on
   the surviving data partitions (``load_degraded`` /
   ``DegradedCheckpointer``; below quorum → typed ``QuorumLost``)
-  instead of a mandatory full restart.
+  instead of a mandatory full restart;
+- ``scheduler`` — straggler-aware scheduling: ``SkewTracker`` folds
+  allgather-synced per-host boundary timings into a hysteresis-gated
+  skew estimate, ``StragglerScheduler`` rebalances the partition
+  assignment toward fast hosts at generation checkpoint boundaries
+  (committed through the manifest protocol), and the speculation
+  helpers re-execute a straggling segment from the last committed
+  generation (deterministic math: first-result-wins is bit-safe);
+  drilled by ``tools/straggler_drill.py``.
 
 Every retry, rollback, preemption flush, and checkpoint fallback lands
 as an ``attempt`` / ``recovery`` record in the canonical ``obs.schema``
@@ -104,4 +112,15 @@ from .degrade import (  # noqa: F401
     DegradePolicy,
     DegradedCheckpointer,
     load_degraded,
+)
+from . import scheduler  # noqa: F401
+from .scheduler import (  # noqa: F401
+    RebalanceDecision,
+    ReschedulePolicy,
+    SkewTracker,
+    StragglerScheduler,
+    assign_weighted,
+    resolve_speculation,
+    run_speculative_segment,
+    speculation_due,
 )

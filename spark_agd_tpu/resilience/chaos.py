@@ -77,13 +77,24 @@ class InjectedFatalError(ValueError):
 
 @dataclasses.dataclass(frozen=True)
 class ScheduledFault:
-    """One scripted fault of a campaign — see the module docstring."""
+    """One scripted fault of a campaign — see the module docstring.
+
+    ``persist=True`` (``slow_host`` only) turns the one-shot boundary
+    sleep into a PERSISTENT per-segment delay: the fault fires at
+    EVERY boundary at or past ``at_iter``, sleeping ``payload *
+    decay**n`` seconds on its n-th firing — a genuinely degraded host
+    (``decay=1``: steady degradation; ``decay<1``: a host that slowly
+    recovers, e.g. a transient noisy neighbor).  Persistent faults are
+    exactly what the straggler scheduler (``resilience.scheduler``)
+    exists to detect and rebalance away from."""
 
     kind: str
     at_iter: int
     process: Optional[int] = None  # None = every process
     payload: float = 0.0           # slow_host: seconds; truncate_ckpt:
     #                                keep fraction; scramble_ckpt: bytes
+    persist: bool = False          # slow_host only: fire every boundary
+    decay: float = 1.0             # persistent per-firing multiplier
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -91,6 +102,13 @@ class ScheduledFault:
                              f"one of {FAULT_KINDS}")
         if self.at_iter < 0:
             raise ValueError("at_iter must be >= 0")
+        if self.persist and self.kind != "slow_host":
+            raise ValueError(
+                f"persist=True is a slow_host modifier; a persistent "
+                f"{self.kind!r} has no meaning (kills and poisons are "
+                "one-shot by nature)")
+        if not 0.0 < self.decay <= 1.0:
+            raise ValueError("decay must be in (0, 1]")
 
 
 class ChaosSchedule:
@@ -101,11 +119,25 @@ class ChaosSchedule:
     back after handling it, and the next due fault fires then).
     ``telemetry`` (optional): one ``chaos`` record per fired fault —
     flushed BEFORE a sigkill is delivered, so the kill itself is on
-    record in the journal."""
+    record in the journal.
+
+    PERSISTENT ``slow_host`` faults (``ScheduledFault(persist=True)``)
+    fire at every boundary at or past their iteration, never exhaust,
+    and never interrupt.  ``slow_scale`` (optional callable → float)
+    scales every slow-host sleep at fire time — the straggler drill
+    wires it to the host's CURRENT data share, so a rebalance that
+    moves partitions off the degraded host genuinely shrinks its
+    delay.  A bound heartbeat (``bind_heartbeat`` — the supervisor
+    binds its own writer) is beaten ``phase="slow"`` at the start of
+    every injected sleep and again every ``beat_interval_s`` during
+    it, so a sleep longer than a monitor's staleness window reads as
+    SLOW, not LOST (``distributed.HostMonitor.verdicts``)."""
 
     def __init__(self, faults: Sequence[ScheduledFault], *,
                  telemetry=None, seed: Optional[int] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 slow_scale: Optional[Callable[[], float]] = None,
+                 beat_interval_s: float = 0.25):
         for f in faults:
             if f.kind in FILE_KINDS:
                 raise ValueError(
@@ -115,32 +147,81 @@ class ChaosSchedule:
         ordered = sorted(faults, key=lambda f: (f.at_iter,
                                                 FAULT_KINDS.index(f.kind)))
         self._poison = [f for f in ordered if f.kind == "nan"]
-        self._pending = [f for f in ordered if f.kind != "nan"]
+        self._persistent = [f for f in ordered
+                            if f.kind == "slow_host" and f.persist]
+        self._persist_fired = [0] * len(self._persistent)
+        self._pending = [f for f in ordered
+                         if f.kind != "nan" and not f.persist]
         self._telemetry = telemetry
         self._seed = seed
         self._sleep = sleep
+        self._slow_scale = slow_scale
+        self._beat_interval_s = float(beat_interval_s)
+        self._heartbeat = None
         self.fired: List[Tuple[str, int]] = []  # (kind, boundary iter)
 
-    def _emit(self, fault: ScheduledFault, global_iter: int) -> None:
+    def bind_heartbeat(self, heartbeat) -> None:
+        """Attach the host's ``HeartbeatWriter`` (the supervisor does)
+        so injected sleeps keep beating — see the class docstring."""
+        self._heartbeat = heartbeat
+
+    def _emit(self, fault: ScheduledFault, global_iter: int,
+              payload: Optional[float] = None) -> None:
         self.fired.append((fault.kind, global_iter))
         if self._telemetry is not None:
             fields = {"at_iter": int(fault.at_iter),
                       "fired_iter": int(global_iter)}
             if fault.process is not None:
                 fields["process"] = int(fault.process)
-            if fault.payload:
-                fields["payload"] = float(fault.payload)
+            eff = fault.payload if payload is None else payload
+            if eff:
+                fields["payload"] = float(eff)
             if self._seed is not None:
                 fields["seed"] = int(self._seed)
             self._telemetry.chaos(fault=fault.kind, **fields)
 
+    def _slow_sleep(self, seconds: float, global_iter: int) -> None:
+        """One injected straggler sleep.  With a bound heartbeat the
+        sleep is chunked into sub-intervals with a ``phase="slow"``
+        beat before each, so the host's liveness file never goes stale
+        mid-sleep; without one the sleep is a single call (the
+        historical behavior tests pin)."""
+        if self._heartbeat is None:
+            self._sleep(seconds)
+            return
+        remaining = float(seconds)
+        while remaining > 0:
+            try:
+                self._heartbeat.beat(iter=global_iter, phase="slow")
+            except OSError:
+                pass  # a dying filesystem must not mask the drill
+            chunk = min(remaining, self._beat_interval_s)
+            self._sleep(chunk)
+            remaining -= chunk
+
     # -- the supervisor hooks (FaultScript interface) ---------------------
     def before_segment(self, global_iter: int) -> None:
+        for i, f in enumerate(self._persistent):
+            if f.at_iter > global_iter:
+                continue
+            eff = float(f.payload) * (float(f.decay)
+                                      ** self._persist_fired[i])
+            if self._slow_scale is not None:
+                eff *= max(0.0, float(self._slow_scale()))
+            self._persist_fired[i] += 1
+            if eff > 1e-9:
+                # a fully-rebalanced-away (or fully-decayed) persistent
+                # straggler goes quiet: no sleep, no record
+                self._emit(f, global_iter, payload=eff)
+                self._slow_sleep(eff, global_iter)
         while self._pending and self._pending[0].at_iter <= global_iter:
             f = self._pending.pop(0)
             self._emit(f, global_iter)
             if f.kind == "slow_host":
-                self._sleep(float(f.payload) or 0.25)
+                scale = (max(0.0, float(self._slow_scale()))
+                         if self._slow_scale is not None else 1.0)
+                self._slow_sleep((float(f.payload) or 0.25) * scale,
+                                 global_iter)
                 continue  # a straggler interrupts nothing
             if f.kind == "sigkill":
                 if self._telemetry is not None:
@@ -167,6 +248,10 @@ class ChaosSchedule:
 
     @property
     def exhausted(self) -> bool:
+        """True once every ONE-SHOT fault has fired.  Persistent
+        slow-host faults are deliberately excluded: they re-fire at
+        every boundary by design, so counting them would make a
+        degraded-host campaign read as eternally unfinished."""
         return not self._pending and not self._poison
 
 
@@ -195,7 +280,11 @@ class ChaosCampaign:
         applied at); in multi-process campaigns numeric/transient
         faults target every process (collective lockstep) while
         kill-class faults pick one victim; with probability ``p_fatal``
-        the last fault becomes ``fatal`` — the typed give-up leg."""
+        the last fault becomes ``fatal`` — the typed give-up leg.
+        About half the drawn ``slow_host`` faults come out PERSISTENT
+        (``persist=True`` with a sub-1 decay, so the total injected
+        delay stays bounded) — the genuinely-degraded-host scenario
+        the straggler scheduler rebalances away from."""
         rng = np.random.default_rng(int(seed))
         pool = ["nan", "device_loss", "slow_host", "sigterm",
                 "truncate_ckpt", "scramble_ckpt"]
@@ -226,10 +315,19 @@ class ChaosCampaign:
         for k, at in zip(kinds, iters_at):
             payload = 0.0
             process: Optional[int] = None
+            persist = False
+            decay = 1.0
             if k == "slow_host":
                 payload = float(rng.uniform(0.02, 0.08))
                 if process_count > 1:
                     process = int(rng.integers(0, process_count))
+                if float(rng.random()) < 0.5:
+                    # the degraded-host variant: per-segment delay with
+                    # a sub-1 decay so the total stays bounded (geometric
+                    # sum <= payload / (1 - decay))
+                    persist = True
+                    payload = float(rng.uniform(0.01, 0.04))
+                    decay = float(rng.uniform(0.5, 0.85))
             elif k == "truncate_ckpt":
                 payload = float(rng.uniform(0.2, 0.7))
             elif k == "scramble_ckpt":
@@ -238,7 +336,8 @@ class ChaosCampaign:
                     and process_count > 1:
                 process = victim
             out.append(ScheduledFault(kind=k, at_iter=int(at),
-                                      process=process, payload=payload))
+                                      process=process, payload=payload,
+                                      persist=persist, decay=decay))
         return cls(seed=int(seed), faults=tuple(out), iters=int(iters),
                    process_count=int(process_count))
 
@@ -259,7 +358,9 @@ class ChaosCampaign:
 
     def describe(self) -> str:
         return (f"seed={self.seed} "
-                + " ".join(f"{f.kind}@{f.at_iter}"
+                + " ".join(f"{f.kind}"
+                           + ("~persist" if f.persist else "")
+                           + f"@{f.at_iter}"
                            + (f"/p{f.process}" if f.process is not None
                               else "")
                            for f in self.faults))

@@ -509,15 +509,32 @@ class HostMonitor:
     indices) scopes the check; default: whatever files exist.  Usable
     from any process that sees the directory: a surviving peer (pass
     ``monitor=`` to the supervisor) or an external babysitter (the
-    drill's parent process)."""
+    drill's parent process).
+
+    SLOW is a distinct verdict from LOST (:meth:`verdicts`): a host
+    whose latest beat carries ``phase="slow"`` (the chaos sub-interval
+    beats during an injected sleep — ``ChaosSchedule`` keeps beating
+    through sleeps once the supervisor binds the heartbeat), or whose
+    beat age sits between ``slow_after_s`` and ``stale_after_s``, is
+    degraded-but-alive.  Only LOST raises; a slow host is the
+    straggler scheduler's problem (``resilience.scheduler``), not the
+    host-loss path's — before this split, a ``slow_host`` sleep longer
+    than the staleness window was misdiagnosed as a dead host."""
 
     def __init__(self, directory: str, *, stale_after_s: float = 30.0,
+                 slow_after_s: Optional[float] = None,
                  expected: Optional[Sequence[int]] = None,
                  telemetry=None, clock=time.time):
         if stale_after_s <= 0:
             raise ValueError("stale_after_s must be > 0")
+        if slow_after_s is not None and not \
+                0 < slow_after_s < stale_after_s:
+            raise ValueError("slow_after_s must sit in "
+                             "(0, stale_after_s)")
         self.directory = directory
         self.stale_after_s = float(stale_after_s)
+        self.slow_after_s = (None if slow_after_s is None
+                             else float(slow_after_s))
         self.expected = None if expected is None else sorted(
             int(p) for p in expected)
         self.telemetry = telemetry
@@ -549,6 +566,28 @@ class HostMonitor:
     def lost_hosts(self) -> List[int]:
         return [p for p, rec in self.poll().items()
                 if rec["age_s"] > self.stale_after_s]
+
+    def verdicts(self) -> Dict[int, str]:
+        """Per-host ``"ok"`` | ``"slow"`` | ``"lost"`` — see the class
+        docstring.  SLOW means alive-but-degraded: the latest beat
+        says ``phase="slow"`` (an injected or self-reported degraded
+        stretch) or the beat age exceeds ``slow_after_s`` without
+        crossing the staleness line."""
+        out: Dict[int, str] = {}
+        for p, rec in sorted(self.poll().items()):
+            age = rec["age_s"]
+            if age > self.stale_after_s:
+                out[p] = "lost"
+            elif rec.get("phase") == "slow" or (
+                    self.slow_after_s is not None
+                    and age > self.slow_after_s):
+                out[p] = "slow"
+            else:
+                out[p] = "ok"
+        return out
+
+    def slow_hosts(self) -> List[int]:
+        return [p for p, v in self.verdicts().items() if v == "slow"]
 
     def check(self) -> None:
         """Raise :class:`HostLost` for the first newly-stale host (one

@@ -131,6 +131,7 @@ def run_agd_supervised(
     place_w: Optional[Callable] = None,
     heartbeat=None,
     monitor=None,
+    scheduler=None,
     seg_cache: Optional[dict] = None,
     stream_iterations: bool = True,
     sleep: Callable[[float], None] = time.sleep,
@@ -180,11 +181,29 @@ def run_agd_supervised(
     peer raises ``HostLost``, which classifies TRANSIENT — retried with
     backoff here, and resumable onto a changed topology by a relaunch
     (``DistributedCheckpointer.load_for_topology``).
+
+    ``scheduler`` (a :class:`~spark_agd_tpu.resilience.scheduler.
+    StragglerScheduler`): the straggler feedback loop.  Each successful
+    segment's HOST-LOCAL boundary seconds (where chaos ``slow_host``
+    sleeps and real per-host work land — in lockstep SPMD the coupled
+    segment timings tie) feed ``scheduler.after_segment``; a returned
+    :class:`~spark_agd_tpu.resilience.scheduler.RebalanceDecision` is
+    applied AT THE GENERATION BOUNDARY: the staged data args are
+    swapped for the rebuilt assignment and the checkpointer
+    force-commits a generation carrying the new partition list, so a
+    crash mid-rebalance resumes consistently from either side of the
+    commit.  ``scheduler=None`` leaves this path untouched
+    (bit-identical to a plain supervised run — pinned by tests).
     """
     if w0 is None or config is None:
         raise ValueError("w0 and config are required")
     if staged is None and smooth is None:
         raise ValueError("pass smooth=... or staged=(build, data_args)")
+    if scheduler is not None and getattr(scheduler, "rebuild", None) \
+            is not None and staged is None:
+        raise ValueError(
+            "scheduler rebalancing swaps the staged data arguments: "
+            "pass staged=(build, data_args), not a closure smooth")
     policy = policy or ResiliencePolicy()
     w0 = jax.tree_util.tree_map(np.asarray, w0)
     if place_w is not None:
@@ -274,6 +293,13 @@ def run_agd_supervised(
                 checkpointer.install_signal_handlers()
                 checkpointer.update(warm, hist)  # generation zero / post-resume
 
+            if faults is not None and heartbeat is not None \
+                    and hasattr(faults, "bind_heartbeat"):
+                # injected slow_host sleeps beat the heartbeat in
+                # sub-intervals (chaos.ChaosSchedule), so a monitor
+                # classifies the host SLOW rather than LOST
+                faults.bind_heartbeat(heartbeat)
+
             schedule = policy.backoff_schedule()
             ledger: List[dict] = []
             attempt_no = 0
@@ -353,6 +379,7 @@ def run_agd_supervised(
                                  or faults is not None
                                  or monitor is not None) else None)
                         hook_exc: Optional[BaseException] = None
+                        t_bnd = time.perf_counter()
                         with boundary_span if boundary_span is not None \
                                 else contextlib.nullcontext():
                             if heartbeat is not None:
@@ -371,6 +398,7 @@ def run_agd_supervised(
                                             status="error",
                                             error=(f"{type(e).__name__}"
                                                    f": {e}"))
+                        boundary_dt = time.perf_counter() - t_bnd
                         if hook_exc is not None:
                             e = hook_exc
                             attempt_no += 1
@@ -497,6 +525,29 @@ def run_agd_supervised(
                         if checkpointer is not None:
                             checkpointer.update(warm, hist,
                                                 converged=converged)
+                        if scheduler is not None and not converged \
+                                and done > 0:
+                            decision = scheduler.after_segment(
+                                start_iter=start, iters=done,
+                                boundary_s=boundary_dt, segment_s=dt)
+                            if decision is not None:
+                                # generation-boundary rebalance: swap
+                                # the staged data for the rebuilt
+                                # assignment, then commit a generation
+                                # that CARRIES it — a crash on either
+                                # side of the commit resumes from a
+                                # self-consistent assignment
+                                new_staged = scheduler.apply(
+                                    decision, checkpointer=checkpointer)
+                                if new_staged is not None:
+                                    staged = new_staged
+                                    if getattr(scheduler, "retrace",
+                                               False):
+                                        seg_fns.clear()
+                                if checkpointer is not None:
+                                    checkpointer.update(
+                                        warm, hist, converged=converged,
+                                        force=True)
                         if converged or done == 0:
                             break
             finally:

@@ -1,0 +1,910 @@
+"""Straggler-aware scheduling (``resilience.scheduler``): the weighted
+re-split math, the skew tracker's hysteresis, the supervisor feedback
+loop, persistent chaos faults, the slow-vs-lost monitor split, the
+perfgate rebalance gate, speculation bit-safety, and the drill.
+
+Everything here is CPU-deterministic tier-1 except the reduced
+2-process drill smoke (marked ``dist_fault`` like its siblings).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu.obs import Telemetry, perfgate, schema
+from spark_agd_tpu.resilience import scheduler as sched_lib
+from spark_agd_tpu.resilience.scheduler import (
+    RebalanceDecision,
+    ReschedulePolicy,
+    SkewTracker,
+    StragglerScheduler,
+    assign_weighted,
+    modeled_makespan,
+    resolve_speculation,
+    run_speculative_segment,
+    speculation_due,
+    uniform_counts,
+    weighted_counts,
+)
+
+pytestmark = pytest.mark.sched
+
+
+# ---------------------------------------------------------------------------
+# the weighted re-split math (property-style)
+
+
+class TestWeightedCounts:
+    def _speed_cases(self):
+        rng = np.random.default_rng(7)
+        cases = [
+            [1.0, 1.0], [1.0, 0.2], [0.2, 1.0], [1.0, 1000.0],
+            [1e-6, 1.0, 1.0], [1.0] * 8 + [0.001],
+            [5.0, 1.0, 0.5, 0.1],
+        ]
+        for _ in range(20):
+            n = int(rng.integers(2, 7))
+            cases.append(list(np.exp(rng.normal(0.0, 1.5, n))))
+        return cases
+
+    def test_covers_exactly_and_respects_floor(self):
+        rng = np.random.default_rng(3)
+        for speeds in self._speed_cases():
+            for parts in (0, 1, 3, 7, 12, 40,
+                          int(rng.integers(1, 64))):
+                for floor in (0, 1, 2):
+                    counts = weighted_counts(parts, speeds,
+                                             min_shard=floor)
+                    assert sum(counts) == parts
+                    eff = min(floor, parts // len(speeds))
+                    assert all(c >= eff for c in counts)
+
+    def test_never_worse_than_uniform(self):
+        for speeds in self._speed_cases():
+            for parts in (1, 5, 12, 37):
+                counts = weighted_counts(parts, speeds, min_shard=1)
+                assert (modeled_makespan(counts, speeds)
+                        <= modeled_makespan(
+                            uniform_counts(parts, len(speeds)),
+                            speeds) + 1e-12)
+
+    def test_strictly_better_for_skewed_fleet(self):
+        speeds = [1.0, 0.2]
+        counts = weighted_counts(12, speeds, min_shard=1)
+        assert counts == [10, 2]
+        assert (modeled_makespan(counts, speeds)
+                < modeled_makespan(uniform_counts(12, 2), speeds))
+
+    def test_min_shard_zero_starves_dead_weight(self):
+        assert weighted_counts(12, [1.0, 0.001],
+                               min_shard=0) == [12, 0]
+
+    def test_deterministic(self):
+        speeds = [1.3, 0.7, 0.7]
+        assert (weighted_counts(11, speeds, min_shard=1)
+                == weighted_counts(11, speeds, min_shard=1))
+
+    def test_zero_speed_clamped_not_crash(self):
+        counts = weighted_counts(6, [1.0, 0.0], min_shard=1)
+        assert sum(counts) == 6 and counts[1] >= 1
+
+    def test_fewer_parts_than_hosts(self):
+        counts = weighted_counts(2, [1.0, 1.0, 1.0], min_shard=1)
+        assert sum(counts) == 2 and all(c >= 0 for c in counts)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            weighted_counts(3, [])
+        with pytest.raises(ValueError):
+            weighted_counts(-1, [1.0])
+
+
+class TestAssignWeighted:
+    def test_partition_coverage_exactly_once(self):
+        union = [f"part-{i:02d}" for i in range(13)]
+        table = assign_weighted(union, [1.0, 0.25, 1.0], min_shard=1)
+        flat = [p for row in table for p in row]
+        assert sorted(flat) == sorted(union)
+        assert len(flat) == len(set(flat)) == 13
+
+    def test_matches_round_robin_rule_counts_when_balanced(self):
+        union = [f"p{i}" for i in range(10)]
+        table = assign_weighted(union, [1.0, 1.0, 1.0], min_shard=1)
+        assert sorted(len(r) for r in table) == sorted(
+            uniform_counts(10, 3))
+
+    def test_deterministic_across_hosts(self):
+        union = [f"p{i}" for i in range(9)]
+        speeds = [0.9, 2.0]
+        assert assign_weighted(union, speeds) == assign_weighted(
+            union, speeds)
+
+
+# ---------------------------------------------------------------------------
+# skew tracker + hysteresis
+
+
+class TestSkewTracker:
+    def test_ewma_math(self):
+        t = SkewTracker(alpha=0.5, floor_s=1e-9)
+        t.observe(0, 1.0)
+        t.observe(0, 0.0)
+        assert t.costs()[0] == pytest.approx(0.5)
+
+    def test_skew_and_speeds(self):
+        t = SkewTracker(floor_s=1e-3)
+        t.fold({0: 0.001, 1: 0.4, 2: 0.001})
+        assert t.straggler() == 1
+        assert t.skew() == pytest.approx(400.0)
+        sp = t.speeds()
+        assert sp[0] == pytest.approx(1.0) and sp[1] < 0.01
+
+    def test_floor_makes_idle_fleet_balanced(self):
+        t = SkewTracker(floor_s=1e-3)
+        t.fold({0: 0.0001, 1: 0.0004})
+        assert t.skew() == pytest.approx(1.0)
+        assert t.straggler() is None
+
+    def test_blip_does_not_persist(self):
+        t = SkewTracker(alpha=1.0, skew_threshold=1.5,
+                        trigger_segments=2)
+        assert not t.fold({0: 0.001, 1: 0.5}).persistent
+        snap = t.fold({0: 0.001, 1: 0.001})  # the blip cleared
+        assert snap.consecutive == 0 and not snap.persistent
+
+    def test_consecutive_same_straggler_triggers(self):
+        t = SkewTracker(alpha=1.0, skew_threshold=1.5,
+                        trigger_segments=2)
+        t.fold({0: 0.001, 1: 0.5})
+        snap = t.fold({0: 0.001, 1: 0.5})
+        assert snap.consecutive == 2 and snap.persistent
+        assert snap.straggler == 1
+
+    def test_straggler_change_resets_counter(self):
+        t = SkewTracker(alpha=1.0, skew_threshold=1.5,
+                        trigger_segments=3)
+        t.fold({0: 0.001, 1: 0.5})
+        snap = t.fold({0: 0.5, 1: 0.001})
+        assert snap.straggler == 0 and snap.consecutive == 1
+
+    def test_observe_heartbeats(self, tmp_path):
+        from spark_agd_tpu.resilience.distributed import heartbeat_name
+
+        d = str(tmp_path)
+        for p, phase in ((0, "segment"), (1, "slow")):
+            with open(os.path.join(d, heartbeat_name(p)), "w") as f:
+                json.dump({"process": p, "time": 0.0,
+                           "phase": phase}, f)
+        t = SkewTracker()
+        seen = t.observe_heartbeats(d)
+        assert set(seen) == {0, 1}
+        assert t.hb_slow == [1]
+        assert all(a >= 0 for a in t.hb_ages.values())
+
+
+# ---------------------------------------------------------------------------
+# the scheduler object (fake exchange — no collectives needed)
+
+
+def _two_host_exchange(slow_us=400000):
+    """An exchange stub: this host's row plus a fabricated slow peer."""
+    def exchange(row):
+        other = row.copy()
+        other[1] = slow_us
+        return np.stack([row, other])
+    return exchange
+
+
+def _drive(scheduler, segments, boundary_s=0.0002, start=0, k=4):
+    decision = None
+    for i in range(segments):
+        decision = scheduler.after_segment(
+            start_iter=start + i * k, iters=k, boundary_s=boundary_s)
+        if decision is not None:
+            break
+    return decision
+
+
+class TestStragglerScheduler:
+    def _mk(self, tel=None, **pol):
+        policy = ReschedulePolicy(**{"trigger_segments": 2,
+                                     "min_shard": 0, **pol})
+        return StragglerScheduler(
+            [f"p{i:02d}" for i in range(12)], policy=policy,
+            telemetry=tel, process_index=0, process_count=2,
+            exchange=_two_host_exchange())
+
+    def test_initial_assignment_is_round_robin(self):
+        s = self._mk()
+        union = sorted(f"p{i:02d}" for i in range(12))
+        assert list(s.assignment) == union[0::2]
+        assert list(s.assignments[1]) == union[1::2]
+
+    def test_decides_after_trigger_syncs(self):
+        tel = Telemetry()
+        s = self._mk(tel)
+        d = _drive(s, 4)
+        assert isinstance(d, RebalanceDecision)
+        assert d.at_iter == 8 and d.before == (6, 6)
+        assert d.after[0] > d.after[1] and sum(d.after) == 12
+        assert d.straggler == 1 and d.moved >= 1
+        kinds = [r["kind"] for r in tel.records]
+        assert kinds.count("skew_estimate") == 2
+
+    def test_apply_updates_state_and_emits(self):
+        tel = Telemetry()
+        s = self._mk(tel)
+        d = _drive(s, 4)
+        rebuilt = []
+        s.rebuild = lambda dec: rebuilt.append(dec.mine) or "staged!"
+        assert s.apply(d) is None or True  # rebuild return forwarded
+        assert s.assignments == d.assignments
+        assert s.rebalances == 1
+        assert rebuilt == [d.mine]
+        recs = {r["kind"] for r in tel.records}
+        assert "rebalance" in recs
+        actions = [r["action"] for r in tel.records
+                   if r["kind"] == "recovery"]
+        assert actions == ["rebalance"]
+        assert not any(schema.validate_record(r)
+                       for r in tel.records)
+
+    def test_same_assignment_suppressed(self):
+        s = self._mk()
+        d = _drive(s, 4)
+        s.apply(d)
+        # skew persists, but the weighted table is already in place:
+        # no repeated decision, hysteresis re-arms instead
+        assert _drive(s, 6, start=d.at_iter) is None or \
+            s.policy.max_rebalances > 1
+
+    def test_max_rebalances_cap(self):
+        s = self._mk(max_rebalances=0)
+        assert _drive(s, 6) is None
+
+    def test_observe_only_policy(self):
+        tel = Telemetry()
+        s = self._mk(tel, rebalance=False)
+        assert _drive(s, 6) is None
+        assert any(r["kind"] == "skew_estimate" for r in tel.records)
+
+    def test_lockstep_mismatch_refused(self):
+        def bad_exchange(row):
+            other = row.copy()
+            other[0] = row[0] + 4  # a host at a different iteration
+            return np.stack([row, other])
+        s = StragglerScheduler(
+            ["a", "b"], policy=ReschedulePolicy(),
+            process_index=0, process_count=2, exchange=bad_exchange)
+        with pytest.raises(RuntimeError, match="lockstep"):
+            s.after_segment(start_iter=0, iters=4, boundary_s=0.001)
+
+    def test_single_process_identity_never_triggers(self):
+        s = StragglerScheduler(
+            ["a", "b", "c"],
+            policy=ReschedulePolicy(trigger_segments=1),
+            process_index=0, process_count=1)
+        for i in range(4):
+            assert s.after_segment(start_iter=i * 4, iters=4,
+                                   boundary_s=0.5) is None
+
+    def test_policy_validation(self):
+        for bad in (dict(skew_threshold=0.5),
+                    dict(trigger_segments=0), dict(sync_every=0),
+                    dict(min_shard=-1), dict(speculative_multiple=1.0),
+                    dict(ewma_alpha=0.0), dict(floor_s=0.0)):
+            with pytest.raises(ValueError):
+                ReschedulePolicy(**bad)
+
+
+# ---------------------------------------------------------------------------
+# supervisor integration (single process, real compiled segments)
+
+
+@pytest.fixture(scope="module")
+def staged_problem(cpu_devices):
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((96, 5)).astype(np.float64)
+    w_true = np.linspace(-1.0, 1.0, 5)
+    y = (X @ w_true > 0).astype(np.float64)
+    build, dargs = smooth_lib.make_smooth_staged(
+        LogisticGradient(), X, y)
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    w0 = np.zeros(5, np.float64)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=24)
+    return dict(build=build, dargs=dargs, px=px, rv=rv, w0=w0,
+                cfg=cfg, seg_cache={})
+
+
+def _supervised(sp, **kw):
+    from spark_agd_tpu.resilience import (ResiliencePolicy,
+                                          run_agd_supervised)
+
+    return run_agd_supervised(
+        prox=sp["px"], reg_value=sp["rv"], w0=sp["w0"],
+        config=sp["cfg"],
+        policy=ResiliencePolicy(segment_iters=4, max_attempts=2,
+                                backoff_base=0.01, jitter=0.0, seed=0),
+        staged=(sp["build"], sp["dargs"]),
+        seg_cache=sp["seg_cache"], stream_iterations=False, **kw)
+
+
+class TestSupervisorIntegration:
+    def test_scheduling_off_is_bit_identical(self, staged_problem):
+        plain = _supervised(staged_problem)
+        again = _supervised(staged_problem, scheduler=None)
+        assert np.array_equal(np.asarray(plain.weights),
+                              np.asarray(again.weights))
+
+    def test_observe_only_scheduler_bit_identical_no_retrace(
+            self, staged_problem):
+        plain = _supervised(staged_problem)
+        keys = set(staged_problem["seg_cache"])
+        tel = Telemetry()
+        s = StragglerScheduler(
+            [f"p{i}" for i in range(8)],
+            policy=ReschedulePolicy(rebalance=False),
+            telemetry=tel, process_index=0, process_count=2,
+            exchange=_two_host_exchange())
+        res = _supervised(staged_problem, telemetry=tel, scheduler=s)
+        # the compiled program is untouched: the shared segment cache
+        # gained no keys, and the trajectory is bit-identical
+        assert set(staged_problem["seg_cache"]) == keys
+        assert np.array_equal(np.asarray(plain.weights),
+                              np.asarray(res.weights))
+        assert any(r["kind"] == "skew_estimate" for r in tel.records)
+        assert not any(r["kind"] == "rebalance" for r in tel.records)
+
+    def test_rebalance_applied_at_generation_boundary(
+            self, staged_problem, tmp_path):
+        from spark_agd_tpu.resilience import DistributedCheckpointer
+        from spark_agd_tpu.resilience.manifest import (
+            committed_generations, load_manifest)
+
+        plain = _supervised(staged_problem)
+        tel = Telemetry()
+        rebuilt = []
+
+        def rebuild(decision):
+            rebuilt.append(decision.mine)
+            # same data: the rebalance machinery must not perturb math
+            return (staged_problem["build"], staged_problem["dargs"])
+
+        s = StragglerScheduler(
+            [f"p{i}" for i in range(8)],
+            policy=ReschedulePolicy(trigger_segments=2, min_shard=1),
+            telemetry=tel, process_index=0, process_count=2,
+            exchange=_two_host_exchange(), rebuild=rebuild)
+        ck = DistributedCheckpointer(
+            str(tmp_path / "ck"), every_iters=4, keep=32,
+            telemetry=tel, process_index=0, process_count=1,
+            partitions=[f"p{i}" for i in range(0, 8, 2)])
+        res = _supervised(staged_problem, telemetry=tel, scheduler=s,
+                          checkpointer=ck)
+        assert np.array_equal(np.asarray(plain.weights),
+                              np.asarray(res.weights))
+        assert s.rebalances == 1 and len(rebuilt) == 1
+        # the checkpointer's NEXT generation carries the new list
+        assert ck.partitions == list(s.assignment)
+        # the forced commit landed: one generation records the
+        # rebalanced assignment (shards carry "partitions")
+        gens = committed_generations(str(tmp_path / "ck"))
+        assert len(gens) >= 2
+        newest = load_manifest(str(tmp_path / "ck"), gens[0])
+        assert newest is not None
+        recs = [r for r in tel.records if r.get("kind") == "rebalance"]
+        assert len(recs) == 1 and recs[0]["at_iter"] == 8
+        assert not any(schema.validate_record(r) for r in tel.records)
+
+    def test_rebuild_requires_staged(self, staged_problem):
+        from spark_agd_tpu.core import smooth as smooth_lib
+        from spark_agd_tpu.ops.losses import LogisticGradient
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((8, 5))
+        y = (X.sum(axis=1) > 0).astype(np.float64)
+        sm = smooth_lib.make_smooth(LogisticGradient(), X, y)
+        from spark_agd_tpu.resilience import (ResiliencePolicy,
+                                              run_agd_supervised)
+
+        s = StragglerScheduler(["a"], rebuild=lambda d: None,
+                               process_index=0, process_count=1)
+        with pytest.raises(ValueError, match="staged"):
+            run_agd_supervised(
+                smooth=sm, prox=staged_problem["px"],
+                reg_value=staged_problem["rv"],
+                w0=staged_problem["w0"], config=staged_problem["cfg"],
+                policy=ResiliencePolicy(segment_iters=4),
+                scheduler=s)
+
+
+# ---------------------------------------------------------------------------
+# persistent chaos faults + heartbeat sub-beats
+
+
+class TestPersistentSlowHost:
+    def test_fires_every_boundary_with_decay(self):
+        from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                    ScheduledFault)
+
+        naps = []
+        s = ChaosSchedule(
+            [ScheduledFault("slow_host", 4, payload=1.0,
+                            persist=True, decay=0.5)],
+            sleep=naps.append)
+        s.before_segment(0)   # not armed yet
+        s.before_segment(4)
+        s.before_segment(8)
+        s.before_segment(12)
+        assert naps == [1.0, 0.5, 0.25]
+        assert [f[0] for f in s.fired] == ["slow_host"] * 3
+        assert s.exhausted  # persistent faults never count against it
+
+    def test_slow_scale_hook_and_quiet_when_zero(self):
+        from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                    ScheduledFault)
+
+        naps = []
+        scale = [1.0]
+        tel = Telemetry()
+        s = ChaosSchedule(
+            [ScheduledFault("slow_host", 0, payload=0.5,
+                            persist=True)],
+            sleep=naps.append, slow_scale=lambda: scale[0],
+            telemetry=tel)
+        s.before_segment(0)
+        scale[0] = 0.0  # the rebalance stripped this host's data
+        s.before_segment(4)
+        assert naps == [0.5]
+        chaos = [r for r in tel.records if r["kind"] == "chaos"]
+        assert len(chaos) == 1 and chaos[0]["payload"] == 0.5
+
+    def test_one_shot_slow_host_unchanged(self):
+        from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                    ScheduledFault)
+
+        naps = []
+        s = ChaosSchedule(
+            [ScheduledFault("slow_host", 2, payload=0.03)],
+            sleep=naps.append)
+        s.before_segment(3)
+        s.before_segment(7)
+        assert naps == [0.03] and s.exhausted
+
+    def test_sub_interval_beats_during_sleep(self):
+        from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                    ScheduledFault)
+
+        naps, beats = [], []
+
+        class FakeHB:
+            def beat(self, **kw):
+                beats.append(kw)
+
+        s = ChaosSchedule(
+            [ScheduledFault("slow_host", 0, payload=1.0,
+                            persist=True)],
+            sleep=naps.append, beat_interval_s=0.25)
+        s.bind_heartbeat(FakeHB())
+        s.before_segment(0)
+        assert naps == [0.25] * 4
+        assert len(beats) == 4
+        assert all(b["phase"] == "slow" for b in beats)
+
+    def test_persist_validation(self):
+        from spark_agd_tpu.resilience.chaos import ScheduledFault
+
+        with pytest.raises(ValueError, match="slow_host"):
+            ScheduledFault("sigterm", 4, persist=True)
+        with pytest.raises(ValueError, match="decay"):
+            ScheduledFault("slow_host", 4, decay=0.0)
+
+    def test_generate_draws_persistent_and_stays_normalized(self):
+        from spark_agd_tpu.resilience.chaos import (FILE_KINDS,
+                                                    ChaosCampaign)
+
+        n_persist = 0
+        for seed in range(150):
+            c = ChaosCampaign.generate(seed, iters=48)
+            assert c == ChaosCampaign.generate(seed, iters=48)
+            kinds = [f.kind for f in c.faults]
+            assert kinds.count("nan") <= 2
+            for f in c.faults:
+                assert 2 <= f.at_iter < 48 * 0.7 + 1
+                if f.persist:
+                    assert f.kind == "slow_host"
+                    assert 0 < f.decay < 1.0
+                    n_persist += 1
+            first_file = next((i for i, k in enumerate(kinds)
+                               if k in FILE_KINDS), None)
+            if first_file is not None:
+                assert "sigterm" in kinds[:first_file]
+        assert n_persist >= 5  # the degraded-host leg actually draws
+
+
+class TestMonitorVerdicts:
+    def _pair(self, tmp_path, stale=2.0, slow_after=None):
+        from spark_agd_tpu.resilience.distributed import (
+            HeartbeatWriter, HostMonitor)
+
+        now = [0.0]
+        hb = HeartbeatWriter(str(tmp_path), process_index=1,
+                             process_count=2, clock=lambda: now[0])
+        mon = HostMonitor(str(tmp_path), stale_after_s=stale,
+                          slow_after_s=slow_after,
+                          clock=lambda: now[0])
+        return now, hb, mon
+
+    def test_fresh_segment_beat_is_ok(self, tmp_path):
+        now, hb, mon = self._pair(tmp_path)
+        hb.beat(iter=4, phase="segment")
+        assert mon.verdicts() == {1: "ok"}
+        mon.check()  # no raise
+
+    def test_slow_phase_beat_is_slow_not_lost(self, tmp_path):
+        now, hb, mon = self._pair(tmp_path)
+        hb.beat(iter=4, phase="slow")
+        now[0] = 1.5  # inside staleness
+        assert mon.verdicts() == {1: "slow"}
+        assert mon.slow_hosts() == [1]
+        mon.check()  # SLOW never raises
+
+    def test_age_based_slow_verdict(self, tmp_path):
+        now, hb, mon = self._pair(tmp_path, stale=4.0, slow_after=1.0)
+        hb.beat(iter=0, phase="segment")
+        now[0] = 2.0
+        assert mon.verdicts() == {1: "slow"}
+
+    def test_stale_is_lost_and_raises(self, tmp_path):
+        from spark_agd_tpu.resilience import HostLost
+
+        now, hb, mon = self._pair(tmp_path)
+        hb.beat(iter=4, phase="segment")
+        now[0] = 10.0
+        assert mon.verdicts() == {1: "lost"}
+        with pytest.raises(HostLost):
+            mon.check()
+
+    def test_long_injected_sleep_with_sub_beats_never_lost(
+            self, tmp_path):
+        """The misdiagnosis this PR fixes: a slow_host sleep LONGER
+        than the staleness window used to read as HostLost; with the
+        chaos sub-interval beats it reads SLOW throughout."""
+        from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                    ScheduledFault)
+
+        now, hb, mon = self._pair(tmp_path, stale=2.0)
+        hb.beat(iter=0, phase="segment")
+
+        verdicts = []
+
+        def fake_sleep(dt):  # the injected sleep advances fake time
+            now[0] += dt
+            verdicts.append(mon.verdicts().get(1))
+            mon.check()  # must never raise mid-sleep
+
+        s = ChaosSchedule(
+            [ScheduledFault("slow_host", 0, payload=6.0,
+                            persist=True)],
+            sleep=fake_sleep, beat_interval_s=0.5)
+        s.bind_heartbeat(hb)
+        s.before_segment(0)  # a 6 s sleep against a 2 s staleness
+        assert verdicts and all(v == "slow" for v in verdicts)
+
+        # the counterfactual: the SAME sleep without sub-beats IS lost
+        from spark_agd_tpu.resilience import HostLost
+
+        now[0] += 6.0
+        with pytest.raises(HostLost):
+            mon.check()
+
+    def test_slow_after_validation(self, tmp_path):
+        from spark_agd_tpu.resilience.distributed import HostMonitor
+
+        with pytest.raises(ValueError):
+            HostMonitor(str(tmp_path), stale_after_s=2.0,
+                        slow_after_s=3.0)
+
+
+# ---------------------------------------------------------------------------
+# perfgate: the rebalance-effectiveness gate
+
+
+def _boundary_span(it, proc, secs):
+    return {"schema_version": 1, "kind": "span", "run_id": "r",
+            "name": "boundary", "seconds": secs, "trace_id": "t1",
+            "span_id": f"s{it}-{proc}", "parent_id": None,
+            "process": proc, "status": "ok", "start_iter": it}
+
+
+def _gate_records(post_slow=0.0004):
+    recs = []
+    for it in (0, 4):
+        recs += [_boundary_span(it, 0, 0.0002),
+                 _boundary_span(it, 1, 0.4)]
+    for it in range(8, 40, 4):
+        recs += [_boundary_span(it, 0, 0.0002),
+                 _boundary_span(it, 1, post_slow)]
+    recs.append({"schema_version": 1, "kind": "recovery",
+                 "run_id": "r", "action": "rebalance", "from_iter": 8})
+    return recs
+
+
+class TestRebalanceGate:
+    def test_pass_when_post_score_drops(self):
+        g = perfgate.gate_rebalance(_gate_records(),
+                                    require_rebalance=True)
+        assert g.exit_code() == 0 and g.improved
+        assert g.pre_score > g.post_score
+        assert g.rebalance_iter == 8
+        assert "pass" in perfgate.format_rebalance_report(g)
+
+    def test_fail_when_rebalance_did_not_help(self):
+        g = perfgate.gate_rebalance(_gate_records(post_slow=0.5),
+                                    require_rebalance=True)
+        assert g.exit_code() == 1 and not g.improved
+
+    def test_refusal_without_spans_is_typed_exit_2(self):
+        recs = [{"schema_version": 1, "kind": "recovery",
+                 "run_id": "r", "action": "rebalance",
+                 "from_iter": 8}]
+        g = perfgate.gate_rebalance(recs, require_rebalance=True)
+        assert g.exit_code() == 2 and g.refusals
+        assert "REFUSED" in perfgate.format_rebalance_report(g)
+
+    def test_refusal_one_sided_samples(self):
+        recs = _gate_records()
+        recs = [r for r in recs
+                if not (r.get("kind") == "span"
+                        and r.get("start_iter", 99) < 8)]
+        g = perfgate.gate_rebalance(recs, require_rebalance=True)
+        assert g.exit_code() == 2
+
+    def test_no_rebalance_vacuous_pass_unless_required(self):
+        spans = [r for r in _gate_records() if r["kind"] == "span"]
+        assert perfgate.gate_rebalance(spans).exit_code() == 0
+        assert perfgate.gate_rebalance(
+            spans, require_rebalance=True).exit_code() == 2
+
+    def test_floor_silences_sub_ms_noise(self):
+        # post-side host 1 is 2x host 0 in MICROSECONDS — noise, not
+        # skew: the floor must keep post below pre
+        g = perfgate.gate_rebalance(_gate_records(post_slow=0.0008),
+                                    require_rebalance=True)
+        assert g.post_score == pytest.approx(1.0)
+        assert g.exit_code() == 0
+
+    def test_kind_rebalance_record_places_boundary_too(self):
+        recs = [r for r in _gate_records() if r["kind"] == "span"]
+        recs.append({"schema_version": 1, "kind": "rebalance",
+                     "run_id": "r", "at_iter": 8})
+        g = perfgate.gate_rebalance(recs, require_rebalance=True)
+        assert g.rebalance_iter == 8 and g.exit_code() == 0
+
+    def test_cli_single_file_mode(self, tmp_path):
+        path = tmp_path / "recs.jsonl"
+        with open(path, "w") as f:
+            for r in _gate_records():
+                f.write(json.dumps(r) + "\n")
+        from tools import perf_gate as cli
+
+        assert cli.main([str(path), "--rebalance"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# speculation
+
+
+class TestSpeculation:
+    def test_due_rule(self):
+        assert not speculation_due(1.0, 0.0, 3.0)  # no median yet
+        assert not speculation_due(0.2, 0.1, 3.0)
+        assert speculation_due(0.5, 0.1, 3.0)
+
+    def test_bit_identical_first_result_wins(self, staged_problem):
+        """The safety argument itself: the SAME compiled segment from
+        the SAME committed warm state is bit-identical, so taking
+        whichever of (primary, backup) lands first changes nothing."""
+        import dataclasses
+
+        import jax
+
+        from spark_agd_tpu.core import agd
+
+        sp = staged_problem
+        cfg4 = dataclasses.replace(sp["cfg"], num_iterations=4)
+        fn = sp["seg_cache"].get((4, False))
+        if fn is None:
+            def _seg(ws, da, c=cfg4):
+                sm, sl = sp["build"](*da)
+                return agd.run_agd(sm, sp["px"], sp["rv"], ws.x, c,
+                                   smooth_loss=sl, warm=ws)
+
+            # graftlint: disable=donation -- ws is the committed
+            # speculation anchor; a lost backup must leave it intact
+            fn = jax.jit(_seg)
+
+        def run_seg(ws, k):
+            res = fn(ws, sp["dargs"])
+            jax.block_until_ready(res.num_iters)
+            return res
+
+        warm = agd.AGDWarmState.initial(sp["w0"], sp["cfg"])
+        tel = Telemetry()
+        a = run_speculative_segment(run_seg, warm, 4)
+        b = run_speculative_segment(run_seg, warm, 4)
+        out = resolve_speculation(a, b.warm, fleet_seconds=999.0,
+                                  telemetry=tel, straggler=1)
+        assert out["matched"] and out["max_diff"] == 0.0
+        assert out["outcome"] == "won"
+        recs = [r for r in tel.records if r.get("kind") == "recovery"]
+        assert recs and recs[0]["action"] == "speculative_exec"
+        assert recs[0]["outcome"] == "won" and recs[0]["matched"]
+        assert not any(schema.validate_record(r) for r in tel.records)
+
+    def test_lost_outcome_and_mismatch_detected(self, staged_problem):
+        import jax
+
+        from spark_agd_tpu.core import agd
+
+        warm = agd.AGDWarmState.initial(staged_problem["w0"],
+                                        staged_problem["cfg"])
+        spec = run_speculative_segment(
+            lambda ws, k: _real_segment(staged_problem, ws), warm, 4)
+        other = spec.warm._replace(
+            x=jax.tree_util.tree_map(lambda a: a + 1e-3, spec.warm.x))
+        out = resolve_speculation(spec, other, fleet_seconds=0.0,
+                                  tol=1e-9)
+        assert not out["matched"] and out["outcome"] == "lost"
+
+
+def _real_segment(sp, ws):
+    import dataclasses
+
+    import jax
+
+    from spark_agd_tpu.core import agd
+
+    cfg4 = dataclasses.replace(sp["cfg"], num_iterations=4)
+
+    def _seg(w, da):
+        sm, sl = sp["build"](*da)
+        return agd.run_agd(sm, sp["px"], sp["rv"], w.x, cfg4,
+                           smooth_loss=sl, warm=w)
+
+    res = _seg(ws, sp["dargs"])
+    jax.block_until_ready(res.num_iters)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# schema + telemetry + report
+
+
+class TestSchemaAndReport:
+    def test_new_kinds_in_selfcheck(self):
+        ok, msgs = schema.selfcheck()
+        assert ok, msgs
+
+    def test_examples_validate(self):
+        assert not schema.validate_record(
+            schema.EXAMPLE_SKEW_ESTIMATE_RECORD)
+        assert not schema.validate_record(
+            schema.EXAMPLE_REBALANCE_RECORD)
+
+    def test_telemetry_helpers(self):
+        tel = Telemetry()
+        tel.skew_estimate(skew=2.5, speeds={"0": 1.0, "1": 0.4},
+                          straggler=1)
+        tel.rebalance(at_iter=12, before={"0": 6, "1": 6},
+                      after={"0": 11, "1": 1}, moved=5)
+        assert tel.registry.snapshot()["sched.skew"] == 2.5
+        assert tel.registry.snapshot()["sched.rebalances"] == 1
+        assert not any(schema.validate_record(r) for r in tel.records)
+
+    def test_recovery_actions_registered(self):
+        assert "rebalance" in schema.RECOVERY_ACTIONS
+        assert "speculative_exec" in schema.RECOVERY_ACTIONS
+
+    def test_report_scheduling_section(self, tmp_path, capsys):
+        tel = Telemetry()
+        tel.skew_estimate(skew=4.8, speeds={"0": 1.0, "1": 0.2},
+                          straggler=1, consecutive=2)
+        tel.rebalance(at_iter=12, before={"0": 6, "1": 6},
+                      after={"0": 11, "1": 1}, moved=5)
+        tel.recovery(action="speculative_exec", outcome="won",
+                     matched=True, from_iter=4, iters=4)
+        path = tmp_path / "sched.jsonl"
+        with open(path, "w") as f:
+            for r in tel.records:
+                f.write(json.dumps(r) + "\n")
+        from tools import agd_report
+
+        assert agd_report.main(["--scheduling", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "scheduling" in out and "1w/0l" in out
+        assert "h1=0.2" in out
+
+        assert agd_report.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "== scheduling" in out
+
+
+# ---------------------------------------------------------------------------
+# ingest: explicit assignment + pinned padding
+
+
+class TestIngestAssignment:
+    @pytest.fixture()
+    def parts(self, tmp_path):
+        from spark_agd_tpu.data import libsvm
+
+        rng = np.random.default_rng(2)
+        paths = []
+        for k in range(4):
+            X = rng.standard_normal((5, 3)).astype(np.float32)
+            y = np.where(X.sum(axis=1) > 0, 1.0, -1.0)
+            p = str(tmp_path / f"part-{k}.libsvm")
+            libsvm.save_libsvm(p, X, y)
+            paths.append(p)
+        return paths
+
+    def test_explicit_assignment_reads_subset(self, parts,
+                                              cpu_devices):
+        from spark_agd_tpu.data import ingest
+        from spark_agd_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_mesh({"data": 1})
+        batch = ingest.from_partitioned_files(
+            parts, mesh, n_features=3, assignment=parts[:2])
+        # 2 partitions x 5 rows each (single-process: no padding
+        # needed on a 1-device axis, so the mask may be None)
+        assert np.asarray(batch.X).shape[0] == 10
+        if batch.mask is not None:
+            assert int(np.asarray(batch.mask).sum()) == 10
+
+    def test_assignment_subset_changes_the_objective_data(
+            self, parts, cpu_devices):
+        from spark_agd_tpu.data import ingest
+        from spark_agd_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_mesh({"data": 1})
+        full = ingest.from_partitioned_files(parts, mesh,
+                                             n_features=3)
+        sub = ingest.from_partitioned_files(
+            parts, mesh, n_features=3, assignment=parts[:1])
+        assert np.asarray(full.X).shape[0] == 20
+        assert np.asarray(sub.X).shape[0] == 5
+        assert np.allclose(np.asarray(sub.X),
+                           np.asarray(full.X)[:5])
+
+
+# ---------------------------------------------------------------------------
+# the drill (reduced smoke — real 2-process gloo)
+
+
+@pytest.mark.dist_fault
+class TestStragglerDrill:
+    def test_reduced_drill_passes(self, tmp_path):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        drill = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "straggler_drill.py")
+        proc = subprocess.run(
+            [sys.executable, drill, "--parts", "8", "--rows", "8",
+             "--iters", "48", "--segment", "4", "--max-ratio", "2.5",
+             "--out", str(tmp_path)],
+            capture_output=True, text=True, timeout=360, env=env)
+        assert proc.returncode == 0, \
+            f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n{proc.stderr[-2000:]}"
+        assert "STRAGGLER DRILL PASSED" in proc.stdout

@@ -67,7 +67,7 @@ def _kind(rec: dict) -> Optional[str]:
     if k in ("run", "iteration", "span", "metrics", "attempt",
              "recovery", "numerics_failure", "contract_pin",
              "serve_request", "serve_latency", "trace_summary",
-             "scaling_curve"):
+             "scaling_curve", "skew_estimate", "rebalance"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -373,6 +373,58 @@ def summarize_scaling(curves: List[dict]) -> str:
     return "\n\n".join(blocks)
 
 
+def summarize_scheduling(skews: List[dict], rebalances: List[dict],
+                         recoveries: List[dict]) -> str:
+    """The straggler-scheduling rollup (``skew_estimate`` /
+    ``rebalance`` records plus ``speculative_exec`` recovery actions
+    from ``resilience.scheduler``): per run — the latest per-host
+    speed estimates, every rebalance with its before/after partition
+    counts, and the speculation won/lost census."""
+    per_run: Dict[str, dict] = defaultdict(
+        lambda: {"skews": 0, "last": None, "max_skew": None,
+                 "rebalances": [], "spec_won": 0, "spec_lost": 0})
+    for rec in skews:
+        e = per_run[rec.get("run_id", "-")]
+        e["skews"] += 1
+        e["last"] = rec  # file order: keep the newest
+        s = rec.get("skew")
+        if isinstance(s, (int, float)) and not isinstance(s, bool):
+            e["max_skew"] = s if e["max_skew"] is None \
+                else max(e["max_skew"], s)
+    for rec in rebalances:
+        per_run[rec.get("run_id", "-")]["rebalances"].append(rec)
+    for rec in recoveries:
+        if rec.get("action") != "speculative_exec":
+            continue
+        e = per_run[rec.get("run_id", "-")]
+        e["spec_won" if rec.get("outcome") == "won"
+          else "spec_lost"] += 1
+    headers = ["run_id", "skew_syncs", "last_skew", "max_skew",
+               "speeds", "rebalances", "speculative"]
+    rows = []
+    for run_id, e in sorted(per_run.items()):
+        last = e["last"] or {}
+        speeds = last.get("speeds") or {}
+        speeds_s = " ".join(
+            f"h{p}={_fmt(v, 3)}" for p, v in sorted(speeds.items())) \
+            or "-"
+        reb_s = "; ".join(
+            (f"@{r.get('at_iter', '?')} "
+             + "->".join(
+                 "[" + ",".join(str(c) for _, c in sorted(
+                     (d or {}).items())) + "]"
+                 for d in (r.get("before"), r.get("after"))))
+            for r in e["rebalances"]) or "-"
+        spec = (f"{e['spec_won']}w/{e['spec_lost']}l"
+                if e["spec_won"] or e["spec_lost"] else "-")
+        rows.append([
+            _fmt(run_id)[:18], str(e["skews"]),
+            _fmt(last.get("skew")), _fmt(e["max_skew"]),
+            speeds_s, reb_s, spec,
+        ])
+    return _table(headers, rows)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -463,6 +515,11 @@ def main(argv=None) -> int:
                    help="print only the == scaling == rollup "
                         "(scaling_curve records; the gate lives in "
                         "tools/agd_bench.py)")
+    p.add_argument("--scheduling", action="store_true",
+                   help="print only the == scheduling == rollup "
+                        "(skew_estimate/rebalance records and "
+                        "speculative executions; the gate lives in "
+                        "tools/perf_gate.py --rebalance)")
     args = p.parse_args(argv)
 
     if args.compare:
@@ -481,6 +538,7 @@ def main(argv=None) -> int:
     runs, spans = [], []
     attempts, recoveries, numerics, pins = [], [], [], []
     serve_reqs, serve_lats, curves = [], [], []
+    skews, rebalances = [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -505,8 +563,24 @@ def main(argv=None) -> int:
             serve_lats.append(rec)
         elif k == "scaling_curve":
             curves.append(rec)
+        elif k == "skew_estimate":
+            skews.append(rec)
+        elif k == "rebalance":
+            rebalances.append(rec)
         elif k is None:
             unknown += 1
+
+    spec_recs = [r for r in recoveries
+                 if r.get("action") == "speculative_exec"]
+    if args.scheduling:
+        if not (skews or rebalances or spec_recs):
+            print("no scheduling records found", file=sys.stderr)
+            return 1
+        print(f"== scheduling ({len(skews)} skew syncs, "
+              f"{len(rebalances)} rebalances, {len(spec_recs)} "
+              f"speculative executions) ==")
+        print(summarize_scheduling(skews, rebalances, recoveries))
+        return 0
 
     if args.scaling:
         if not curves:
@@ -544,6 +618,11 @@ def main(argv=None) -> int:
     if curves:
         print(f"\n== scaling ({len(curves)} ladder(s)) ==")
         print(summarize_scaling(curves))
+    if skews or rebalances or spec_recs:
+        print(f"\n== scheduling ({len(skews)} skew syncs, "
+              f"{len(rebalances)} rebalances, {len(spec_recs)} "
+              f"speculative executions) ==")
+        print(summarize_scheduling(skews, rebalances, recoveries))
     tracing = summarize_tracing(records, recoveries, args.trace)
     if tracing:
         print("\n== tracing ==")

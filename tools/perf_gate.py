@@ -53,7 +53,14 @@ def main(argv=None) -> int:
         prog="python -m tools.perf_gate",
         description=__doc__.splitlines()[0])
     p.add_argument("baseline", metavar="BASELINE.jsonl")
-    p.add_argument("candidate", metavar="CANDIDATE.jsonl")
+    p.add_argument("candidate", metavar="CANDIDATE.jsonl", nargs="?")
+    p.add_argument("--rebalance", action="store_true",
+                   help="single-file mode: gate rebalance "
+                        "effectiveness over BASELINE.jsonl's records "
+                        "(a run carrying rebalance actions must show "
+                        "its post-rebalance straggler score below the "
+                        "pre-rebalance value; exit 2 when the "
+                        "boundary spans are missing)")
     p.add_argument("--threshold", action="append", metavar="NAME=REL",
                    help="override one metric's relative threshold "
                         "(repeatable); 'collectives' is an ABSOLUTE "
@@ -70,6 +77,22 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from spark_agd_tpu.obs import perfgate
+
+    if args.rebalance:
+        if args.candidate is not None:
+            p.error("--rebalance is single-file: pass only RECORDS.jsonl")
+        try:
+            records = perfgate.load_records(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: cannot read records: {e}",
+                  file=sys.stderr)
+            return 2
+        result = perfgate.gate_rebalance(records,
+                                         require_rebalance=True)
+        print(perfgate.format_rebalance_report(result))
+        return result.exit_code()
+    if args.candidate is None:
+        p.error("CANDIDATE.jsonl is required (unless --rebalance)")
 
     thresholds = _parse_thresholds(args.threshold, p)
     try:

@@ -1,0 +1,666 @@
+#!/usr/bin/env python
+"""The STRAGGLER drill — CI proof that the scheduling feedback loop
+(``resilience.scheduler``) actually beats a persistent straggler.
+
+Two real 2-process gloo phases over partitioned-file ingest, then a
+speculation leg, all on CPU in one command:
+
+1. **baseline** — an uninterrupted 2-process supervised AGD fit with
+   the scheduler attached (observe-only in practice: balanced hosts
+   never trigger).  Records the final loss, the fit wall clock, and
+   the steady-state per-segment time B.
+2. **straggler run** — the same fit with a PERSISTENT ``slow_host``
+   chaos fault on one process, calibrated so its segments take
+   ``--slow-factor`` (default 5×) the measured baseline segment: the
+   canonical "one degraded host makes every lockstep collective
+   straggler-bound" scenario.  The scheduler must detect the skew from
+   allgather-synced host-local boundary timings (``skew_estimate``
+   records), decide a weighted rebalance under hysteresis, swap the
+   partition assignment at a generation checkpoint boundary (the new
+   assignment rides the next barrier-committed manifest; the static
+   ``pad_to_rows`` shapes mean ZERO recompiles), and the degraded
+   host's data-proportional delay collapses.  Meanwhile the parent
+   babysits the heartbeat directory: the injected sleeps sub-beat with
+   ``phase="slow"``, so the :class:`HostMonitor` must report the host
+   SLOW and never LOST (the misdiagnosis this PR fixed).
+3. **speculation** — the parent re-executes one SLOW pre-rebalance
+   segment from its committed generation (1-process backup off the
+   same manifest chain) and resolves it against the fleet's committed
+   result: the warm carries must match (deterministic math — the
+   same-program case is bit-identical, the cross-topology backup here
+   agrees to f64 reduction noise) and the ``speculative_exec``
+   recovery record lands with its won/lost accounting.
+
+PASS (exit 0) requires: the straggler run's final loss within
+``--tol`` (1e-6) of the baseline; its wall clock within
+``--max-ratio`` (default 1.5×) of the baseline wall clock — instead of
+the ~``--slow-factor``× a scheduler-less run would pay; at least one
+``rebalance`` record (and recovery action) with the post-rebalance
+straggler score gated BELOW the pre-rebalance score by the REAL
+``obs.perfgate.gate_rebalance``; the slow host classified SLOW (never
+``HostLost``) while sleeping; a matched speculative execution on
+record; every record schema-valid; and ``tools/agd_report.py
+--scheduling`` able to render the rollup.  Any miss prints the reason
+and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/straggler_drill.py [-v] [--out DIR]
+
+Internally re-invokes itself with ``--child`` for the two SPMD
+processes (same init sequence as ``tools/dist_fault_drill.py``).
+See ``docs/ROBUSTNESS.md`` §straggler-aware-scheduling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_FEATURES = 6
+REG = 0.1
+
+
+def _configure_jax(n_devices: int = 1, gloo: bool = True):
+    """Platform + precision config, BEFORE any backend use (same
+    ordering contract as tools/dist_fault_drill.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}")
+    if gloo:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — newer jax: default works
+            pass
+    return jax
+
+
+def _part_paths(workdir: str):
+    return sorted(glob.glob(os.path.join(workdir, "parts",
+                                         "part-*.libsvm")))
+
+
+def _problem_pieces(args):
+    import numpy as np
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.ops.prox import L2Prox
+
+    px, rv = smooth_lib.make_prox(L2Prox(), REG)
+    w0 = np.zeros(N_FEATURES, np.float64)
+    cfg = agd.AGDConfig(convergence_tol=0.0,
+                        num_iterations=args.iters)
+    return px, rv, w0, cfg
+
+
+def child_main(args) -> int:
+    """One SPMD process of phase ``baseline`` or ``straggler``."""
+    jax = _configure_jax(1)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_agd_tpu.data import ingest
+    from spark_agd_tpu.obs import JSONLSink, Telemetry, trace as trace_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.parallel import (dist_smooth,
+                                        mesh as mesh_lib,
+                                        multihost as mh)
+    from spark_agd_tpu.resilience import (DistributedCheckpointer,
+                                          HeartbeatWriter,
+                                          ResiliencePolicy,
+                                          ReschedulePolicy,
+                                          StragglerScheduler,
+                                          run_agd_supervised)
+    from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                ScheduledFault)
+
+    mh.initialize(args.addr, args.nproc, args.pid)
+    assert jax.process_count() == args.nproc
+    mesh = mesh_lib.make_mesh({"data": len(jax.devices())})
+
+    paths = _part_paths(args.workdir)
+    assert len(paths) == args.parts, paths
+    n_total = args.parts * args.rows
+
+    def make_staged(assignment=None):
+        # the FIXED pad_to_rows block height is the zero-recompile
+        # trick: every assignment (12..0 partitions on this host)
+        # yields the same global array shapes, so a rebalance swaps
+        # data ARGUMENTS through the already-compiled segment program
+        batch = ingest.from_partitioned_files(
+            paths, mesh, n_features=N_FEATURES, dtype=np.float64,
+            validate="raise", assignment=assignment,
+            pad_to_rows=n_total)
+        return dist_smooth.make_dist_smooth_staged(
+            LogisticGradient(), batch, mesh=mesh)
+
+    px, rv, w0, cfg = _problem_pieces(args)
+    policy = ResiliencePolicy(
+        max_attempts=2, backoff_base=0.01, backoff_max=0.05,
+        jitter=0.0, seed=0, segment_iters=args.segment)
+    jsonl = mh.host_suffixed(os.path.join(
+        args.workdir, f"drill-{args.phase}.jsonl"))
+    tel = Telemetry([JSONLSink(jsonl)])
+    hb_dir = os.path.join(args.workdir, "hb", args.phase)
+    hb = HeartbeatWriter(hb_dir, telemetry=tel)
+
+    scheduler = StragglerScheduler(
+        paths,
+        policy=ReschedulePolicy(
+            skew_threshold=1.5, trigger_segments=args.trigger,
+            sync_every=1, min_shard=0, max_rebalances=1,
+            ewma_alpha=0.6),
+        rebuild=lambda decision: make_staged(decision.mine),
+        telemetry=tel, heartbeat_dir=hb_dir)
+    n_initial = max(1, len(scheduler.assignment))
+
+    faults = None
+    if args.phase == "straggler" and args.pid == args.slow_pid:
+        # the persistent 5× straggler: per-segment delay calibrated to
+        # (factor-1) × the measured baseline segment, scaled by this
+        # host's CURRENT data share — a genuinely data-proportional
+        # degradation, so the rebalance that strips its partitions
+        # genuinely removes its delay
+        faults = ChaosSchedule(
+            [ScheduledFault("slow_host", at_iter=0,
+                            payload=args.slow_s, persist=True)],
+            telemetry=tel,
+            slow_scale=lambda: (len(scheduler.assignment)
+                                / n_initial))
+
+    ck = DistributedCheckpointer(
+        os.path.join(args.workdir, "ckpt", args.phase),
+        every_iters=args.segment, keep=64, telemetry=tel,
+        mesh_shape=dict(mesh.shape),
+        partitions=ingest.local_partitions(paths))
+
+    def place_w(w):
+        return mesh_lib.replicate(
+            jax.tree_util.tree_map(jnp.asarray, w), mesh)
+
+    with trace_lib.activate(trace_lib.from_env()):
+        t0 = time.perf_counter()
+        res = run_agd_supervised(
+            prox=px, reg_value=rv, w0=w0, config=cfg, policy=policy,
+            staged=make_staged(None), telemetry=tel, checkpointer=ck,
+            heartbeat=hb, faults=faults, scheduler=scheduler,
+            place_w=place_w, stream_iterations=False)
+        fit_wall = time.perf_counter() - t0
+    tel.flush()
+
+    ok_secs = [a["seconds"] for a in res.attempts
+               if a["outcome"] == "ok"]
+    steady = ok_secs[1:] or ok_secs  # the first segment carries compile
+    summary = {
+        "final_loss": float(res.loss_history[-1]),
+        "num_iters": int(res.num_iters),
+        "fit_wall": fit_wall,
+        "seg_mean": sum(steady) / max(1, len(steady)),
+        "rebalances": int(scheduler.rebalances),
+        "assignment_len": len(scheduler.assignment),
+    }
+    with open(os.path.join(
+            args.workdir,
+            f"summary-{args.phase}-p{args.pid}.json"), "w") as f:
+        json.dump(summary, f)
+    print(f"DRILL_CHILD_OK phase={args.phase} pid={args.pid} "
+          f"iters={res.num_iters} wall={fit_wall:.3f} "
+          f"rebalances={scheduler.rebalances} "
+          f"loss={summary['final_loss']:.12f}", flush=True)
+    return 0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_children(args, phase: str, port: int, slow_s: float):
+    me = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(me))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return [
+        subprocess.Popen(
+            [sys.executable, me, "--child", "--phase", phase,
+             "--addr", f"localhost:{port}", "--nproc", "2",
+             "--pid", str(i), "--workdir", args.workdir,
+             "--parts", str(args.parts), "--rows", str(args.rows),
+             "--iters", str(args.iters),
+             "--segment", str(args.segment),
+             "--trigger", str(args.trigger),
+             "--slow-pid", str(args.slow_pid),
+             "--slow-s", str(slow_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        for i in range(2)
+    ]
+
+
+def _reap(procs, timeout):
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def _summaries(args, phase: str):
+    out = {}
+    for pid in range(2):
+        path = os.path.join(args.workdir,
+                            f"summary-{phase}-p{pid}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                out[pid] = json.load(f)
+    return out
+
+
+def parent_main(args) -> int:
+    import tempfile
+
+    failures: list = []
+
+    def check(ok: bool, what: str):
+        tag = "ok" if ok else "FAIL"
+        if not ok:
+            failures.append(what)
+        if args.verbose or not ok:
+            print(f"{tag}: {what}")
+
+    args.workdir = args.out or tempfile.mkdtemp(prefix="straggler_drill_")
+    os.makedirs(os.path.join(args.workdir, "parts"), exist_ok=True)
+    for stale in glob.glob(os.path.join(args.workdir, "*.json*")) \
+            + glob.glob(os.path.join(args.workdir, "ckpt", "*", "*")) \
+            + glob.glob(os.path.join(args.workdir, "hb", "*", "*")):
+        os.unlink(stale)
+
+    import numpy as np
+
+    from spark_agd_tpu.data import libsvm  # jax-free import
+
+    rng = np.random.default_rng(11)
+    w_true = np.linspace(-1.0, 1.0, N_FEATURES)
+    for k in range(args.parts):
+        X = rng.standard_normal((args.rows, N_FEATURES)).astype(
+            np.float32)
+        y = np.where(
+            X @ w_true + 0.3 * rng.standard_normal(args.rows) > 0,
+            1.0, -1.0)
+        libsvm.save_libsvm(
+            os.path.join(args.workdir, "parts",
+                         f"part-{k:02d}.libsvm"), X, y)
+
+    from spark_agd_tpu.obs import (JSONLSink, Telemetry, perfgate,
+                                   schema, trace as trace_lib)
+
+    parent_jsonl = os.path.join(args.workdir, "drill-parent.jsonl")
+    tel = Telemetry([JSONLSink(parent_jsonl)])
+    root_span = tel.trace_span("straggler_drill",
+                               tool="straggler_drill")
+    root_ctx = root_span.__enter__()
+    os.environ[trace_lib.TRACE_ENV] = root_ctx.to_env_value()
+
+    # -- phase 1: balanced 2-process baseline -----------------------------
+    procs = _spawn_children(args, "baseline", _free_port(), 0.0)
+    outs = _reap(procs, timeout=420)
+    for i, (rc, out, err) in enumerate(outs):
+        check(rc == 0 and "DRILL_CHILD_OK" in out,
+              f"baseline child {i} completed (rc={rc})"
+              + ("" if rc == 0 else f"\n{err[-2000:]}"))
+    base = _summaries(args, "baseline")
+    if len(base) != 2:
+        check(False, "baseline summaries written by both processes")
+        return _verdict(failures, args)
+    base_wall = max(s["fit_wall"] for s in base.values())
+    base_loss = base[0]["final_loss"]
+    seg_mean = sum(s["seg_mean"] for s in base.values()) / 2.0
+    check(all(s["rebalances"] == 0 for s in base.values()),
+          "balanced baseline triggered ZERO rebalances")
+    # calibrate the straggler: (factor-1) extra segment-times of delay
+    # per boundary makes its segments ~factor × the baseline segment;
+    # the clamp floor keeps the slow phase observable on machines
+    # where a segment is sub-10ms
+    slow_s = min(max((args.slow_factor - 1.0) * seg_mean,
+                     args.min_slow_s), args.max_slow_s)
+    if args.verbose:
+        print(f"baseline: wall={base_wall:.3f}s seg_mean="
+              f"{seg_mean * 1e3:.1f}ms loss={base_loss:.12f} -> "
+              f"straggler sleep {slow_s:.3f}s/boundary")
+
+    # -- precompile the speculation backup BEFORE the live phase ----------
+    # (a backup that must first pay XLA compile has already lost; real
+    # speculative executors keep the program warm)
+    jax = _configure_jax(1, gloo=False)
+    import dataclasses as _dc
+
+    from spark_agd_tpu.core import agd
+    from spark_agd_tpu.data import ingest
+    from spark_agd_tpu.obs import timeline  # noqa: F401  (gate dep)
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.parallel import dist_smooth, mesh as mesh_lib
+    from spark_agd_tpu.resilience import (run_speculative_segment,
+                                          resolve_speculation,
+                                          scheduler as sched_lib)
+    from spark_agd_tpu.utils import checkpoint as ckpt_lib
+
+    px, rv, w0, cfg = _problem_pieces(args)
+    mesh1 = mesh_lib.make_mesh({"data": len(jax.devices())})
+    batch1 = ingest.from_partitioned_files(
+        _part_paths(args.workdir), mesh1, n_features=N_FEATURES,
+        dtype=np.float64, validate="raise")
+    build1, dargs1 = dist_smooth.make_dist_smooth_staged(
+        LogisticGradient(), batch1, mesh=mesh1)
+    cfg_seg = _dc.replace(cfg, num_iterations=args.segment)
+
+    import jax as _jax
+
+    def _seg(ws, da):
+        sm, sl = build1(*da)
+        return agd.run_agd(sm, px, rv, ws.x, cfg_seg,
+                           smooth_loss=sl, warm=ws)
+
+    # graftlint: disable=donation -- ws is the committed speculation
+    # anchor, re-executed verbatim; donating it would consume the
+    # committed state a lost speculation must be able to discard
+    seg_jit = _jax.jit(_seg)
+
+    def run_seg(ws, k):
+        res = seg_jit(ws, dargs1)
+        _jax.block_until_ready(res.num_iters)
+        return res
+
+    warm_template = agd.AGDWarmState.initial(w0, cfg)
+    run_seg(warm_template, args.segment)  # compile warm-up
+
+    # -- phase 2: the persistent straggler, babysat -----------------------
+    from spark_agd_tpu.resilience import HostLost, HostMonitor
+
+    procs = _spawn_children(args, "straggler", _free_port(), slow_s)
+    monitor = HostMonitor(
+        os.path.join(args.workdir, "hb", "straggler"),
+        stale_after_s=max(4.0, 2.0 * slow_s), telemetry=tel)
+    saw_slow = False
+    mislost = None
+    while any(p.poll() is None for p in procs):
+        try:
+            monitor.check()
+        except HostLost as e:
+            mislost = e
+        if monitor.verdicts().get(args.slow_pid) == "slow":
+            saw_slow = True
+        time.sleep(0.1)
+    outs = _reap(procs, timeout=420)
+    for i, (rc, out, err) in enumerate(outs):
+        check(rc == 0 and "DRILL_CHILD_OK" in out,
+              f"straggler child {i} completed (rc={rc})"
+              + ("" if rc == 0 else f"\n{err[-2000:]}"))
+    check(saw_slow,
+          f"HostMonitor classified host {args.slow_pid} SLOW while it "
+          "slept (heartbeat sub-beats, phase=\"slow\")")
+    check(mislost is None,
+          "the sleeping straggler was NEVER misdiagnosed as HostLost "
+          + ("" if mislost is None else f"(got {mislost})"))
+
+    strag = _summaries(args, "straggler")
+    if len(strag) != 2:
+        check(False, "straggler summaries written by both processes")
+        return _verdict(failures, args)
+    strag_wall = max(s["fit_wall"] for s in strag.values())
+    strag_loss = strag[0]["final_loss"]
+    ratio = strag_wall / base_wall
+    diff = abs(strag_loss - base_loss)
+    check(diff <= args.tol,
+          f"straggler-run final loss matches the no-fault baseline "
+          f"(|diff| = {diff:.2e} <= {args.tol:g})")
+    check(any(s["rebalances"] >= 1 for s in strag.values()),
+          "the scheduler applied >= 1 rebalance")
+    check(ratio <= args.max_ratio,
+          f"wall clock within budget: {strag_wall:.2f}s vs baseline "
+          f"{base_wall:.2f}s = {ratio:.2f}x <= {args.max_ratio:g}x "
+          f"(a scheduler-less run would sit near "
+          f"{args.slow_factor:g}x the steady-state segment)")
+
+    # -- the record evidence ----------------------------------------------
+    strag_records = []
+    for path in sorted(glob.glob(os.path.join(
+            args.workdir, "drill-straggler.*jsonl*"))):
+        strag_records.extend(schema.read_jsonl(path))
+    kinds = {}
+    for r in strag_records:
+        kinds[r.get("kind")] = kinds.get(r.get("kind"), 0) + 1
+    check(kinds.get("skew_estimate", 0) >= args.trigger,
+          f"skew_estimate records on the stream "
+          f"(x{kinds.get('skew_estimate', 0)})")
+    check(kinds.get("rebalance", 0) >= 1,
+          f"rebalance records on the stream "
+          f"(x{kinds.get('rebalance', 0)})")
+    actions = {}
+    for r in strag_records:
+        if r.get("kind") == "recovery":
+            actions[r["action"]] = actions.get(r["action"], 0) + 1
+    check(actions.get("rebalance", 0) >= 1,
+          f"recovery action 'rebalance' recorded "
+          f"(x{actions.get('rebalance', 0)})")
+    check(actions.get("host_lost", 0) == 0,
+          "no host_lost recovery records (slow != lost)")
+    slow_beats = [r for r in strag_records
+                  if r.get("kind") == "heartbeat"
+                  and r.get("phase") == "slow"]
+    check(len(slow_beats) >= 1,
+          f"phase=\"slow\" heartbeat sub-beats on record "
+          f"(x{len(slow_beats)})")
+
+    # the REAL perfgate grades rebalance effectiveness on the same
+    # records the run emitted; the floor is scaled to the injected
+    # sleep so post-rebalance millisecond boundary noise reads as
+    # balanced, not as residual skew
+    gate = perfgate.gate_rebalance(strag_records,
+                                   floor_s=max(0.02, slow_s / 10.0),
+                                   require_rebalance=True)
+    check(gate.exit_code() == 0 and gate.improved,
+          f"obs.perfgate.gate_rebalance passes: straggler score "
+          f"{gate.pre_score and round(gate.pre_score, 3)} -> "
+          f"{gate.post_score and round(gate.post_score, 3)} "
+          f"(exit {gate.exit_code()}"
+          + (f"; refusals {gate.refusals}" if gate.refusals else "")
+          + ")")
+
+    # -- phase 3: speculative backup of a SLOW pre-rebalance segment ------
+    from spark_agd_tpu.resilience import manifest as manifest_lib
+
+    ckpt_dir = os.path.join(args.workdir, "ckpt", "straggler")
+    gens = manifest_lib.committed_generations(ckpt_dir)
+    by_iter = {}
+    for g in gens:
+        try:
+            m = manifest_lib.load_manifest(ckpt_dir, g)
+        except (ValueError, OSError):
+            continue
+        by_iter.setdefault(int(m.prior_iters), m)
+    reb_iters = [r["at_iter"] for r in strag_records
+                 if r.get("kind") == "rebalance"]
+    spec_from = args.segment  # the second segment: boundary slept
+    if reb_iters and spec_from + args.segment > min(reb_iters):
+        spec_from = 0
+    m_lo = by_iter.get(spec_from)
+    m_hi = by_iter.get(spec_from + args.segment)
+    check(m_lo is not None and m_hi is not None,
+          f"committed generations bracket the speculated segment "
+          f"(iters {spec_from} and {spec_from + args.segment}; have "
+          f"{sorted(by_iter)[:8]}...)")
+    if m_lo is not None and m_hi is not None:
+        def _warm_of(m):
+            path = m.shard_path(ckpt_dir, 0)
+            entries = ckpt_lib.read_npz_entries(path)
+            return ckpt_lib.checkpoint_from_entries(
+                path, ckpt_lib._Entries(path, entries), w0, None).warm
+
+        # fleet cost of that segment = the slow boundary + the segment
+        fleet_s = 0.0
+        for r in strag_records:
+            if r.get("kind") == "span" and r.get("name") == "boundary" \
+                    and r.get("start_iter") == spec_from:
+                fleet_s = max(fleet_s, float(r.get("seconds", 0.0)))
+        for r in strag_records:
+            if r.get("kind") == "attempt" and r.get("outcome") == "ok" \
+                    and r.get("start_iter") == spec_from:
+                fleet_s += float(r.get("seconds", 0.0))
+
+        spec = run_speculative_segment(run_seg, _warm_of(m_lo),
+                                       args.segment,
+                                       from_iter=spec_from)
+        outcome = resolve_speculation(
+            spec, _warm_of(m_hi), fleet_seconds=fleet_s or None,
+            tol=1e-9, straggler=args.slow_pid, telemetry=tel)
+        check(outcome["matched"],
+              f"speculative re-execution matches the committed "
+              f"generation (max diff {outcome['max_diff']:.2e} <= "
+              "1e-9; deterministic math makes first-result-wins safe)")
+        check(outcome["outcome"] in ("won", "lost"),
+              f"speculation resolved {outcome['outcome']} "
+              f"(backup {outcome['seconds']:.3f}s vs fleet "
+              f"{fleet_s:.3f}s)")
+        # the policy rule that would have armed this backup live
+        med = sorted(
+            float(r.get("seconds", 0.0)) for r in strag_records
+            if r.get("kind") == "attempt"
+            and r.get("outcome") == "ok")
+        if med and fleet_s:
+            mid = med[len(med) // 2]
+            check(sched_lib.speculation_due(
+                fleet_s, mid, args.spec_multiple),
+                f"speculation_due fires for the slow segment "
+                f"({fleet_s:.3f}s >= {args.spec_multiple:g} x median "
+                f"{mid:.3f}s)")
+
+    # -- cross-stream schema validation + the report CLI ------------------
+    root_span.__exit__(None, None, None)
+    tel.flush()
+    jsonls = sorted(glob.glob(os.path.join(args.workdir,
+                                           "drill-*.jsonl*")))
+    records = []
+    for path in jsonls:
+        records.extend(schema.read_jsonl(path))
+    invalid = [(i, errs) for i, rec in enumerate(records, 1)
+               if (errs := schema.validate_record(
+                   json.loads(json.dumps(rec, default=str))))]
+    check(not invalid,
+          f"all {len(records)} records across {len(jsonls)} streams "
+          "are schema-valid"
+          + (f" (first bad: {invalid[0]})" if invalid else ""))
+
+    cli = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "agd_report.py"), "--scheduling"] + jsonls,
+        capture_output=True, text=True, timeout=120)
+    check(cli.returncode == 0 and "scheduling" in cli.stdout,
+          f"tools/agd_report.py --scheduling renders the rollup "
+          f"(rc={cli.returncode})"
+          + ("" if cli.returncode == 0 else f"\n{cli.stderr[-800:]}"))
+
+    print(f"drill artifacts under {args.workdir} "
+          f"({len(records)} records in {len(jsonls)} streams)")
+    return _verdict(failures, args, ratio=ratio)
+
+
+def _verdict(failures, args, ratio=None) -> int:
+    if failures:
+        print(f"STRAGGLER DRILL FAILED ({len(failures)} checks):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("STRAGGLER DRILL PASSED: persistent "
+          f"{args.slow_factor:g}x straggler detected from boundary "
+          "skew, partitions rebalanced at a generation boundary "
+          "(zero recompiles), straggler score gated lower, slow host "
+          "never misdiagnosed as lost, speculative backup matched"
+          + (f"; wall {ratio:.2f}x the no-fault baseline"
+             if ratio is not None else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/straggler_drill.py",
+        description="two-process persistent-straggler scheduling drill")
+    p.add_argument("--child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--phase", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--addr", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--nproc", type=int, default=2,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--pid", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--workdir", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--slow-s", type=float, default=0.0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--parts", type=int, default=12,
+                   help="partition files (default 12)")
+    p.add_argument("--rows", type=int, default=10,
+                   help="rows per partition (default 10)")
+    p.add_argument("--iters", type=int, default=128,
+                   help="iteration budget (default 128)")
+    p.add_argument("--segment", type=int, default=4,
+                   help="segment length = checkpoint cadence "
+                        "(default 4)")
+    p.add_argument("--trigger", type=int, default=2,
+                   help="consecutive over-threshold syncs before a "
+                        "rebalance (default 2)")
+    p.add_argument("--slow-pid", type=int, default=1,
+                   help="which process plays the straggler (default 1)")
+    p.add_argument("--slow-factor", type=float, default=5.0,
+                   help="how many baseline-segment-times the "
+                        "straggler's segments take (default 5)")
+    p.add_argument("--min-slow-s", type=float, default=0.25,
+                   help="floor on the injected per-boundary sleep "
+                        "(keeps the slow phase observable on fast "
+                        "machines; default 0.25)")
+    p.add_argument("--max-slow-s", type=float, default=2.5,
+                   help="cap on the injected per-boundary sleep "
+                        "(default 2.5)")
+    p.add_argument("--max-ratio", type=float, default=1.5,
+                   help="straggler-run wall budget as a multiple of "
+                        "the no-fault baseline (default 1.5)")
+    p.add_argument("--spec-multiple", type=float, default=3.0,
+                   help="speculation_due threshold over the median "
+                        "segment (default 3)")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="|straggler loss - baseline| bound "
+                        "(default 1e-6)")
+    p.add_argument("--out", default=None,
+                   help="directory for partitions/checkpoints/JSONLs "
+                        "(default: a fresh temp dir)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
