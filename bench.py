@@ -302,20 +302,27 @@ def _staged_smooth_jit(Xd, yd):
     return jax.jit(lambda w, da: build(*da)[0](w)), dargs
 
 
-def _make_step(gradient, Xd, yd, num_iterations, loss_mode="x"):
+def _make_step(gradient, Xd, yd, num_iterations, loss_mode="x",
+               mesh=None, sharded_update=False):
     """The bench's fused step IS the public runner's program: built by
     ``api.make_runner`` (data as jit ARGUMENTS — constant-embedded data
     made XLA compile time scale with the dataset, the r4 compile_s:1843
     row / the r3 on-chip compile wedge), re-exposed with the
     closure-style ``step(w)`` + AOT ``lower/compile`` surface the
-    ladder's timing helpers consume."""
+    ladder's timing helpers consume.  ``mesh``/``sharded_update`` pass
+    through to the runner — the sharded-update program donates its
+    carry exactly like the replicated one, so _BoundStep's owned-copy
+    treatment (``_donation_safe``) covers it too and repeated timed
+    fits never invalidate the caller's device buffers."""
     from spark_agd_tpu import api
     from spark_agd_tpu.ops.prox import L2Prox
 
+    kw = {} if mesh is None else dict(mesh=mesh,
+                                      sharded_update=sharded_update)
     fit = api.make_runner((Xd, yd, None), gradient, L2Prox(),
                           reg_param=REG, convergence_tol=0.0,
                           num_iterations=num_iterations,
-                          loss_mode=loss_mode)
+                          loss_mode=loss_mode, **kw)
     return _BoundStep(fit.jitted_step, fit.data_args)
 
 

@@ -554,7 +554,8 @@ def run_ladder(config: BenchConfig, *, scale_per_device: float,
                iters: int, convergence_tol: float = 0.0,
                max_devices: Optional[int] = None,
                sentinel: Optional[scaling_lib.ContentionSentinel] = None,
-               telemetry=None, eps: float = 1e-3) -> dict:
+               telemetry=None, eps: float = 1e-3,
+               update_mode: str = "replicated") -> dict:
     """One weak-scaling ladder over mesh shapes 1→N for ``config``:
     per rung the dataset grows proportionally to the device count
     (fixed per-device work — ideal scaling holds seconds-per-iteration
@@ -564,11 +565,21 @@ def run_ladder(config: BenchConfig, *, scale_per_device: float,
     ``scaling_curve`` record with per-point efficiency, the fitted
     serial fraction, the per-point contention verdicts, and the full
     environment fingerprint + ``env_key`` — the trustworthy answer to
-    "does this scale?" that single-number BENCH rows never were."""
+    "does this scale?" that single-number BENCH rows never were.
+
+    ``update_mode`` selects the data-parallel weight-update program:
+    ``"replicated"`` (full-gradient psum, the default) or ``"sharded"``
+    (``api.make_runner(sharded_update=True)``: reduce-scatter + 1/N
+    prox + all-gather).  The mode is stamped onto the curve record so
+    :func:`obs.perfgate.gate_update_modes` can pair the two ladders."""
     import jax
 
     from spark_agd_tpu.parallel import mesh as mesh_lib
 
+    if update_mode not in ("replicated", "sharded"):
+        raise ValueError(
+            f"update_mode must be 'replicated' or 'sharded', got "
+            f"{update_mode!r}")
     sentinel = sentinel or scaling_lib.ContentionSentinel()
     rungs = ladder_rungs(len(jax.devices()), max_devices)
     points = []
@@ -589,7 +600,8 @@ def run_ladder(config: BenchConfig, *, scale_per_device: float,
                               config.updater(), mesh=mesh,
                               convergence_tol=convergence_tol,
                               num_iterations=iters,
-                              reg_param=config.reg_param)
+                              reg_param=config.reg_param,
+                              sharded_update=update_mode == "sharded")
         t0 = time.perf_counter()
         res = fit(w0)
         jax.block_until_ready(res.weights)
@@ -636,6 +648,7 @@ def run_ladder(config: BenchConfig, *, scale_per_device: float,
     extra.update(env)
     extra.update(
         algorithm="agd",
+        update_mode=update_mode,
         rows_per_device=int(rows_per_device or 0),
         iters=iters,
         ladder=",".join(str(k) for k in rungs),

@@ -57,7 +57,7 @@ DEFAULT_CONSTANT_BUDGET = 1 << 20
 @dataclasses.dataclass(frozen=True)
 class ContractViolation:
     """One failed pin; ``contract`` is ``constant-bytes`` / ``donation``
-    / ``collective-census``."""
+    / ``collective-census`` / ``collective-bytes``."""
 
     contract: str
     label: str
@@ -146,6 +146,32 @@ def check_collective_census(census: Dict[str, int], label: str,
     return out
 
 
+def check_allreduce_bytes(collective_bytes: Optional[Dict[str, int]],
+                          label: str, max_bytes: int
+                          ) -> List[ContractViolation]:
+    """The sharded-update hot loop's all-reduce traffic must stay
+    scalar-control-only (``obs.introspect.collective_bytes``): a stray
+    full-D psum re-entering the loop would pass the *census* pin only if
+    it also replaced an existing one, but it can never hide from the
+    byte ceiling — a D-sized gradient is orders of magnitude above the
+    handful of f32/s32 scalars the control plane psums per iteration."""
+    if collective_bytes is None:
+        return [ContractViolation(
+            "collective-bytes", label,
+            "max_all_reduce_bytes pinned but the analyzer reported no "
+            "collective byte census for this program",
+            observed=None, expected=max_bytes)]
+    got = int(collective_bytes.get("all-reduce", 0))
+    if got > int(max_bytes):
+        return [ContractViolation(
+            "collective-bytes", label,
+            f"all-reduce result bytes {got} exceed the pin "
+            f"{int(max_bytes)} — a full-size reduction is riding the "
+            "hot loop where only scalar control psums belong",
+            observed=got, expected=int(max_bytes))]
+    return []
+
+
 def check_runner(fit, w0, *, label: str,
                  pins: Optional[Dict[str, dict]] = None,
                  budget_bytes: Optional[int] = None,
@@ -185,15 +211,26 @@ def check_runner(fit, w0, *, label: str,
     if "collectives" in pin:
         violations += check_collective_census(cost.collectives, label,
                                               pin["collectives"])
+    if "max_all_reduce_bytes" in pin:
+        violations += check_allreduce_bytes(
+            cost.collective_bytes, label,
+            int(pin["max_all_reduce_bytes"]))
     return violations, cost
+
+
+_DEFAULT_CONTRACTS = ("constant-bytes", "donation", "collective-census")
 
 
 def pin_records(run_id: str, label: str,
                 violations: List[ContractViolation],
-                cost=None) -> List[dict]:
+                cost=None,
+                checked: Tuple[str, ...] = _DEFAULT_CONTRACTS,
+                ) -> List[dict]:
     """The ``contract_pin`` records for one checked runner: one OK
     record per passed contract, one failing record per violation — a
-    JSONL consumer sees pins were RUN, not merely not-violated."""
+    JSONL consumer sees pins were RUN, not merely not-violated.
+    ``checked`` names the contracts that actually ran (labels whose pin
+    carries ``max_all_reduce_bytes`` add ``collective-bytes``)."""
     from ..obs import schema
 
     bad = {v.contract for v in violations}
@@ -202,7 +239,7 @@ def pin_records(run_id: str, label: str,
         recs.append(schema.contract_pin_record(
             run_id, v.contract, False, label=label, message=v.message,
             observed=v.observed, expected=v.expected))
-    for contract in ("constant-bytes", "donation", "collective-census"):
+    for contract in checked:
         if contract not in bad:
             recs.append(schema.contract_pin_record(
                 run_id, contract, True, label=label))
@@ -225,6 +262,10 @@ def check_compiled(compiled, *, label: str, pin: dict,
     if "collectives" in pin:
         violations += check_collective_census(cost.collectives, label,
                                               pin["collectives"])
+    if "max_all_reduce_bytes" in pin:
+        violations += check_allreduce_bytes(
+            cost.collective_bytes, label,
+            int(pin["max_all_reduce_bytes"]))
     return violations, cost
 
 
@@ -272,13 +313,19 @@ def check_default_runners(pins: Optional[Dict[str, dict]] = None,
     """The gate body behind ``tools/graft_lint.py --contracts``: build
     the REAL public AGD and L-BFGS runners on a small synthetic problem
     (CPU-deterministic) and run every pin against their compiled
-    programs.  Emits ``contract_pin`` records on ``telemetry`` when
-    given."""
+    programs.  When the host exposes at least two devices the meshed
+    pair is pinned too — ``agd_mesh`` (replicated all-reduce update) and
+    ``agd_sharded`` (``sharded_update=True``) over a 2-device data mesh,
+    so a stray full-size all-reduce re-entering the sharded hot loop
+    fails this gate on any CPU.  Emits ``contract_pin`` records on
+    ``telemetry`` when given."""
+    import jax
     import numpy as np
 
     from .. import api
     from ..ops.losses import LogisticGradient
     from ..ops.prox import SquaredL2Updater
+    from ..parallel import mesh as mesh_lib
 
     rng = np.random.default_rng(0)
     X = rng.normal(size=(64, 8)).astype(np.float32)
@@ -288,20 +335,42 @@ def check_default_runners(pins: Optional[Dict[str, dict]] = None,
     if pins is None:
         pins = load_pins()
 
-    out: List[ContractViolation] = []
-    for label, fit in (
-            ("agd", api.make_runner(data, LogisticGradient(),
-                                    SquaredL2Updater(), reg_param=1e-3,
-                                    num_iterations=5, mesh=False)),
-            ("lbfgs", api.make_lbfgs_runner(data, LogisticGradient(),
+    runners = [
+        ("agd", api.make_runner(data, LogisticGradient(),
+                                SquaredL2Updater(), reg_param=1e-3,
+                                num_iterations=5, mesh=False)),
+        ("lbfgs", api.make_lbfgs_runner(data, LogisticGradient(),
+                                        SquaredL2Updater(),
+                                        reg_param=1e-3,
+                                        num_iterations=5,
+                                        mesh=False)),
+    ]
+    if len(jax.devices()) >= 2:
+        mesh2 = mesh_lib.make_mesh({mesh_lib.DATA_AXIS: 2},
+                                   devices=jax.devices()[:2])
+        runners.append(
+            ("agd_mesh", api.make_runner(data, LogisticGradient(),
+                                         SquaredL2Updater(),
+                                         reg_param=1e-3,
+                                         num_iterations=5,
+                                         mesh=mesh2)))
+        runners.append(
+            ("agd_sharded", api.make_runner(data, LogisticGradient(),
                                             SquaredL2Updater(),
                                             reg_param=1e-3,
                                             num_iterations=5,
-                                            mesh=False))):
+                                            mesh=mesh2,
+                                            sharded_update=True)))
+
+    out: List[ContractViolation] = []
+    for label, fit in runners:
         violations, cost = check_runner(fit, w0, label=label, pins=pins)
         out.extend(violations)
         if telemetry is not None:
+            checked = _DEFAULT_CONTRACTS
+            if "max_all_reduce_bytes" in pins.get(label, {}):
+                checked = checked + ("collective-bytes",)
             for rec in pin_records(telemetry.run_id, label, violations,
-                                   cost):
+                                   cost, checked=checked):
                 telemetry.emit(rec)
     return out

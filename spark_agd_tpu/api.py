@@ -31,7 +31,8 @@ from .core import agd, gd, smooth as smooth_lib
 from .ops.losses import Gradient
 from .ops.prox import Prox
 from .ops.sparse import CSRMatrix
-from .parallel import dist_smooth, mesh as mesh_lib
+from .parallel import dist_smooth, mesh as mesh_lib, \
+    sharded_update as sharded_lib
 
 Data = Union[Tuple, "mesh_lib.ShardedBatch"]
 
@@ -134,13 +135,34 @@ def _check_grid_fit(updater, reg_params, op_name: str):
     return reg_params
 
 
-def _build_smooth(gradient, data, mesh, dist_mode):
+def _build_smooth(gradient, data, mesh, dist_mode, sharded_update=False):
     """``(build, data_args)``: prepared/placed data as a pytree to pass
     THROUGH ``jax.jit``, plus ``build(*traced) -> (smooth, smooth_loss)``
     to call inside the trace.  Closing the jitted step over the concrete
     arrays instead would embed them as program constants and make XLA
     compile time scale with the dataset (the r4 ``compile_s: 1842.74``
-    full-scale row) — see ``core.smooth.make_smooth_staged``."""
+    full-scale row) — see ``core.smooth.make_smooth_staged``.
+
+    ``sharded_update=True`` returns the sharded-mode pair instead: the
+    build slot is a ``parallel.sharded_update.ShardedUpdateBuild`` whose
+    ``make_agd_run`` hook compiles the whole AGD loop (reduce-scatter
+    gradient, 1/N-shard update, exit allgather) — consumers dispatch on
+    the hook, never call the build."""
+    if sharded_update:
+        if mesh is None:
+            raise ValueError(
+                "sharded_update=True requires a mesh (the 1/N weight "
+                "shard is per-replica); pass mesh= or a ShardedBatch, "
+                "or drop sharded_update on a single-device run")
+        if dist_mode != "shard_map":
+            raise ValueError(
+                "sharded_update=True requires dist_mode='shard_map' "
+                "(the sharded carry is an explicit-SPMD construction "
+                "the GSPMD partitioner cannot express)")
+        batch = (data if isinstance(data, mesh_lib.ShardedBatch)
+                 else mesh_lib.shard_batch(mesh, data[0], data[1],
+                                           data[2]))
+        return sharded_lib.make_sharded_staged(gradient, batch, mesh=mesh)
     if mesh is None:
         if isinstance(data, mesh_lib.ShardedBatch):
             X, y, mask = data
@@ -243,6 +265,7 @@ def make_runner(
     dist_mode: str = "shard_map",
     loss_mode: str = "x",
     telemetry=None,
+    sharded_update: bool = False,
 ):
     """Build ``fit(initial_weights) -> AGDResult``, compiled ONCE.
 
@@ -259,9 +282,19 @@ def make_runner(
     is span-timed.  Costs a host round-trip per iteration, so the
     default ``None`` compiles the identical program as before (no
     callback in the HLO) — see ``docs/OBSERVABILITY.md``.
+
+    ``sharded_update`` (off by default; requires a mesh and the
+    ``shard_map`` dist mode): run the cross-replica sharded weight
+    update (``parallel.sharded_update``, docs/PERFORMANCE.md §"sharded
+    weight update") — reduce-scatter the gradient, prox/momentum on the
+    1/N shard, allgather full weights only for the smooth kernel.  Same
+    ``fit`` contract, same ``AGDResult``, parity within reduction
+    reordering; ``False`` traces programs bit-identical to before the
+    flag existed.
     """
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
-    build, dargs = _build_smooth(gradient, data, m, dist_mode)
+    build, dargs = _build_smooth(gradient, data, m, dist_mode,
+                                 sharded_update=sharded_update)
     px, rv = smooth_lib.make_prox(updater, reg_param)
     cfg = agd.AGDConfig(
         convergence_tol=convergence_tol, num_iterations=num_iterations,
@@ -271,15 +304,20 @@ def make_runner(
     tel_cb = (None if telemetry is None
               else telemetry.iteration_callback("agd"))
 
-    def _step(w, da):
-        sm, sl = build(*da)
-        return agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl,
-                           telemetry_cb=tel_cb)
+    if sharded_update:
+        _step = build.make_agd_run(px, rv, cfg, telemetry_cb=tel_cb)
+    else:
+        def _step(w, da):
+            sm, sl = build(*da)
+            return agd.run_agd(sm, px, rv, w, cfg, smooth_loss=sl,
+                               telemetry_cb=tel_cb)
 
     # the carry is donated: XLA aliases the weights buffer in place
     # instead of copying it (graftlint donation contract; the aliasing
     # is pinned against the compiled program by analysis.contracts) —
-    # _place_w hands the program a fresh buffer it may consume
+    # _place_w hands the program a fresh buffer it may consume.  The
+    # sharded run donates the same way: its entry/exit speak full
+    # replicated trees, so the result aliases the donated carry.
     step = jax.jit(_step, donate_argnums=0)
 
     def _place_w(initial_weights):
@@ -328,6 +366,7 @@ def run(
     resilience=None,
     checkpointer=None,
     journal=None,
+    sharded_update: bool = False,
 ):
     """Functional entry point, signature-parity with reference ``run``
     (``:177-189``).  Returns ``(weights, loss_history)`` where
@@ -379,7 +418,8 @@ def run(
             data, gradient, updater, convergence_tol, num_iterations,
             reg_param, initial_weights, l0, l_exact, beta, alpha,
             may_restart, mesh, dist_mode, loss_mode, return_result,
-            telemetry, verbose, resilience, checkpointer, journal)
+            telemetry, verbose, resilience, checkpointer, journal,
+            sharded_update=sharded_update)
     if checkpointer is not None or journal is not None:
         raise ValueError(
             "checkpointer=/journal= require the supervised path; pass "
@@ -389,7 +429,7 @@ def run(
         num_iterations=num_iterations, reg_param=reg_param, l0=l0,
         l_exact=l_exact, beta=beta, alpha=alpha, may_restart=may_restart,
         mesh=mesh, dist_mode=dist_mode, loss_mode=loss_mode,
-        telemetry=telemetry)
+        telemetry=telemetry, sharded_update=sharded_update)
     result = fit(initial_weights)
     n = int(result.num_iters)
     loss_history = np.asarray(result.loss_history)[:n]
@@ -415,7 +455,8 @@ def _run_supervised(data, gradient, updater, convergence_tol,
                     num_iterations, reg_param, initial_weights, l0,
                     l_exact, beta, alpha, may_restart, mesh, dist_mode,
                     loss_mode, return_result, telemetry, verbose,
-                    resilience, checkpointer, journal=None):
+                    resilience, checkpointer, journal=None, *,
+                    sharded_update=False):
     """The ``resilience=`` branch of :func:`run`: the same data staging
     and mesh resolution as :func:`make_runner`, driven by
     ``resilience.supervisor.run_agd_supervised`` (segmented fused
@@ -425,7 +466,8 @@ def _run_supervised(data, gradient, updater, convergence_tol,
 
     policy = None if resilience is True else resilience
     data, m, dist_mode = _reconcile_runner_mesh(data, mesh, dist_mode)
-    build, dargs = _build_smooth(gradient, data, m, dist_mode)
+    build, dargs = _build_smooth(gradient, data, m, dist_mode,
+                                 sharded_update=sharded_update)
     px, rv = smooth_lib.make_prox(updater, reg_param)
     cfg = agd.AGDConfig(
         convergence_tol=convergence_tol, num_iterations=num_iterations,

@@ -153,6 +153,20 @@ class _Trial(NamedTuple):
     n_bt: jax.Array
 
 
+def _replica_gated(cb: Callable) -> Callable:
+    """Host-side wrapper for the telemetry callback under the sharded
+    carry: every replica's program invokes the callback (the emission
+    lives inside a ``shard_map`` body), but only replica 0's invocation
+    reaches the stream — N identical records per iteration would
+    corrupt every downstream consumer that counts them."""
+
+    def gated(replica, **kw):
+        if int(replica) == 0:
+            cb(**kw)
+
+    return gated
+
+
 def run_agd(
     smooth: SmoothFn,
     prox: ProxFn,
@@ -163,6 +177,7 @@ def run_agd(
     smooth_loss: LossFn | None = None,
     warm: AGDWarmState | None = None,
     telemetry_cb: Callable | None = None,
+    axis_name: str | None = None,
 ) -> AGDResult:
     """Pure, trace-compatible AGD.  Wrap in ``jax.jit`` (the API layer does).
 
@@ -187,6 +202,22 @@ def run_agd(
     outfeed on TPU), which is exactly the traffic the fused design
     removed; ``None`` (default) traces the identical program as before
     (no callback in the HLO).
+
+    ``axis_name`` (the sharded weight update, arXiv 2004.13336): when
+    set, the caller is running this function inside a ``shard_map`` body
+    over that axis with ``w0``/``warm`` holding each replica's 1/N weight
+    shard and ``smooth`` returning the matching 1/N *gradient* shard
+    (reduce-scatter inside — see ``parallel.sharded_update``).  All the
+    elementwise carry math (``tvec.axpby``, prox, the ``z`` restart
+    merge) is shard-local and runs unchanged on 1/N of the elements; the
+    handful of control scalars that need the *global* vectors — ``xy_sq``,
+    the two curvature dots, the convergence norms, the restart dot — are
+    assembled from shard-local partial sums via scalar ``lax.psum``,
+    so every replica sees identical control flow through both nested
+    ``while_loop``s.  ``reg_value`` must likewise return the global
+    penalty (callers psum their shard-local value).  ``None`` (default)
+    binds the plain ``tvec`` reductions — bit-identical trace to before
+    the parameter existed.
     """
     cfg = config
     if cfg.loss_mode not in ("x", "x_strict", "y"):
@@ -203,6 +234,22 @@ def run_agd(
     beta = s(cfg.beta)
     btol = s(cfg.backtrack_tol)
     backtracking = cfg.beta < 1.0  # static: trial-acceptance structure
+
+    if axis_name is None:
+        # bit-identical trace to the pre-sharding program: direct aliases,
+        # no wrapper frames, nothing new in the jaxpr
+        _dot, _sq_norm, _norm = tvec.dot, tvec.sq_norm, tvec.norm
+    else:
+        # shard-local partial sums -> one scalar psum each: the only
+        # cross-replica traffic the carry math itself generates
+        def _dot(a, b):
+            return lax.psum(tvec.dot(a, b), axis_name)
+
+        def _sq_norm(a):
+            return lax.psum(tvec.sq_norm(a), axis_name)
+
+        def _norm(a):
+            return jnp.sqrt(_sq_norm(a))
 
     def trial_cond(c: _Trial) -> jax.Array:
         return jnp.logical_and(~c.accept, c.n_bt < cfg.max_backtracks)
@@ -231,7 +278,7 @@ def run_agd(
                               s(jnp.nan), c.bts, jnp.asarray(True), c.n_bt)
 
             xy = tvec.sub(x, y)
-            xy_sq = tvec.sq_norm(xy)
+            xy_sq = _sq_norm(xy)
             # Trivial accepts: exact-zero step (reference :263-267) or a
             # non-finite f_y (deviation: defer to the outer NaN guard
             # instead of spinning — see module docstring).
@@ -243,10 +290,10 @@ def run_agd(
 
             def eval_fx(_):
                 f_x, g_x = norm_smooth(x_old, smooth(x))
-                q_x = f_y + tvec.dot(xy, g_y) + 0.5 * c.big_l * xy_sq
+                q_x = f_y + _dot(xy, g_y) + 0.5 * c.big_l * xy_sq
                 local_simple = (
                     c.big_l + 2.0 * jnp.maximum(f_x - q_x, 0.0) / xy_sq)
-                local_curv = 2.0 * tvec.dot(xy, tvec.sub(g_x, g_y)) / xy_sq
+                local_curv = 2.0 * _dot(xy, tvec.sub(g_x, g_y)) / xy_sq
                 local_l = jnp.where(c.bts, local_simple, local_curv)
                 bts_new = jnp.logical_and(
                     c.bts,
@@ -305,8 +352,8 @@ def run_agd(
         loss_hist = o.loss_hist.at[o.it].set(loss)
 
         aborted = ~jnp.isfinite(t.f_y)  # NaN guard, reference :309-312
-        norm_x = tvec.norm(t.x)
-        norm_dx = tvec.norm(tvec.sub(t.x, x_old))
+        norm_x = _norm(t.x)
+        norm_dx = _norm(tvec.sub(t.x, x_old))
         done_zero = jnp.logical_and(norm_dx == 0.0,
                                     it_new + prior_iters > 1)
         done_tol = norm_dx < tol * jnp.maximum(norm_x, 1.0)
@@ -316,7 +363,7 @@ def run_agd(
         restart = jnp.asarray(False)
         if cfg.may_restart:
             restart = jnp.logical_and(
-                tvec.dot(t.g_y, tvec.sub(t.x, x_old)) > 0.0, ~done)
+                _dot(t.g_y, tvec.sub(t.x, x_old)) > 0.0, ~done)
         z_new = tvec.tmap(
             lambda zi, xi: jnp.where(restart, xi, zi), t.z, t.x)
         theta_new = jnp.where(restart, s(jnp.inf), t.theta)
@@ -325,10 +372,15 @@ def run_agd(
         if telemetry_cb is not None:
             # live stream: the same scalars the diag_* arrays record,
             # emitted to the host WHILE the compiled loop runs
-            jax.debug.callback(
-                telemetry_cb, it=it_new, loss=loss, big_l=t.big_l,
-                theta=t.theta, step=1.0 / (t.theta * t.big_l),
-                restarted=restart)
+            scalars = dict(it=it_new, loss=loss, big_l=t.big_l,
+                           theta=t.theta, step=1.0 / (t.theta * t.big_l),
+                           restarted=restart)
+            if axis_name is None:
+                jax.debug.callback(telemetry_cb, **scalars)
+            else:
+                jax.debug.callback(
+                    _replica_gated(telemetry_cb),
+                    replica=lax.axis_index(axis_name), **scalars)
 
         return _Outer(
             x=t.x, z=z_new, theta=theta_new, big_l=t.big_l, bts=bts_new,

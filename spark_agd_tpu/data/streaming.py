@@ -299,8 +299,13 @@ def make_streaming_smooth(
     mesh=None,
     pad_to: Optional[int] = None,
     csr_nnz_per_shard: Optional[int] = None,
+    prefetch: int = 0,
 ):
     """Build host-level ``(smooth, smooth_loss)`` that stream macro-batches.
+
+    ``prefetch`` (default 0 = off): background-thread ingest depth for
+    the fold — see :func:`fold_stream`; batch k+1's host read/parse
+    overlaps batch k's device compute.
 
     Each batch is (optionally) padded to ``pad_to`` rows so XLA compiles ONE
     kernel shape instead of one per ragged tail, then placed on ``mesh``
@@ -352,13 +357,14 @@ def make_streaming_smooth(
         (ls, gs), n = fold_stream(
             batch_sums,
             lambda a, b: [a[0] + b[0], tvec.add(a[1], b[1])],
-            _place, dataset, w)
+            _place, dataset, w, prefetch=prefetch)
         nf = jnp.asarray(n, ls.dtype)
         return ls / nf, tvec.scale(1.0 / nf, gs)
 
     def smooth_loss(w):
         (ls,), n = fold_stream(
-            batch_loss_sums, lambda a, b: [a[0] + b[0]], _place, dataset, w)
+            batch_loss_sums, lambda a, b: [a[0] + b[0]], _place, dataset,
+            w, prefetch=prefetch)
         return ls / jnp.asarray(n, ls.dtype)
 
     return smooth, smooth_loss
@@ -433,7 +439,49 @@ def make_streaming_eval_multi(
     return eval_multi
 
 
-def fold_stream(kernel, combine, place, dataset, w):
+class _Prefetcher:
+    """Bounded background ingest: a daemon thread pulls raw batches off
+    the iterator into a ``queue.Queue(maxsize=depth)`` so batch k+1's
+    host-side read/parse/pad (the expensive part of ``next()`` for disk
+    and LibSVM sources) overlaps batch k's device compute INSTEAD of
+    serializing after it.  Placement (``device_put``) stays on the
+    consuming thread — JAX dispatch ordering is per-thread, and the
+    queue bound caps host memory at ``depth`` raw batches.  The sentinel
+    marks exhaustion; a producer exception is re-raised at the consumer's
+    next pull, not swallowed."""
+
+    _END = object()
+
+    def __init__(self, it, depth: int):
+        import queue
+        import threading
+
+        self._q = queue.Queue(maxsize=depth)
+        self._err = None
+
+        def pump():
+            try:
+                for b in it:
+                    self._q.put(b)
+            except BaseException as e:  # noqa: BLE001 — relayed, below
+                self._err = e
+            finally:
+                self._q.put(self._END)
+
+        self._thread = threading.Thread(
+            target=pump, name="fold-stream-prefetch", daemon=True)
+        self._thread.start()
+
+    def __call__(self):
+        b = self._q.get()
+        if b is self._END:
+            if self._err is not None:
+                raise self._err
+            return None
+        return b
+
+
+def fold_stream(kernel, combine, place, dataset, w, prefetch: int = 0):
     """Stream the dataset through ``kernel(w, X, y, mask) -> (sums…, n)``,
     combining device sums with ``combine`` and counts as host ints
     (immune to integer wrap at 1B rows).
@@ -448,9 +496,22 @@ def fold_stream(kernel, combine, place, dataset, w):
     - the per-batch host sync the old loop had (``int(n)`` after every
       kernel) is gone — counts are drained ONCE after the stream, so no
       batch waits for its predecessor's scalar readback.
+
+    ``prefetch > 0`` adds a second stage of pipelining for sources whose
+    ``next()`` does real host work (disk reads, LibSVM parse, CSC twin
+    builds): a bounded background thread (:class:`_Prefetcher`) keeps up
+    to ``prefetch`` RAW batches ready, so iteration k+1's ingest runs
+    concurrently with iteration k's compute instead of inside the gap
+    between dispatches.  ``0`` (default) is the exact single-threaded
+    loop as before — nothing spawned, bit-identical behavior.
     """
     it = iter(dataset)
-    first = next(it, None)
+    if prefetch > 0:
+        pull = _Prefetcher(it, prefetch)
+    else:
+        def pull():
+            return next(it, None)
+    first = pull()
     if first is None:
         raise ValueError("streaming dataset yielded no batches")
     nxt = place(*first)
@@ -460,6 +521,6 @@ def fold_stream(kernel, combine, place, dataset, w):
         *sums, n = kernel(w, *nxt)  # async dispatch on batch i
         ns.append(n)
         acc = sums if acc is None else combine(acc, sums)
-        b = next(it, None)  # host prep of batch i+1 overlaps device work
+        b = pull()  # host prep of batch i+1 overlaps device work
         nxt = None if b is None else place(*b)
     return acc, sum(int(x) for x in ns)

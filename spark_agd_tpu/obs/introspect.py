@@ -65,6 +65,58 @@ def collective_census(hlo: str) -> Dict[str, int]:
     return {op: count_ops(hlo, op) for op in COLLECTIVE_OPS}
 
 
+def _shape_bytes(type_text: str) -> int:
+    """Total bytes of every array shape in an HLO result-type string
+    (handles tuples: each ``dtype[dims]`` element is summed)."""
+    import re
+
+    total = 0
+    for m in re.finditer(r"(pred|[a-z]+\d+\w*)\[([\d,]*)\]", type_text):
+        dt, dims = m.groups()
+        if dt == "pred":
+            nbytes = 1
+        else:
+            bits = int(re.match(r"[a-z]+(\d+)", dt).group(1))
+            nbytes = max(1, bits // 8)
+        size = 1
+        if dims:
+            for d in dims.split(","):
+                size *= int(d)
+        total += size * nbytes
+    return total
+
+
+def collective_bytes(hlo: str) -> Dict[str, int]:
+    """Per-collective RESULT bytes summed over one program's HLO text.
+
+    The census above counts *instructions*; this weighs them — the
+    number that distinguishes a full-D gradient all-reduce from the
+    scalar control-plane psums the sharded update mode leaves behind
+    (its all-reduce COUNT goes up — one psum per control scalar — while
+    its all-reduce BYTES collapse to a few scalars per iteration; the
+    full-D traffic moves to reduce-scatter + all-gather).  Bytes are the
+    op's result shape(s): for reduce-scatter that is the post-scatter
+    1/N shard, for all-gather the gathered full array — i.e. what the op
+    delivers, not what crosses each link (a ring moves ~the same bytes
+    for either phrasing).  Async ``-start`` forms count their (operand,
+    result) tuple and may overstate; the CPU backend the contract tests
+    pin against emits the sync forms."""
+    out = {op: 0 for op in COLLECTIVE_OPS}
+    for line in hlo.splitlines():
+        if " = " not in line:
+            continue
+        rest = line.split(" = ", 1)[1]
+        for op in COLLECTIVE_OPS:
+            idx = rest.find(f" {op}(")
+            if idx < 0:
+                idx = rest.find(f" {op}-start(")
+            if idx < 0:
+                continue
+            out[op] += _shape_bytes(rest[:idx + 1])
+            break
+    return out
+
+
 def hlo_text(fn: Callable, *args) -> str:
     """Optimized HLO of ``fn(*args)`` — lowered and compiled, never
     executed.  ``fn`` may already be jitted (anything with ``.lower``)."""
@@ -99,6 +151,9 @@ class ProgramCost:
     peak_hbm_bytes: Optional[int]
     collectives: Dict[str, int]
     hlo_bytes: int
+    # per-collective result bytes (see collective_bytes); defaulted so
+    # hand-built ProgramCost literals in older tests stay valid
+    collective_bytes: Optional[Dict[str, int]] = None
 
     @property
     def n_collectives(self) -> int:
@@ -119,7 +174,8 @@ class ProgramCost:
             alias_bytes=self.alias_bytes,
             generated_code_bytes=self.generated_code_bytes,
             peak_hbm_bytes=self.peak_hbm_bytes,
-            hlo_bytes=self.hlo_bytes, **fields)
+            hlo_bytes=self.hlo_bytes,
+            collective_bytes=self.collective_bytes, **fields)
 
 
 def _cost_dict(compiled) -> dict:
@@ -176,7 +232,8 @@ def analyze_compiled(compiled, label: str = "program") -> ProgramCost:
         argument_bytes=arg_b, output_bytes=out_b, temp_bytes=tmp_b,
         alias_bytes=ga("alias_size_in_bytes"),
         generated_code_bytes=gen_b, peak_hbm_bytes=peak,
-        collectives=collective_census(hlo), hlo_bytes=len(hlo))
+        collectives=collective_census(hlo), hlo_bytes=len(hlo),
+        collective_bytes=collective_bytes(hlo))
 
 
 def analyze_lowered(lowered, label: str = "program") -> ProgramCost:

@@ -348,7 +348,10 @@ def gate_files(baseline_path: str, candidate_path: str,
                            load_records(candidate_path), **kwargs)
 
 
-_CURVE_KEY_FIELDS = ("tool", "name", "algorithm")
+# update_mode distinguishes the replicated-psum and sharded-update
+# ladders of the same benchmark; absent on pre-sharding records, and
+# _key skips None fields, so old histories keep their keys
+_CURVE_KEY_FIELDS = ("tool", "name", "algorithm", "update_mode")
 
 
 def split_curves(records: List[dict]) -> Dict[str, dict]:
@@ -552,6 +555,165 @@ def format_scaling_report(result: ScalingGateResult) -> str:
                      + ("pass" if result.exit_code() == 0 else
                         f"FAIL ({len(result.shape_failures)} shape, "
                         f"{len(result.regressions)} regression(s))"))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Update-mode gate (parallel.sharded_update vs replicated all-reduce)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class UpdateModeGateResult:
+    """The sharded-vs-replicated gate's outcome: for every benchmark
+    that stamped BOTH an ``update_mode="replicated"`` and an
+    ``update_mode="sharded"`` scaling curve on the same environment,
+    the sharded curve's fitted serial fraction must be STRICTLY below
+    the replicated one — the whole point of reduce-scattering the
+    gradient is shrinking the non-parallelizable fraction, and a pair
+    where it does not is a perf claim the repo must not ship.
+    ``refusals`` are typed exit-2 conditions (missing mode curve,
+    contention-contaminated points, provenance quarantine, cross-
+    environment pair), per the BENCH post-mortem doctrine: a poisoned
+    comparison is worse than none."""
+
+    pairs: List[Tuple[str, Optional[float], Optional[float]]]
+    refusals: List[str]
+    failures: List[str]
+    allow_cross_env: bool = False
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.refusals) and not self.allow_cross_env
+
+    @property
+    def ok(self) -> bool:
+        return not self.refused and not self.failures
+
+    def exit_code(self) -> int:
+        """0 pass, 1 sharded not strictly better, 2 refused."""
+        if self.refused:
+            return 2
+        return 1 if self.failures else 0
+
+    def status(self) -> str:
+        return ("refused" if self.refused
+                else "fail" if self.failures else "pass")
+
+    def record(self, run_id: Optional[str] = None,
+               tool: str = "agd_bench") -> dict:
+        """The gate's outcome as one typed, schema-stamped run record
+        (mirrors :meth:`ScalingGateResult.record`)."""
+        return schema.stamp({
+            "name": "update_mode_gate",
+            "gate_status": self.status(),
+            "pairs": [{"key": k, "replicated_serial_fraction": r,
+                       "sharded_serial_fraction": s}
+                      for k, r, s in self.pairs],
+            "refusals": list(self.refusals),
+            "failures": list(self.failures),
+        }, tool=tool, kind="run", run_id=run_id)
+
+
+def _strip_mode(key: str) -> str:
+    return " ".join(p for p in key.split(" ")
+                    if not p.startswith("update_mode="))
+
+
+def gate_update_modes(
+    records: List[dict],
+    *,
+    policy: Optional[scaling_lib.CurvePolicy] = None,
+    allow_cross_env: bool = False,
+) -> UpdateModeGateResult:
+    """Pair each benchmark's ``update_mode="sharded"`` scaling curve
+    with its ``update_mode="replicated"`` twin (same tool / name /
+    algorithm) and require the sharded fitted serial fraction strictly
+    below the replicated one.
+
+    Typed refusals (exit 2): a mode missing its twin, contention-
+    contaminated points on either curve (under the policy's
+    ``refuse_contended``), provenance-quarantined records, disagreeing
+    :data:`CURVE_ENV_FIELDS` or stamped ``env_key`` between the pair,
+    and serial fractions that cannot be fitted (< 2 points).
+    ``allow_cross_env`` downgrades refusals to notes, mirroring
+    :func:`gate_scaling`."""
+    policy = policy or scaling_lib.CurvePolicy()
+    curves = split_curves(records)
+    by_mode: Dict[str, Dict[str, dict]] = {}
+    for key, rec in curves.items():
+        mode = rec.get("update_mode")
+        if not isinstance(mode, str):
+            continue
+        by_mode.setdefault(_strip_mode(key), {})[mode] = rec
+
+    pairs: List[Tuple[str, Optional[float], Optional[float]]] = []
+    refusals: List[str] = []
+    failures: List[str] = []
+
+    if not by_mode:
+        refusals.append("no scaling_curve records carrying update_mode "
+                        "— run the ladder with --update-mode both")
+    for base_key in sorted(by_mode):
+        modes = by_mode[base_key]
+        missing = [m for m in ("replicated", "sharded") if m not in modes]
+        if missing:
+            refusals.append(
+                f"{base_key}: no update_mode={'/'.join(missing)} curve "
+                "to pair — run both modes on this environment")
+            continue
+        rep, sh = modes["replicated"], modes["sharded"]
+        for side, rec in (("replicated", rep), ("sharded", sh)):
+            verdict = scaling_lib.check_curve(rec, policy)
+            refusals.extend(
+                _curve_refusals(f"{base_key} [{side}]", rec, policy,
+                                verdict, side))
+        for f in CURVE_ENV_FIELDS + ("env_key",):
+            rv, sv = rep.get(f), sh.get(f)
+            if rv is not None and sv is not None and rv != sv:
+                refusals.append(
+                    f"{base_key}: cross-environment pair — {f} differs "
+                    f"(replicated {rv!r} vs sharded {sv!r})")
+        r_sf = scaling_lib.fit_serial_fraction(
+            scaling_lib.sorted_points(rep.get("points") or []))
+        s_sf = scaling_lib.fit_serial_fraction(
+            scaling_lib.sorted_points(sh.get("points") or []))
+        pairs.append((base_key, r_sf, s_sf))
+        if r_sf is None or s_sf is None:
+            refusals.append(
+                f"{base_key}: serial fraction not fittable on both "
+                "sides (need >= 2 ladder points per mode)")
+            continue
+        if not s_sf < r_sf:
+            failures.append(
+                f"{base_key}: sharded serial fraction {s_sf:.4f} is "
+                f"not strictly below replicated {r_sf:.4f} — the "
+                "reduce-scatter update is not buying scalability here")
+    return UpdateModeGateResult(pairs=pairs, refusals=refusals,
+                                failures=failures,
+                                allow_cross_env=allow_cross_env)
+
+
+def format_update_mode_report(result: UpdateModeGateResult) -> str:
+    """Human-readable update-mode gate report (the output of
+    ``tools/agd_bench.py gate-modes``)."""
+    lines: List[str] = []
+    if result.refusals:
+        head = ("UPDATE-MODE GATE REFUSED" if result.refused
+                else "refusals waived by --allow-cross-env")
+        lines.append(head + ":")
+        lines.extend("  " + r for r in result.refusals)
+        lines.append("")
+    for key, r_sf, s_sf in result.pairs:
+        lines.append(
+            f"{key}: serial_fraction replicated {_fmt(r_sf)} vs "
+            f"sharded {_fmt(s_sf)}")
+    lines.extend("  " + f for f in result.failures)
+    if not result.refused:
+        lines.append("UPDATE-MODE GATE: "
+                     + ("pass (sharded strictly lower)"
+                        if result.ok else
+                        f"FAIL ({len(result.failures)} pair(s) not "
+                        "strictly better)"))
     return "\n".join(lines)
 
 

@@ -154,6 +154,11 @@ _OPTIONAL: Dict[str, dict] = {
         "cpu_count": (int, type(None)), "loadavg_1m": _NUM,
         "cpu_governor": str, "cpu_turbo": str,
         "cgroup_cpu_quota": (_NUM + (str,)), "env_key": str,
+        # which weight-update execution mode the run used:
+        # "replicated" (full update everywhere) or "sharded"
+        # (reduce-scatter → 1/N prox → allgather,
+        # parallel.sharded_update)
+        "update_mode": str,
     },
     "iteration": {"L": _NUM, "theta": _NUM, "step": _NUM,
                   "restarted": bool, "accepted": bool,
@@ -176,6 +181,9 @@ _OPTIONAL: Dict[str, dict] = {
         "temp_bytes": _OPT_NUM, "alias_bytes": _OPT_NUM,
         "generated_code_bytes": _OPT_NUM, "peak_hbm_bytes": _OPT_NUM,
         "hlo_bytes": int, "backend": str, "algorithm": str,
+        # per-collective result bytes (obs.introspect.collective_bytes):
+        # the all-reduce-bytes-collapse signature of the sharded update
+        "collective_bytes": (dict, type(None)),
         "tool": str, "timestamp_unix": _NUM,
     },
     "numerics_failure": {
@@ -260,6 +268,9 @@ _OPTIONAL: Dict[str, dict] = {
         "mesh_shape": dict, "cpu_count": (int, type(None)),
         "loadavg_1m": _NUM, "cpu_governor": str, "cpu_turbo": str,
         "cgroup_cpu_quota": (_NUM + (str,)),
+        # the update-mode gate (obs.perfgate.gate_update_modes) pairs
+        # replicated-vs-sharded curves on this field
+        "update_mode": str,
         "algorithm": str, "tool": str, "timestamp_unix": _NUM,
     },
     "skew_estimate": {
@@ -545,7 +556,7 @@ EXAMPLE_RUN_RECORD = {
     "timestamp_unix": 1754000000.0, "algorithm": "agd",
     "name": "logistic_l2_rcv1like", "platform": "cpu", "n_devices": 1,
     "iters": 20, "final_loss": 0.3217, "converged": False,
-    "iters_per_sec": 412.5, "error": None,
+    "iters_per_sec": 412.5, "update_mode": "sharded", "error": None,
 }
 
 EXAMPLE_ITERATION_RECORD = {
@@ -580,6 +591,9 @@ EXAMPLE_PROGRAM_COST_RECORD = {
     "collectives": {"all-reduce": 3, "all-gather": 0,
                     "reduce-scatter": 0, "collective-permute": 0,
                     "all-to-all": 0},
+    "collective_bytes": {"all-reduce": 96, "all-gather": 0,
+                         "reduce-scatter": 0, "collective-permute": 0,
+                         "all-to-all": 0},
 }
 
 EXAMPLE_NUMERICS_FAILURE_RECORD = {
@@ -693,6 +707,7 @@ EXAMPLE_SCALING_CURVE_RECORD = {
     ],
     "n_points": 2, "max_devices": 2, "efficiency": [1.0, 0.9309],
     "serial_fraction": 0.0742, "contention_flagged": 0,
+    "update_mode": "replicated",
     "rows_per_device": 256, "iters": 8, "ladder": "1,2",
     "env_key": "env-9f2ab34c11d0", "platform": "cpu", "n_devices": 8,
     "cpu_count": 8, "loadavg_1m": 0.42, "cgroup_cpu_quota": 8.0,

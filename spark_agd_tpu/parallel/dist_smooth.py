@@ -139,6 +139,24 @@ def _pair_builder(eval_fn, args):
     return build, args
 
 
+def psum_scatter_combine(ls, gs, n, data_axis, layout):
+    """The reduce-scatter twin of the ``_make_shard_map`` combine below
+    (arXiv 2004.13336, "Automatic Cross-Replica Sharding of Weight Update
+    in Data-Parallel Training"): the control scalars (Σloss, n) still
+    all-reduce — they are O(1) on the wire — but the full-D gradient
+    combine becomes one tiled ``lax.psum_scatter`` per leaf, so each
+    replica receives only its 1/N shard of the *summed* gradient and the
+    weight update that consumes it runs on 1/N of the elements.
+    ``layout`` is the ``parallel.sharded_update.ShardLayout`` fixing the
+    per-leaf flatten/pad geometry; the matching ``all_gather`` is
+    ``ShardLayout.gather``.  Only the sharded-update execution mode uses
+    this; the replicated builders in this module keep their plain psum
+    and trace bit-identical programs to before it existed."""
+    ls = lax.psum(ls, data_axis)
+    n = lax.psum(n, data_axis)
+    return ls, layout.scatter(gs, data_axis), n
+
+
 def _make_auto(gradient, X, y, mask):
     """GSPMD: global-array kernel; XLA partitions it from input shardings."""
 

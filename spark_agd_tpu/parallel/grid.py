@@ -74,20 +74,40 @@ def _shard_data_plumbing(X, y, mask, data_axis):
             lambda Xs, ys, ms: (Xs, ys, ms))
 
 
-def _local_smooth_fns(gradient, Xl, yl, ml, data_axis):
+def _local_smooth_fns(gradient, Xl, yl, ml, data_axis, layout=None):
     """The in-body (smooth, smooth_loss) pair: per-shard kernel + psum —
     ``dist_smooth._make_shard_map``'s math, but built from ALREADY-local
-    shards so it can live inside a vmapped body."""
+    shards so it can live inside a vmapped body.
 
-    def smooth(w):
-        ls, gs, n = gradient.batch_loss_and_grad(w, Xl, yl, ml)
-        ls = lax.psum(ls, data_axis)
-        gs = tvec.tmap(lambda g: lax.psum(g, data_axis), gs)
-        n = lax.psum(n, data_axis)
-        nf = jnp.asarray(n, ls.dtype)
-        return ls / nf, tvec.scale(1.0 / nf, gs)
+    ``layout`` (a ``parallel.sharded_update.ShardLayout``) switches the
+    pair to the sharded-carry dialect of the same contract: ``w`` is the
+    replica's 1/N weight shard, an ``all_gather`` materializes the full
+    weights only for the kernel, and the gradient combine is the
+    reduce-scatter (``dist_smooth.psum_scatter_combine``) so the returned
+    mean gradient is the matching 1/N shard.  The default ``None`` keeps
+    the replicated pair bit-identical for this module's sweep/CV bodies.
+    """
+
+    if layout is None:
+        def smooth(w):
+            ls, gs, n = gradient.batch_loss_and_grad(w, Xl, yl, ml)
+            ls = lax.psum(ls, data_axis)
+            gs = tvec.tmap(lambda g: lax.psum(g, data_axis), gs)
+            n = lax.psum(n, data_axis)
+            nf = jnp.asarray(n, ls.dtype)
+            return ls / nf, tvec.scale(1.0 / nf, gs)
+    else:
+        def smooth(w_shard):
+            w = layout.gather(w_shard, data_axis)
+            ls, gs, n = gradient.batch_loss_and_grad(w, Xl, yl, ml)
+            ls, gs, n = dist_smooth.psum_scatter_combine(
+                ls, gs, n, data_axis, layout)
+            nf = jnp.asarray(n, ls.dtype)
+            return ls / nf, tvec.scale(1.0 / nf, gs)
 
     def smooth_loss(w):
+        if layout is not None:
+            w = layout.gather(w, data_axis)
         ls, _, n = gradient.batch_loss_and_grad(w, Xl, yl, ml)
         ls = lax.psum(ls, data_axis)
         n = lax.psum(n, data_axis)

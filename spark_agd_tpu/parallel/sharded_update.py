@@ -1,0 +1,248 @@
+"""Cross-replica sharded weight update: reduce-scatter → 1/N prox → allgather.
+
+The replicated data-parallel mode (``parallel.dist_smooth``) all-reduces
+the full-D gradient and then runs the *entire* prox/momentum/backtracking
+update redundantly on every replica — N identical copies of the
+``tvec.axpby`` chains, the prox, and the curvature partial sums.  Per
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv 2004.13336) the all-reduce is algebraically a
+reduce-scatter followed by an all-gather, and everything *between* the two
+halves — the weight update — only needs the 1/N gradient shard it received.
+This module builds that execution mode for the fused AGD loop:
+
+    kernel on local rows  →  psum_scatter(Σgrad)   [1/N shard in]
+    shard-local axpby / prox / z-merge             [1/N of the FLOPs]
+    scalar psums for f_y, xy_sq, dots, norms       [O(1) on the wire]
+    all_gather(w shard)  →  full w for the kernel  [only where needed]
+
+On the wire per iteration the full-D traffic is one reduce-scatter plus
+one all-gather per smooth evaluation — the same bytes as the all-reduce
+it replaces (which IS that pair, fused) — but the update FLOPs and the
+update working set drop by 1/N, which is exactly the serial fraction the
+replicated mode pays on every added replica (Gustafson: the replicated
+update is work that does NOT shrink with N).  ``obs.introspect``'s
+collective census shows the signature: all-reduce bytes collapse to the
+scalar control plane, reduce-scatter and all-gather appear.
+
+The whole AGD loop lives inside ONE ``shard_map`` body so the carry
+(``x``, ``z`` — and the warm-start state on resume) stays sharded across
+iterations; ``core.agd.run_agd(axis_name=...)`` assembles its control
+scalars with cheap scalar psums so both nested ``lax.while_loop``s see
+identical decisions on every replica.  Entry and exit speak *full* trees:
+weights in, ``AGDResult`` with full weights/final_z out — so donation,
+checkpointing (``AGDWarmState`` round-trips full trees), the supervisor's
+rollback anchor, and the PR 10 scheduler's pinned-shape rebalance all
+compose unchanged, and a checkpoint written by either mode resumes in the
+other.
+
+The leaf geometry is fixed by :class:`ShardLayout`: every weight leaf is
+flattened, zero-padded up to a multiple of N, and split evenly.  The pad
+slots are inert by the prox protocol (``prox(0, 0, step) == 0`` — the
+contract ``ops.prox`` already guarantees for masked/padded entries) and
+contribute zero to every psummed scalar, so the padded program computes
+bit-for-bit the statistics of the unpadded one.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core import agd
+from ..ops.losses import Gradient
+from ..ops.sparse import RowShardedCSR
+from . import grid, mesh as mesh_lib
+from .shmap import shard_map
+
+
+class ShardLayout(NamedTuple):
+    """Static per-leaf flatten/pad/split geometry of one weight pytree.
+
+    Everything here is trace-time constant (shapes, sizes, treedef), so
+    the layout can be rebuilt from any structurally-identical tree and
+    two replicas can never disagree about where a shard boundary falls.
+    """
+
+    n_shards: int
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    shard_sizes: Tuple[int, ...]  # ceil(size / n_shards) per leaf
+    treedef: Any
+
+    @classmethod
+    def for_tree(cls, tree, n_shards: int) -> "ShardLayout":
+        leaves = jax.tree_util.tree_leaves(tree)
+        treedef = jax.tree_util.tree_structure(tree)
+        shapes = tuple(tuple(leaf.shape) for leaf in leaves)
+        sizes = tuple(int(math.prod(s)) for s in shapes)
+        shard_sizes = tuple(-(-s // n_shards) for s in sizes)
+        return cls(n_shards, shapes, sizes, shard_sizes, treedef)
+
+    def _padded(self, leaf, size, shard):
+        flat = jnp.ravel(leaf)
+        pad = shard * self.n_shards - size
+        return jnp.pad(flat, (0, pad)) if pad else flat
+
+    def shard(self, tree, idx):
+        """Slice replica ``idx``'s 1/N of every leaf (``idx`` may be a
+        traced ``lax.axis_index``)."""
+        out = []
+        for leaf, size, shard in zip(jax.tree_util.tree_leaves(tree),
+                                     self.sizes, self.shard_sizes):
+            flat = self._padded(leaf, size, shard)
+            out.append(lax.dynamic_slice(flat, (idx * shard,), (shard,)))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def gather(self, tree, axis_name: str):
+        """all_gather every shard leaf back to the full leaf shape —
+        the only place full weights materialize in the sharded mode."""
+        out = []
+        for leaf, shape, size in zip(jax.tree_util.tree_leaves(tree),
+                                     self.shapes, self.sizes):
+            full = lax.all_gather(leaf, axis_name, tiled=True)
+            out.append(full[:size].reshape(shape))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def scatter(self, tree, axis_name: str):
+        """Reduce-scatter every full leaf: sum across replicas, keep only
+        this replica's shard — the all-reduce's cheaper left half."""
+        out = []
+        for leaf, size, shard in zip(jax.tree_util.tree_leaves(tree),
+                                     self.sizes, self.shard_sizes):
+            flat = self._padded(leaf, size, shard)
+            out.append(lax.psum_scatter(flat, axis_name,
+                                        scatter_dimension=0, tiled=True))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+
+class ShardedUpdateBuild:
+    """The ``build`` half of the sharded mode's staged ``(build, dargs)``.
+
+    Deliberately NOT a smooth builder: a stand-alone ``smooth(w)`` cannot
+    keep the carry sharded between iterations, so calling it like the
+    ``dist_smooth`` builds raises.  Consumers dispatch on the
+    :meth:`make_agd_run` hook instead (``api.make_runner`` and the
+    resilience supervisor's segment compiler both do), which returns the
+    whole fused AGD loop as one ``shard_map``-wrapped callable with the
+    same ``(carry, data_args)`` call shape as the replicated step — so
+    the scheduler's pinned-shape rebalance can still swap ``dargs``
+    between generations without touching the build.
+    """
+
+    def __init__(self, gradient: Gradient, X, y, mask, *, mesh: Mesh,
+                 data_axis: str):
+        self.gradient = gradient
+        self.mesh = mesh
+        self.data_axis = data_axis
+        # grid's plumbing is the ONE definition of (args, in_specs,
+        # local rebuild) for a row-sharded dataset, dense or CSR
+        self.data_args, self._data_specs, self._rebuild_local = \
+            grid._shard_data_plumbing(X, y, mask, data_axis)
+
+    def __call__(self, *a):
+        raise TypeError(
+            "sharded-update staged data has no stand-alone smooth: the "
+            "carry must stay sharded across iterations, so the whole AGD "
+            "loop is built at once — use make_agd_run(prox, reg_value, "
+            "config) (api.make_runner and the supervisor do)")
+
+    def make_agd_run(self, prox, reg_value, config, *,
+                     telemetry_cb: Callable | None = None,
+                     poison: bool = False,
+                     warm_entry: bool = False) -> Callable:
+        """``run(carry, data_args) -> AGDResult`` over FULL weight trees.
+
+        ``carry`` is ``w0`` (cold start) or an ``AGDWarmState`` holding
+        full trees when ``warm_entry=True`` (the supervisor's resume
+        path); either way the sharding/unsharding happens inside the
+        program.  ``poison=True`` wraps the shard smooth with the fault
+        injector (supervisor fault drills).  ``reg_value`` is the plain
+        full-tree penalty; its shard-local partial is psummed here.
+        """
+        mesh, axis = self.mesh, self.data_axis
+        n_shards = mesh.shape[axis]
+        gradient, rebuild_local = self.gradient, self._rebuild_local
+
+        def _body(carry, *data):
+            idx = lax.axis_index(axis)
+            template = carry.x if warm_entry else carry
+            layout = ShardLayout.for_tree(template, n_shards)
+            if warm_entry:
+                warm_sh = carry._replace(x=layout.shard(carry.x, idx),
+                                         z=layout.shard(carry.z, idx))
+                w0_sh = warm_sh.x
+            else:
+                warm_sh = None
+                w0_sh = layout.shard(carry, idx)
+
+            Xl, yl, ml = rebuild_local(*data)
+            sm, sl = grid._local_smooth_fns(gradient, Xl, yl, ml, axis,
+                                            layout=layout)
+            if poison:
+                from ..resilience import faults as faults_lib
+                sm = faults_lib.poison_smooth(sm)
+
+            def rv_shard(w_sh):
+                # elementwise penalties sum over elements; zero pad slots
+                # contribute zero, so the psum of shard partials is the
+                # exact full-tree value
+                return lax.psum(reg_value(w_sh), axis)
+
+            res = agd.run_agd(sm, prox, rv_shard, w0_sh, config,
+                              smooth_loss=sl, warm=warm_sh,
+                              telemetry_cb=telemetry_cb, axis_name=axis)
+            # exit allgather: results speak full trees so donation,
+            # checkpoints, and cross-mode resume compose unchanged
+            return res._replace(weights=layout.gather(res.weights, axis),
+                                final_z=layout.gather(res.final_z, axis))
+
+        run = shard_map(_body, mesh=mesh,
+                        in_specs=(P(),) + tuple(self._data_specs),
+                        out_specs=P(), check_vma=False)
+
+        def run_bound(carry, data_args):
+            return run(carry, *data_args)
+
+        return run_bound
+
+
+def make_sharded_staged(
+    gradient: Gradient,
+    X,
+    y=None,
+    mask=None,
+    *,
+    mesh: Mesh,
+    data_axis: str = mesh_lib.DATA_AXIS,
+):
+    """``(build, data_args)`` for the sharded-update mode — the staged
+    twin of ``dist_smooth.make_dist_smooth_staged`` with a
+    :class:`ShardedUpdateBuild` in the build slot.  Accepts the same
+    inputs: a ``ShardedBatch`` (preferred) or raw ``(X, y[, mask])``
+    sharded on the fly."""
+    from ..ops.pallas_kernels import PallasMarginGradient
+
+    if isinstance(gradient, PallasMarginGradient):
+        raise ValueError(
+            "sharded_update does not compose with the fused Pallas "
+            "kernel yet (its tile-aligned relayout assumes the "
+            "replicated smooth contract); use the XLA gradient or "
+            "sharded_update=False")
+    if isinstance(X, mesh_lib.ShardedBatch):
+        if y is not None or mask is not None:
+            raise ValueError(
+                "pass either a ShardedBatch or raw (X, y[, mask]), not both")
+        X, y, mask = X
+    elif y is None:
+        raise ValueError("y is required when X is a raw array")
+    if not isinstance(X, (jax.Array, RowShardedCSR)) \
+            or not isinstance(y, jax.Array):
+        X, y, mask = mesh_lib.shard_batch(mesh, X, y, mask, axis=data_axis)
+    build = ShardedUpdateBuild(gradient, X, y, mask, mesh=mesh,
+                               data_axis=data_axis)
+    return build, build.data_args

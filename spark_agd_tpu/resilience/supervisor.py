@@ -221,6 +221,23 @@ def run_agd_supervised(
         key = (k, poisoned)
         if staged is not None:
             build, dargs = staged
+            if getattr(build, "make_agd_run", None) is not None:
+                # sharded-update build (parallel.sharded_update): the
+                # whole segment loop is one shard_map program speaking
+                # full trees at entry/exit, so the warm carry, rollback,
+                # and checkpointing below work unchanged.  Rebalance
+                # still swaps only ``dargs``.
+                if key not in seg_fns:
+                    # graftlint: disable=donation -- ws is the rollback
+                    # anchor: reused to retry after a failed segment, so
+                    # donating it would hand numerics rollback a deleted
+                    # buffer
+                    seg_fns[key] = jax.jit(build.make_agd_run(
+                        prox, reg_value, cfg_k, telemetry_cb=tel_cb,
+                        poison=poisoned, warm_entry=True))
+                res = seg_fns[key](warm, dargs)
+                jax.block_until_ready(res.num_iters)
+                return res
             if key not in seg_fns:
                 def _seg(ws, da, c=cfg_k, poison=poisoned):
                     sm, sl = build(*da)

@@ -19,6 +19,11 @@ Usage::
     python tools/agd_bench.py gate SCALING.jsonl --history SCALING.jsonl
     python tools/agd_bench.py gate CAND.jsonl --baseline BASE.jsonl
 
+    # run BOTH update modes, then gate sharded strictly better
+    python tools/agd_bench.py run --config 1 --devices 4 \\
+        --update-mode both --out MODES.jsonl
+    python tools/agd_bench.py gate-modes MODES.jsonl
+
     # side-by-side curve report (never fails)
     python tools/agd_bench.py compare BASE.jsonl CAND.jsonl
 
@@ -177,6 +182,8 @@ def cmd_run(args) -> int:
     if not configs:
         log(f"unknown config {args.config}")
         return 2
+    modes = (("replicated", "sharded")
+             if args.update_mode == "both" else (args.update_mode,))
     failures = 0
     sentinel = None
     for cfg in configs:
@@ -184,29 +191,33 @@ def cmd_run(args) -> int:
             from spark_agd_tpu.obs import scaling
 
             sentinel = scaling.ContentionSentinel()
-        try:
-            rec = bench_run.run_ladder(
-                cfg, scale_per_device=args.scale_per_device,
-                iters=args.iters, convergence_tol=args.tol,
-                max_devices=args.max_devices, sentinel=sentinel)
-        except Exception as e:  # noqa: BLE001 — one config's dead ladder
-            # must not take down the others; the record carries the error
-            import traceback
+        for mode in modes:
+            try:
+                rec = bench_run.run_ladder(
+                    cfg, scale_per_device=args.scale_per_device,
+                    iters=args.iters, convergence_tol=args.tol,
+                    max_devices=args.max_devices, sentinel=sentinel,
+                    update_mode=mode)
+            except Exception as e:  # noqa: BLE001 — one config's dead
+                # ladder must not take down the others; the record
+                # carries the error
+                import traceback
 
-            traceback.print_exc(file=sys.stderr)
-            rec = schema.stamp(
-                {"name": cfg.name,
-                 "error": f"ladder: {type(e).__name__}: {e}"[:500]},
-                tool="agd_bench")
-            failures += 1
-        errs = schema.validate_record(json.loads(json.dumps(rec)))
-        if errs:
-            log(f"[{cfg.name}] record failed schema validation: {errs}")
-            failures += 1
-        print(json.dumps(rec), flush=True)
-        for path in filter(None, (args.history, args.out)):
-            with open(path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+                traceback.print_exc(file=sys.stderr)
+                rec = schema.stamp(
+                    {"name": cfg.name, "update_mode": mode,
+                     "error": f"ladder: {type(e).__name__}: {e}"[:500]},
+                    tool="agd_bench")
+                failures += 1
+            errs = schema.validate_record(json.loads(json.dumps(rec)))
+            if errs:
+                log(f"[{cfg.name}] record failed schema validation: "
+                    f"{errs}")
+                failures += 1
+            print(json.dumps(rec), flush=True)
+            for path in filter(None, (args.history, args.out)):
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
     return 1 if failures else 0
 
 
@@ -265,6 +276,31 @@ def cmd_gate(args) -> int:
     print(perfgate.format_scaling_report(result))
     # the TYPED outcome record: one machine-readable line, so a refusal
     # is evidence in the artifact stream, not a silent exit code
+    rec = result.record()
+    print(json.dumps(rec), flush=True)
+    if args.record:
+        with open(args.record, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return result.exit_code()
+
+
+def cmd_gate_modes(args) -> int:
+    """Gate the replicated-vs-sharded ladder pair: the sharded curve's
+    fitted serial fraction must be STRICTLY below the replicated one on
+    the same environment (``obs.perfgate.gate_update_modes``)."""
+    from spark_agd_tpu.obs import perfgate
+
+    try:
+        records, notes = _load_any(args.records)
+    except OSError as e:
+        log(f"agd_bench: cannot read records: {e}")
+        return 2
+    for n in notes:
+        log(f"note: {n}")
+    result = perfgate.gate_update_modes(
+        records, policy=_policy_from_args(args),
+        allow_cross_env=args.allow_cross_env)
+    print(perfgate.format_update_mode_report(result))
     rec = result.record()
     print(json.dumps(rec), flush=True)
     if args.record:
@@ -379,6 +415,14 @@ def main(argv=None) -> int:
                          "history JSONL")
     pr.add_argument("--out", type=str, default=None,
                     help="also append each record to this file")
+    pr.add_argument("--update-mode",
+                    choices=("replicated", "sharded", "both"),
+                    default="replicated",
+                    help="weight-update program per ladder: replicated "
+                         "(full-gradient psum, default), sharded "
+                         "(reduce-scatter + 1/N prox + all-gather), or "
+                         "both (one curve record per mode — the input "
+                         "gate-modes wants)")
     pr.set_defaults(fn=cmd_run)
 
     pg = sub.add_parser("gate", help="gate scaling_curve records on "
@@ -395,6 +439,19 @@ def main(argv=None) -> int:
                          "record to this file")
     _add_policy_args(pg)
     pg.set_defaults(fn=cmd_gate)
+
+    pm = sub.add_parser(
+        "gate-modes",
+        help="gate the replicated-vs-sharded ladder pair: sharded "
+             "serial fraction strictly below replicated (exit 0/1/2)")
+    pm.add_argument("records", metavar="RECORDS.jsonl",
+                    help="JSONL holding BOTH modes' scaling_curve "
+                         "records (e.g. from run --update-mode both)")
+    pm.add_argument("--record", type=str, default=None,
+                    help="also append the typed update_mode_gate "
+                         "outcome record to this file")
+    _add_policy_args(pm)
+    pm.set_defaults(fn=cmd_gate_modes)
 
     pc = sub.add_parser("compare", help="side-by-side curve report "
                                         "(never fails)")
