@@ -71,6 +71,11 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     # one applied generation-boundary rebalance
     "skew_estimate": ((), frozenset({"skew"})),
     "rebalance": ((), frozenset({"at_iter"})),
+    # the continuous-learning pipeline (pipeline.canary /
+    # pipeline.promote): one shadow-served canary evaluation and one
+    # typed promotion decision
+    "canary": ((), frozenset({"generation", "verdict"})),
+    "promotion": ((), frozenset({"decision"})),
 }
 
 

@@ -34,6 +34,7 @@ behind the operator's back.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -73,6 +74,10 @@ class FlightRecorder(Sink):
         self.min_dump_interval_s = float(min_dump_interval_s)
         self._clock = clock
         self._ring: deque = deque(maxlen=self.capacity)
+        # emit() appends from every telemetered thread while dump()
+        # iterates — an unguarded deque raises "mutated during
+        # iteration" exactly when a failure path dumps under live load
+        self._ring_lock = threading.Lock()
         self._seen = 0
         self._last_dump_t: Dict[str, float] = {}
         self._dump_counter = 0
@@ -82,8 +87,9 @@ class FlightRecorder(Sink):
 
     # -- the sink half ----------------------------------------------------
     def emit(self, record: dict) -> None:
-        self._seen += 1
-        self._ring.append(dict(record))
+        with self._ring_lock:
+            self._seen += 1
+            self._ring.append(dict(record))
 
     @property
     def seen(self) -> int:
@@ -93,7 +99,8 @@ class FlightRecorder(Sink):
 
     def snapshot(self) -> List[dict]:
         """The ring's current contents, oldest first."""
-        return [dict(r) for r in self._ring]
+        with self._ring_lock:
+            return [dict(r) for r in self._ring]
 
     # -- the dump half ----------------------------------------------------
     def dump(self, path: Optional[str] = None, *,
@@ -124,7 +131,9 @@ class FlightRecorder(Sink):
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
         journal = _journal()
-        frames = [journal.encode_record(rec) for rec in self._ring]
+        with self._ring_lock:
+            ring = list(self._ring)
+        frames = [journal.encode_record(rec) for rec in ring]
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(MAGIC)

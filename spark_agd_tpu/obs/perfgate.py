@@ -879,6 +879,186 @@ def format_rebalance_report(result: RebalanceGateResult) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# Promotion gate (pipeline.canary -> pipeline.promote)
+# ---------------------------------------------------------------------------
+
+# relative held-out-loss regression allowed before a candidate fails
+# the quality leg (models.evaluation.log_loss, lower is better)
+DEFAULT_QUALITY_THRESHOLD = 0.05
+
+# a shadow latency summary over fewer requests than this is sampling
+# noise, not evidence — the gate refuses rather than judging on it
+DEFAULT_MIN_SHADOW_REQUESTS = 16
+
+# the latency leg's metrics: candidate shadow percentiles vs the HEAD
+# leg's, on the serving SLO thresholds RUN_METRICS already defines
+PROMOTION_LATENCY_METRICS = ("p50_ms", "p99_ms")
+
+
+@dataclasses.dataclass
+class PromotionGateResult:
+    """The promotion gate's outcome over ``canary`` records: a
+    candidate generation promotes only when BOTH legs hold — held-out
+    quality within ``quality_threshold`` of the baseline, and shadow
+    p50/p99 within the serving-SLO thresholds of the HEAD leg.
+    ``refusals`` are typed exit-2 conditions (too few shadow requests,
+    cross-generation spec mismatch, contention-flagged latency, missing
+    evidence), per the repo's gating doctrine: a comparison that cannot
+    be made honestly is refused, not passed."""
+
+    canaries: List[dict]
+    deltas: List[Delta]
+    refusals: List[str]
+    failures: List[str]
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.refusals)
+
+    @property
+    def ok(self) -> bool:
+        return not self.refused and not self.failures
+
+    def exit_code(self) -> int:
+        """0 pass, 1 a gate leg regressed, 2 refused."""
+        if self.refused:
+            return 2
+        return 1 if self.failures else 0
+
+    def status(self) -> str:
+        return ("refused" if self.refused
+                else "fail" if self.failures else "pass")
+
+    def record(self, run_id: Optional[str] = None,
+               tool: str = "pipeline") -> dict:
+        """The gate's outcome as one typed, schema-stamped run record
+        (mirrors :meth:`UpdateModeGateResult.record`)."""
+        return schema.stamp({
+            "name": "promotion_gate",
+            "gate_status": self.status(),
+            "canaries": len(self.canaries),
+            "refusals": list(self.refusals),
+            "failures": list(self.failures),
+        }, tool=tool, kind="run", run_id=run_id)
+
+
+def gate_promotion(records: List[dict], *,
+                   quality_threshold: float = DEFAULT_QUALITY_THRESHOLD,
+                   thresholds: Optional[Dict[str, float]] = None,
+                   min_shadow_requests: int = DEFAULT_MIN_SHADOW_REQUESTS,
+                   require_canary: bool = False
+                   ) -> PromotionGateResult:
+    """Gate promotion over ``canary`` records: for every canary in the
+    stream, compare the quality leg (``quality_candidate`` vs
+    ``quality_baseline`` held-out loss, relative ``quality_threshold``)
+    and the latency leg (shadow ``p50_ms``/``p99_ms`` vs the HEAD
+    leg's ``baseline_*``, on :data:`RUN_METRICS` thresholds unless
+    overridden by ``thresholds``).  Without canary records the gate
+    passes vacuously unless ``require_canary`` (then: typed refusal).
+
+    Typed refusals (exit 2): fewer than ``min_shadow_requests`` shadow
+    requests, a ``baseline_spec``/``candidate_spec`` disagreement (the
+    engines are not serving the same model family — latency pairs are
+    meaningless), a contention-flagged latency window, missing
+    quality or latency evidence, and any refusal the canary controller
+    itself stamped."""
+    thresholds = dict(thresholds or {})
+    canaries = [r for r in records if isinstance(r, dict)
+                and r.get("kind") == "canary"]
+    refusals: List[str] = []
+    failures: List[str] = []
+    deltas: List[Delta] = []
+    if not canaries:
+        if require_canary:
+            refusals.append("no canary records in the stream — "
+                            "nothing to gate")
+        return PromotionGateResult(canaries=[], deltas=deltas,
+                                   refusals=refusals, failures=failures)
+    for rec in canaries:
+        gen = rec.get("generation")
+        base = rec.get("baseline_generation")
+        key = (f"canary g{base}->g{gen}" if base is not None
+               else f"canary g{gen}")
+        for r in rec.get("refusals") or []:
+            refusals.append(f"{key}: {r}")
+        shadow = rec.get("shadow_requests")
+        if not isinstance(shadow, int) or isinstance(shadow, bool) \
+                or shadow < min_shadow_requests:
+            refusals.append(
+                f"{key}: too few shadow requests "
+                f"({shadow!r} < {min_shadow_requests}) — the latency "
+                "evidence is sampling noise")
+        b_spec, c_spec = rec.get("baseline_spec"), rec.get(
+            "candidate_spec")
+        if b_spec is not None and c_spec is not None \
+                and b_spec != c_spec:
+            refusals.append(
+                f"{key}: cross-generation spec mismatch — the shadow "
+                "engine is not serving the HEAD model family "
+                f"(baseline {b_spec!r} vs candidate {c_spec!r})")
+        if rec.get("contention_flagged") is True:
+            refusals.append(
+                f"{key}: contention-flagged latency window — the "
+                "shadow percentiles measured a noisy host, not the "
+                "candidate")
+        qb, qc = _num(rec, "quality_baseline"), _num(
+            rec, "quality_candidate")
+        if qb is None or qc is None:
+            refusals.append(f"{key}: quality evidence missing "
+                            "(quality_baseline/quality_candidate)")
+        else:
+            _compare_metric(key, "holdout_loss", "lower", qb, qc,
+                            quality_threshold, deltas)
+        lat_pairs = 0
+        for metric in PROMOTION_LATENCY_METRICS:
+            b = _num(rec, f"baseline_{metric}")
+            c = _num(rec, metric)
+            if b is None or c is None:
+                continue
+            lat_pairs += 1
+            direction, default = RUN_METRICS[metric]
+            _compare_metric(key, metric, direction,
+                            b, c, thresholds.get(metric, default),
+                            deltas)
+        if lat_pairs == 0:
+            refusals.append(f"{key}: latency evidence missing "
+                            "(no paired baseline_*/candidate "
+                            "percentiles)")
+    failures.extend(
+        f"{d.key}: {d.metric} regressed "
+        f"{'' if d.rel_change is None else format(d.rel_change, '+.1%')}"
+        f" (baseline {_fmt(d.baseline)} -> candidate "
+        f"{_fmt(d.candidate)}, threshold {d.threshold:g})"
+        for d in deltas if d.status == "regression")
+    return PromotionGateResult(canaries=canaries, deltas=deltas,
+                               refusals=refusals, failures=failures)
+
+
+def format_promotion_report(result: PromotionGateResult) -> str:
+    """Human-readable promotion-gate report (the output of
+    ``tools/perf_gate.py --promotion``)."""
+    lines: List[str] = []
+    if result.refusals:
+        lines.append("PROMOTION GATE REFUSED:")
+        lines.extend("  " + r for r in result.refusals)
+        return "\n".join(lines)
+    if not result.canaries:
+        return ("PROMOTION GATE: pass (no canary records — nothing "
+                "to gate)")
+    shown = [d for d in result.deltas if d.status != "skipped"]
+    if shown:
+        lines.append(format_deltas(shown))
+    lines.append(
+        "PROMOTION GATE: "
+        + (f"pass ({len(result.canaries)} canary(s), "
+           f"{len(shown)} metric(s) compared)"
+           if result.ok else
+           f"FAIL ({len(result.failures)} leg(s) regressed)"))
+    lines.extend("  " + f for f in result.failures)
+    return "\n".join(lines)
+
+
 def format_report(result: GateResult, *, verbose: bool = False) -> str:
     """The gate's full human-readable report."""
     lines: List[str] = []

@@ -39,7 +39,8 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "numerics_failure", "attempt", "recovery", "heartbeat",
          "chaos", "journal_replay", "degraded", "contract_pin",
          "serve_request", "serve_latency", "trace_summary",
-         "scaling_curve", "skew_estimate", "rebalance")
+         "scaling_curve", "skew_estimate", "rebalance",
+         "canary", "promotion")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
@@ -47,12 +48,14 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
 # generation swap (serve.registry); ``flight_dump`` records a flight-
 # recorder dump written by a failure path (obs.flight); ``rebalance``
 # and ``speculative_exec`` are the straggler scheduler's actions
-# (resilience.scheduler).
+# (resilience.scheduler); ``rollback_generation`` is the continuous-
+# learning pipeline repointing serving HEAD back to the prior
+# generation after a failed promotion (pipeline.promote).
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
                     "host_lost", "elastic_resume", "degraded_continue",
                     "hot_swap", "flight_dump", "rebalance",
-                    "speculative_exec")
+                    "speculative_exec", "rollback_generation")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -122,6 +125,15 @@ _REQUIRED: Dict[str, dict] = {
     # decided at; the before/after per-host partition counts ride as
     # optionals
     "rebalance": {"run_id": str, "at_iter": int},
+    # one shadow-served canary evaluation of a candidate generation
+    # (pipeline.canary): ``generation`` is the candidate, ``verdict``
+    # is "pass" | "fail" | "refused"; slice fraction, quality delta,
+    # and per-leg latency evidence ride as optionals
+    "canary": {"run_id": str, "generation": int, "verdict": str},
+    # one typed promotion decision (pipeline.promote): ``decision`` is
+    # "promoted" | "rejected" | "rolled_back"; from/to generation and
+    # the gate evidence ride as optionals
+    "promotion": {"run_id": str, "decision": str},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -285,6 +297,35 @@ _OPTIONAL: Dict[str, dict] = {
         "before": dict, "after": dict, "moved": int,
         "generation": int, "process": int, "reason": str,
         "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
+    },
+    "canary": {
+        # which generation the candidate shadowed, and what fraction of
+        # live traffic was mirrored to it
+        "baseline_generation": int, "slice_fraction": _NUM,
+        "shadow_requests": int, "epoch": int,
+        # quality leg: held-out loss of baseline vs candidate
+        # (models.evaluation.log_loss) and the relative threshold the
+        # gate applied
+        "quality_baseline": _OPT_NUM, "quality_candidate": _OPT_NUM,
+        "quality_delta": _OPT_NUM, "quality_threshold": _NUM,
+        "quality_verdict": str, "quality_fault_injected": bool,
+        # latency leg: candidate shadow percentiles vs HEAD's
+        "p50_ms": _OPT_NUM, "p99_ms": _OPT_NUM,
+        "baseline_p50_ms": _OPT_NUM, "baseline_p99_ms": _OPT_NUM,
+        "latency_verdict": str, "contention_flagged": bool,
+        # refusal evidence (spec mismatch, torn target, thin traffic)
+        "refusals": list, "baseline_spec": dict, "candidate_spec": dict,
+        "reason": str, "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
+    },
+    "promotion": {
+        "from_generation": (int, type(None)), "to_generation": int,
+        "candidate_generation": int, "epoch": int,
+        # the gate evidence the decision was made on: the canary
+        # verdict, perfgate status, and any refusal strings
+        "gate_status": str, "evidence": dict, "refusals": list,
+        "reason": str, "source": str, "algorithm": str, "tool": str,
         "timestamp_unix": _NUM,
     },
 }
@@ -533,6 +574,27 @@ def rebalance_record(run_id: str, at_iter: int, **fields) -> dict:
             "run_id": run_id, "at_iter": int(at_iter), **fields}
 
 
+def canary_record(run_id: str, generation: int, verdict: str,
+                  **fields) -> dict:
+    """One shadow-served canary evaluation (``pipeline.canary``):
+    ``generation`` is the candidate, ``verdict`` pass/fail/refused;
+    ``slice_fraction``/``shadow_requests`` size the shadow leg,
+    ``quality_*`` and ``p50_ms``/``p99_ms`` carry the two gate legs'
+    evidence, ``refusals`` why the gate refused to judge."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "canary",
+            "run_id": run_id, "generation": int(generation),
+            "verdict": str(verdict), **fields}
+
+
+def promotion_record(run_id: str, decision: str, **fields) -> dict:
+    """One typed promotion decision (``pipeline.promote``):
+    ``decision`` is promoted/rejected/rolled_back;
+    ``from_generation``/``to_generation`` the HEAD movement,
+    ``evidence`` the canary/gate record the decision rode on."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "promotion",
+            "run_id": run_id, "decision": str(decision), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -732,6 +794,31 @@ EXAMPLE_REBALANCE_RECORD = {
     "source": "scheduler",
 }
 
+EXAMPLE_CANARY_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "canary",
+    "run_id": "r18c2d3e4-1a2b-0", "generation": 5, "verdict": "pass",
+    "baseline_generation": 4, "slice_fraction": 0.25,
+    "shadow_requests": 64, "epoch": 3,
+    "quality_baseline": 0.3217, "quality_candidate": 0.3105,
+    "quality_delta": -0.0348, "quality_threshold": 0.05,
+    "quality_verdict": "pass", "quality_fault_injected": False,
+    "p50_ms": 2.4, "p99_ms": 10.1,
+    "baseline_p50_ms": 2.1, "baseline_p99_ms": 9.7,
+    "latency_verdict": "pass", "contention_flagged": False,
+    "refusals": [], "source": "pipeline.canary", "tool": "pipeline",
+}
+
+EXAMPLE_PROMOTION_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "promotion",
+    "run_id": "r18c2d3e4-1a2b-0", "decision": "rolled_back",
+    "from_generation": 5, "to_generation": 4,
+    "candidate_generation": 5, "epoch": 3, "gate_status": "failed",
+    "evidence": {"verdict": "pass", "post_check": "holdout loss "
+                 "regressed 412% after repoint"},
+    "refusals": [], "reason": "post-promotion quality check failed",
+    "source": "pipeline.promote", "tool": "pipeline",
+}
+
 # the kind-keyed table selfcheck iterates — graftlint's schema-drift
 # rule cross-checks that EVERY registered kind appears here (and has a
 # Telemetry helper), so a new kind cannot land without selfcheck
@@ -756,6 +843,8 @@ EXAMPLES: Dict[str, dict] = {
     "scaling_curve": EXAMPLE_SCALING_CURVE_RECORD,
     "skew_estimate": EXAMPLE_SKEW_ESTIMATE_RECORD,
     "rebalance": EXAMPLE_REBALANCE_RECORD,
+    "canary": EXAMPLE_CANARY_RECORD,
+    "promotion": EXAMPLE_PROMOTION_RECORD,
 }
 
 

@@ -437,6 +437,29 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def canary(self, *, generation: int, verdict: str,
+               **fields) -> dict:
+        """Emit (and return) a ``canary`` record — one shadow-served
+        candidate evaluation (``pipeline.canary``) — counted overall
+        (``pipeline.canaries``) and per verdict
+        (``pipeline.canary.<verdict>``)."""
+        self.registry.counter("pipeline.canaries").inc()
+        self.registry.counter(f"pipeline.canary.{verdict}").inc()
+        rec = schema.canary_record(self.run_id, generation, verdict,
+                                   **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def promotion(self, *, decision: str, **fields) -> dict:
+        """Emit (and return) a ``promotion`` record — one typed
+        promotion decision (``pipeline.promote``: promoted / rejected /
+        rolled_back) — counted per decision
+        (``pipeline.<decision>``)."""
+        self.registry.counter(f"pipeline.{decision}").inc()
+        rec = schema.promotion_record(self.run_id, decision, **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

@@ -1,0 +1,39 @@
+"""The continuous-learning pipeline: train → publish → canary →
+promote/rollback as ONE supervised loop.
+
+The training plane (``resilience.supervisor`` driving the AGD core)
+and the serving plane (``serve.registry`` hot swaps) speak the same
+CRC-manifested generation protocol but, before this package, never to
+each other — a human had to carry weights across.  This package closes
+the loop, DeepSpark-style (a driver continuously publishing parameter
+updates to serving workers on a fixed cadence), with the gate
+discipline the rest of the repo already enforces:
+
+- :class:`~.trainer.ContinuousTrainer` runs warm-started, preemption-
+  safe epochs over minibatches and publishes every epoch's weights as
+  a candidate generation;
+- :class:`~.canary.CanaryController` shadow-serves the candidate on a
+  slice of live traffic (a second ``ServeEngine`` beside HEAD) and
+  grades it on held-out quality AND shadow latency
+  (``obs.perfgate.gate_promotion``);
+- :class:`~.promote.Promoter` turns the canary verdict into a typed
+  decision — ``promoted`` / ``rejected`` / ``rolled_back`` — where a
+  post-repoint failure triggers automatic rollback to the previous
+  verifiable generation (``serve.registry.repoint``), flight-recorded
+  and emitted as the ``rollback_generation`` recovery action.
+
+Everything rides the existing trace/telemetry machinery: one trace
+tree tells the whole train→publish→canary→promote→rollback story
+(``tools/agd_trace.py``), and ``tools/pipeline_drill.py`` is the
+acceptance drill.  See ``docs/CONTINUOUS.md``.
+"""
+
+from .trainer import ContinuousTrainer, EpochResult
+from .canary import CanaryController, CanaryReport
+from .promote import Promoter, PromotionDecision
+
+__all__ = [
+    "ContinuousTrainer", "EpochResult",
+    "CanaryController", "CanaryReport",
+    "Promoter", "PromotionDecision",
+]

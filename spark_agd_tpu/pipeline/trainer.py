@@ -1,0 +1,133 @@
+"""Continuous epoch trainer — the training half of the pipeline loop.
+
+One :class:`ContinuousTrainer` owns a long-lived supervised fit broken
+into epochs: each ``run_epoch(X, y)`` call warm-starts from the
+previous epoch's weights, runs ``run_agd_supervised`` over that
+epoch's minibatch (preemption-safe via ``AutoCheckpointer``), and
+publishes the result to ``serve.registry`` as a CANDIDATE generation
+through the manifest commit protocol — published means durably
+committed, NOT serving; the canary/promotion half decides whether
+serving HEAD moves (``pipeline.canary`` / ``pipeline.promote``).
+
+Compile-once epochs: the staged ``build`` closure from
+``core.smooth.make_smooth_staged`` closes only over the gradient
+object, so ONE build is reused across epochs with each epoch's
+prepared arrays passed through jit as arguments, and one shared
+``seg_cache`` keeps every epoch after the first compile-free (same
+shape ⇒ same program).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Callable, Optional
+
+from ..core import agd
+from ..core import smooth as smooth_lib
+from ..resilience.autockpt import AutoCheckpointer
+from ..resilience.supervisor import (ResiliencePolicy,
+                                     run_agd_supervised)
+
+
+@dataclasses.dataclass
+class EpochResult:
+    """One epoch's outcome: the published candidate and its fit."""
+
+    epoch: int
+    generation: int        # the published candidate generation
+    final_loss: float
+    weights: Any           # the CLEAN post-epoch weights (warm start)
+    result: Any            # the epoch's SupervisedResult ledger
+
+
+class ContinuousTrainer:
+    """See module docstring.
+
+    ``make_model(weights)`` turns an epoch's weight vector into the
+    servable model the registry publishes.  ``weight_fault(epoch,
+    weights)`` (optional, drill-only) corrupts the PUBLISHED candidate
+    of matching epochs while the warm-start chain keeps the clean
+    weights — the fault-injection hook ``tools/pipeline_drill.py``
+    forces a failed canary with.
+    """
+
+    def __init__(self, registry, gradient, *,
+                 prox: Callable, reg_value: Callable,
+                 w0, config: Optional[agd.AGDConfig] = None,
+                 make_model: Callable[[Any], Any],
+                 policy: Optional[ResiliencePolicy] = None,
+                 telemetry=None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: Optional[int] = None,
+                 checkpoint_keep: int = 2,
+                 weight_fault: Optional[Callable] = None):
+        self.registry = registry
+        self.gradient = gradient
+        self.prox = prox
+        self.reg_value = reg_value
+        self.config = config or agd.AGDConfig()
+        self.make_model = make_model
+        self.policy = policy
+        self.telemetry = telemetry
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_keep = max(1, int(checkpoint_keep))
+        self.weight_fault = weight_fault
+        self.weights = w0
+        self.epoch = 0
+        self.total_iters = 0
+        self._build = None            # one build, every epoch
+        self._seg_cache: dict = {}    # one cache, every epoch
+
+    def run_epoch(self, X, y, mask=None) -> EpochResult:
+        """Run one warm-started epoch over ``(X, y)`` and publish the
+        result as the next candidate generation.  Returns the epoch's
+        :class:`EpochResult`; the supervisor's failure taxonomy
+        (transient retry, numeric rollback, preemption resume) applies
+        unchanged inside the epoch."""
+        self.epoch += 1
+        epoch = self.epoch
+        span = (self.telemetry.trace_span("pipeline_epoch",
+                                          epoch=epoch, tool="pipeline")
+                if self.telemetry is not None else None)
+        with span if span is not None else contextlib.nullcontext():
+            build, dargs = smooth_lib.make_smooth_staged(
+                self.gradient, X, y, mask)
+            if self._build is None:
+                self._build = build
+            checkpointer = None
+            if self.checkpoint_path is not None:
+                checkpointer = AutoCheckpointer(
+                    f"{self.checkpoint_path}.e{epoch:03d}.npz",
+                    every_iters=(self.checkpoint_every
+                                 or self.config.num_iterations),
+                    keep=self.checkpoint_keep,
+                    telemetry=self.telemetry)
+            result = run_agd_supervised(
+                prox=self.prox, reg_value=self.reg_value,
+                w0=self.weights, config=self.config,
+                policy=self.policy, telemetry=self.telemetry,
+                checkpointer=checkpointer,
+                staged=(self._build, dargs),
+                seg_cache=self._seg_cache,
+                stream_iterations=False)
+            self.weights = result.weights
+            self.total_iters += result.num_iters
+            final_loss = (float(result.loss_history[-1])
+                          if len(result.loss_history) else float("nan"))
+            publish_w = result.weights
+            if self.weight_fault is not None:
+                publish_w = self.weight_fault(epoch, publish_w)
+            generation = self.registry.publish(
+                self.make_model(publish_w),
+                converged=result.converged,
+                prior_iters=self.total_iters)
+            if span is not None:
+                span.note(generation=generation, final_loss=final_loss,
+                          iters=result.num_iters,
+                          retries=result.retries,
+                          rollbacks=result.rollbacks)
+            return EpochResult(epoch=epoch, generation=generation,
+                               final_loss=final_loss,
+                               weights=result.weights, result=result)

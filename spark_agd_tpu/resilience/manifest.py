@@ -165,6 +165,18 @@ def write_manifest(directory: str, manifest: Manifest) -> str:
     return path
 
 
+def repoint_head(directory: str, manifest: Manifest) -> str:
+    """Atomically repoint ``manifest.json`` at an ALREADY-COMMITTED
+    generation without writing a new one — the rollback half of the
+    commit protocol (``serve.registry.repoint``).  The per-generation
+    manifest chain is untouched; only the HEAD pointer moves, so a
+    restart loads the repointed generation while every newer committed
+    generation stays on disk as evidence."""
+    head = os.path.join(directory, HEAD_NAME)
+    _atomic_write_text(head, manifest.to_json())
+    return head
+
+
 def committed_generations(directory: str) -> List[int]:
     """Generation ids with a per-generation manifest on disk, newest
     first.  (A committed manifest may still fail verification — torn

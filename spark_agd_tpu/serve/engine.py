@@ -413,8 +413,15 @@ class ServeEngine:
                 out = prog.compiled(self._params, padded, prog.scratch)
                 # the donated scratch's buffer now IS the output; copy
                 # the result to host, then recycle the device buffer
-                # as the next call's scratch
-                host = jax.device_get(out)
+                # as the next call's scratch.  The host copy must OWN
+                # its memory: on CPU ``device_get`` may return a
+                # zero-copy VIEW of the device buffer (persistent-
+                # cache-deserialized executables do), and the next
+                # call's donation would mutate results already handed
+                # to callers.
+                host = np.asarray(jax.device_get(out))
+                if host.base is not None or not host.flags.owndata:
+                    host = host.copy()
             prog.scratch = out
         return host[:n], generation, bucket
 

@@ -185,6 +185,76 @@ class ModelRegistry:
                 tool="serve.registry")
         return loaded.generation
 
+    # -- rollback ----------------------------------------------------------
+    def previous(self, generation: Optional[int] = None) -> Optional[int]:
+        """The newest VERIFIABLE committed generation strictly older
+        than ``generation`` (default: the currently-bound generation,
+        falling back to HEAD's).  Unverifiable generations along the
+        walk are skipped with a ``checkpoint_fallback`` recovery record,
+        exactly like ``load_newest``; None when nothing older is
+        loadable — the rollback chain is exhausted."""
+        if generation is None:
+            if self._current is not None:
+                generation = self._current.generation
+            else:
+                head = mf.load_manifest(self.directory)
+                if head is None:
+                    return None
+                generation = head.generation
+        for g in mf.committed_generations(self.directory):
+            if g >= generation:
+                continue
+            man = mf.load_manifest(self.directory, g)
+            if man is None:
+                continue
+            if not mf.verify_manifest(man, self.directory):
+                return g
+            if self.telemetry is not None:
+                self.telemetry.recovery(
+                    action="checkpoint_fallback", generation=g,
+                    reason="skipped while walking back: failed "
+                           "file-level verification",
+                    source="serve.registry", tool="serve.registry")
+        return None
+
+    def repoint(self, generation: int, engine=None) -> LoadedModel:
+        """Deliberately move serving HEAD to ``generation`` — forward
+        (promotion) or backward (rollback).  The target must be a
+        committed, verifiable generation: a missing manifest raises
+        ``LookupError``, a torn shard raises ``CheckpointCorruptError``
+        (``checkpoint_fallback``-recorded) — the registry never repoints
+        at garbage.  On success the manifest HEAD pointer is atomically
+        rewritten (so a restart serves this generation), the model is
+        bound into ``engine`` when given, and a ``hot_swap`` recovery
+        record ties the movement into the trace."""
+        man = mf.load_manifest(self.directory, generation)
+        if man is None:
+            raise LookupError(
+                f"no committed generation g{generation} in "
+                f"{self.directory!r}")
+        try:
+            loaded = self._load_manifest(man)
+        except CheckpointCorruptError:
+            if self.telemetry is not None:
+                self.telemetry.recovery(
+                    action="checkpoint_fallback", generation=generation,
+                    reason="repoint refused: target failed "
+                           "verification",
+                    source="serve.registry", tool="serve.registry")
+            raise
+        mf.repoint_head(self.directory, man)
+        if engine is not None:
+            engine.bind(loaded.model, loaded.generation)
+        previous = (self._current.generation
+                    if self._current is not None else 0)
+        self._current = loaded
+        if self.telemetry is not None:
+            self.telemetry.recovery(
+                action="hot_swap", generation=loaded.generation,
+                from_generation=previous, source="serve.registry",
+                tool="serve.registry")
+        return loaded
+
     def gc(self) -> List[str]:
         """Housekeeping: drop all but the ``keep`` newest generations
         (same in-flight-orphan sparing as the training GC)."""

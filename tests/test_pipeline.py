@@ -1,0 +1,560 @@
+"""Continuous-learning pipeline tests (``spark_agd_tpu/pipeline/``).
+
+The contracts pinned here close the train→serve loop: the registry's
+rollback primitives move ONLY the HEAD pointer (the committed chain —
+and forward generation counting — survive a backward repoint), torn
+targets are refused with the training-side loader semantics, the
+promotion gate refuses rather than guesses on thin/mismatched/noisy
+evidence, the trainer's warm-start chain stays clean even when the
+published candidate is fault-injected, a failed post-promotion check
+rolls HEAD back automatically (emitted as the ``rollback_generation``
+recovery action), and every record the loop emits is schema-valid.
+The reduced drill smoke rides at the bottom, serve-drill style.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu.core import agd
+from spark_agd_tpu.core import smooth as smooth_lib
+from spark_agd_tpu.models.evaluation import log_loss
+from spark_agd_tpu.models.glm import (LinearRegressionModel,
+                                      LogisticRegressionModel)
+from spark_agd_tpu.obs import (InMemorySink, Telemetry, perfgate,
+                               schema)
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.pipeline import (CanaryController, ContinuousTrainer,
+                                    Promoter)
+from spark_agd_tpu.resilience.faults import scramble_file
+from spark_agd_tpu.serve import (MicroBatchQueue, ModelRegistry,
+                                 ServeEngine)
+from spark_agd_tpu.utils.checkpoint import CheckpointCorruptError
+
+pytestmark = pytest.mark.pipeline
+
+D = 6
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _model(seed=1, scale=1.0):
+    r = _rng(seed)
+    return LogisticRegressionModel(
+        (r.normal(size=D) * scale).astype(np.float32), 0.0)
+
+
+def _data(seed=0, n=64):
+    r = _rng(seed)
+    X = r.normal(size=(n, D)).astype(np.float32)
+    w = _rng(99).normal(size=D).astype(np.float32)
+    y = (r.random(n) < 1.0 / (1.0 + np.exp(-(X @ w)))).astype(
+        np.float32)
+    return X, y
+
+
+def _telemetry():
+    sink = InMemorySink()
+    return Telemetry([sink]), sink
+
+
+# ---------------------------------------------------------------------------
+# satellite: registry rollback primitives
+
+
+class TestRegistryRollback:
+    def _publish_n(self, tmp_path, n=3):
+        reg = ModelRegistry(str(tmp_path))
+        for i in range(n):
+            reg.publish(_model(seed=i + 1))
+        return reg
+
+    def test_previous_walks_back_from_head(self, tmp_path):
+        reg = self._publish_n(tmp_path)
+        assert reg.previous() == 2
+        assert reg.previous(2) == 1
+        assert reg.previous(1) is None
+
+    def test_repoint_moves_head_only(self, tmp_path):
+        from spark_agd_tpu.resilience import manifest as mf
+
+        reg = self._publish_n(tmp_path)
+        loaded = reg.repoint(1)
+        assert loaded.generation == 1
+        assert reg.current.generation == 1
+        # HEAD on disk moved; the committed chain did not
+        assert mf.load_manifest(str(tmp_path)).generation == 1
+        assert mf.committed_generations(str(tmp_path)) == [3, 2, 1]
+        # a fresh registry restarting from disk serves the repointed gen
+        assert ModelRegistry(str(tmp_path)).load().generation == 1
+
+    def test_publish_after_rollback_counts_forward(self, tmp_path):
+        reg = self._publish_n(tmp_path)
+        reg.repoint(1)
+        # forward counting: a rollback must never cause a generation
+        # collision with the still-committed later generations
+        assert reg.publish(_model(seed=9)) == 4
+
+    def test_repoint_missing_generation_raises(self, tmp_path):
+        reg = self._publish_n(tmp_path)
+        with pytest.raises(LookupError):
+            reg.repoint(17)
+
+    def test_repoint_binds_engine(self, tmp_path):
+        reg = self._publish_n(tmp_path)
+        engine = ServeEngine(reg.load(3).model, generation=3,
+                             max_batch=8, min_bucket=4)
+        reg.repoint(2, engine=engine)
+        assert engine.generation == 2
+        assert engine.hot_swaps == 1
+
+    def _scramble_gen(self, tmp_path, generation):
+        from spark_agd_tpu.resilience import manifest as mf
+
+        man = mf.load_manifest(str(tmp_path), generation)
+        scramble_file(str(tmp_path / man.shards[0].path), seed=3)
+
+    def test_previous_skips_torn_generation(self, tmp_path):
+        reg = self._publish_n(tmp_path)
+        self._scramble_gen(tmp_path, 2)
+        assert reg.previous(3) == 1
+        with pytest.raises(CheckpointCorruptError):
+            reg.repoint(2)
+
+    def test_repoint_refusal_leaves_head(self, tmp_path):
+        reg = self._publish_n(tmp_path)
+        self._scramble_gen(tmp_path, 2)
+        with pytest.raises(CheckpointCorruptError):
+            reg.repoint(2)
+        assert reg.load().generation == 3  # HEAD never moved
+
+
+# ---------------------------------------------------------------------------
+# satellite: schema kinds, Telemetry helpers, recovery action
+
+
+class TestPipelineSchema:
+    def test_examples_validate(self):
+        for kind in ("canary", "promotion"):
+            assert kind in schema.KINDS
+            assert not schema.validate_record(schema.EXAMPLES[kind])
+
+    def test_selfcheck_green(self):
+        ok, problems = schema.selfcheck()
+        assert ok, problems
+
+    def test_canary_constructor_and_helper(self):
+        rec = schema.canary_record("r1", 5, "pass",
+                                   baseline_generation=4,
+                                   shadow_requests=32)
+        assert not schema.validate_record(rec)
+        tel, sink = _telemetry()
+        out = tel.canary(generation=5, verdict="fail",
+                         quality_delta=0.2)
+        assert not schema.validate_record(out)
+        assert sink.records[-1]["kind"] == "canary"
+        assert tel.registry.snapshot()["pipeline.canary.fail"] == 1
+
+    def test_promotion_constructor_and_helper(self):
+        rec = schema.promotion_record("r1", "promoted",
+                                      from_generation=4,
+                                      to_generation=5)
+        assert not schema.validate_record(rec)
+        tel, sink = _telemetry()
+        out = tel.promotion(decision="rolled_back", from_generation=5,
+                            to_generation=4)
+        assert not schema.validate_record(out)
+        assert sink.records[-1]["decision"] == "rolled_back"
+
+    def test_rollback_generation_recovery_action(self):
+        assert "rollback_generation" in schema.RECOVERY_ACTIONS
+        tel, sink = _telemetry()
+        rec = tel.recovery(action="rollback_generation",
+                           from_generation=5, generation=4,
+                           reason="post-check failed")
+        assert not schema.validate_record(rec)
+
+    def test_bad_required_types_rejected(self):
+        rec = schema.canary_record("r1", 5, "pass")
+        rec["generation"] = "five"
+        assert schema.validate_record(rec)
+        rec2 = schema.promotion_record("r1", "promoted")
+        del rec2["decision"]
+        assert schema.validate_record(rec2)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the promotion gate (obs.perfgate.gate_promotion)
+
+
+def _canary(gen=5, **over):
+    rec = {"schema_version": schema.SCHEMA_VERSION, "kind": "canary",
+           "run_id": "r1", "generation": gen, "verdict": "pass",
+           "baseline_generation": gen - 1, "shadow_requests": 64,
+           "quality_baseline": 0.50, "quality_candidate": 0.49,
+           "p50_ms": 1.0, "p99_ms": 2.0,
+           "baseline_p50_ms": 1.0, "baseline_p99_ms": 2.0}
+    rec.update(over)
+    return rec
+
+
+class TestGatePromotion:
+    def test_pass(self):
+        g = perfgate.gate_promotion([_canary()])
+        assert g.ok and not g.refused and g.exit_code() == 0
+
+    def test_quality_regression_fails(self):
+        g = perfgate.gate_promotion(
+            [_canary(quality_candidate=0.60)])
+        assert not g.ok and g.exit_code() == 1
+        assert any("holdout_loss" in f for f in g.failures)
+
+    def test_latency_regression_fails(self):
+        g = perfgate.gate_promotion([_canary(p99_ms=4.0)])
+        assert g.exit_code() == 1
+
+    def test_thin_shadow_traffic_refuses(self):
+        g = perfgate.gate_promotion([_canary(shadow_requests=3)])
+        assert g.refused and g.exit_code() == 2
+
+    def test_spec_mismatch_refuses(self):
+        g = perfgate.gate_promotion([_canary(
+            baseline_spec={"kind": "logistic"},
+            candidate_spec={"kind": "linear"})])
+        assert g.refused and g.exit_code() == 2
+
+    def test_contention_flag_refuses(self):
+        g = perfgate.gate_promotion([_canary(contention_flagged=True)])
+        assert g.refused and g.exit_code() == 2
+
+    def test_missing_quality_refuses(self):
+        rec = _canary()
+        del rec["quality_candidate"]
+        g = perfgate.gate_promotion([rec])
+        assert g.refused
+
+    def test_vacuous_and_require_canary(self):
+        assert perfgate.gate_promotion([]).exit_code() == 0
+        g = perfgate.gate_promotion([], require_canary=True)
+        assert g.refused and g.exit_code() == 2
+
+    def test_quality_threshold_knob(self):
+        rec = _canary(quality_candidate=0.52)  # +4% relative
+        assert perfgate.gate_promotion([rec]).ok
+        assert not perfgate.gate_promotion(
+            [rec], quality_threshold=0.01).ok
+
+    def test_record_is_schema_valid(self):
+        g = perfgate.gate_promotion([_canary()])
+        assert not schema.validate_record(g.record(run_id="r1"))
+
+    def test_report_renders(self):
+        g = perfgate.gate_promotion([_canary(shadow_requests=1)])
+        text = perfgate.format_promotion_report(g)
+        assert "REFUSED" in text
+
+    def test_cli_promotion_exit_codes(self, tmp_path):
+        from tools import perf_gate as cli
+
+        def run(recs, *extra):
+            path = tmp_path / "c.jsonl"
+            with open(path, "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+            return cli.main([str(path), "--promotion", *extra])
+
+        assert run([_canary()]) == 0
+        assert run([_canary(quality_candidate=0.9)]) == 1
+        assert run([_canary(shadow_requests=1)]) == 2
+        assert run([]) == 2  # --promotion requires canary evidence
+        assert run([_canary(quality_candidate=0.52)],
+                   "--quality-threshold", "0.01") == 1
+
+
+# ---------------------------------------------------------------------------
+# tentpole: the continuous trainer
+
+
+def _trainer(tmp_path, tel=None, **over):
+    prox, reg_value = smooth_lib.make_prox(L2Prox(), 0.01)
+    kwargs = dict(
+        prox=prox, reg_value=reg_value,
+        w0=np.zeros(D, np.float32),
+        config=agd.AGDConfig(num_iterations=8, convergence_tol=0.0),
+        make_model=lambda w: LogisticRegressionModel(
+            np.asarray(w, np.float32), 0.0),
+        telemetry=tel)
+    kwargs.update(over)
+    reg = ModelRegistry(str(tmp_path), telemetry=tel)
+    return ContinuousTrainer(reg, LogisticGradient(), **kwargs), reg
+
+
+class TestContinuousTrainer:
+    def test_epochs_warm_start_and_publish(self, tmp_path):
+        trainer, reg = _trainer(tmp_path)
+        X, y = _data(seed=1)
+        r1 = trainer.run_epoch(X, y)
+        X2, y2 = _data(seed=2)
+        r2 = trainer.run_epoch(X2, y2)
+        assert (r1.generation, r2.generation) == (1, 2)
+        assert r2.epoch == 2
+        # warm start: epoch 2 began from epoch 1's weights, moved on
+        assert not np.allclose(np.asarray(r1.weights),
+                               np.asarray(r2.weights))
+        assert trainer.total_iters == 16
+        # published candidates round-trip through the registry
+        assert np.allclose(
+            np.asarray(reg.load(2).model.weights),
+            np.asarray(r2.weights))
+
+    def test_compile_once_epochs_share_build_and_cache(self, tmp_path):
+        trainer, _ = _trainer(tmp_path)
+        X, y = _data(seed=1)
+        trainer.run_epoch(X, y)
+        build = trainer._build
+        cache_keys = set(trainer._seg_cache)
+        X2, y2 = _data(seed=2)
+        trainer.run_epoch(X2, y2)
+        assert trainer._build is build
+        assert set(trainer._seg_cache) == cache_keys  # same program
+
+    def test_weight_fault_corrupts_publish_not_chain(self, tmp_path):
+        fault = lambda epoch, w: np.asarray(w) + 100.0  # noqa: E731
+        trainer, reg = _trainer(tmp_path, weight_fault=fault)
+        X, y = _data(seed=1)
+        r = trainer.run_epoch(X, y)
+        published = np.asarray(reg.load(r.generation).model.weights)
+        assert np.allclose(published, np.asarray(r.weights) + 100.0)
+        # the warm-start chain kept the CLEAN weights
+        assert np.allclose(np.asarray(trainer.weights),
+                           np.asarray(r.weights))
+
+    def test_epoch_emits_trace_span(self, tmp_path):
+        tel, sink = _telemetry()
+        trainer, _ = _trainer(tmp_path, tel=tel)
+        X, y = _data(seed=1)
+        trainer.run_epoch(X, y)
+        spans = [r for r in sink.records
+                 if r.get("kind") == "span"
+                 and r.get("name") == "pipeline_epoch"
+                 and "generation" in r]  # the completed span
+        assert len(spans) == 1 and spans[0].get("trace_id")
+
+
+# ---------------------------------------------------------------------------
+# tentpole: canary window + typed promotion decisions
+
+
+def _serving_stack(tmp_path, tel, **canary_over):
+    """A registry with one good serving generation, its engine+queue,
+    and a canary controller graded on a real held-out set."""
+    Xv, yv = _data(seed=5, n=96)
+    reg = ModelRegistry(str(tmp_path), telemetry=tel)
+    g1 = _model(seed=99)  # weights ~ the data's true w (seed 99)
+    reg.publish(g1)
+    engine = ServeEngine(g1, generation=1, max_batch=8, min_bucket=4,
+                         telemetry=tel)
+    reg.refresh(engine)
+    queue = MicroBatchQueue(engine, telemetry=tel).start()
+    kwargs = dict(telemetry=tel, holdout=(Xv, yv), slice_fraction=1.0,
+                  min_shadow_requests=2,
+                  thresholds={"p50_ms": 50.0, "p99_ms": 50.0})
+    kwargs.update(canary_over)
+    return reg, engine, queue, CanaryController(reg, engine, queue,
+                                                **kwargs)
+
+
+class TestCanaryAndPromotion:
+    def test_pass_window_promotes(self, tmp_path):
+        tel, sink = _telemetry()
+        reg, engine, queue, ctl = _serving_stack(tmp_path, tel)
+        try:
+            gen = reg.publish(_model(seed=99))  # identical quality
+            assert ctl.start_canary(gen, epoch=1)
+            for i in range(6):
+                ctl.submit(_rng(i).normal(size=(3, D)).astype(
+                    np.float32)).result(timeout=30)
+            assert ctl.shadow_count >= 2
+            report = ctl.finish_canary()
+            assert report.verdict == "pass"
+            assert not ctl.active
+            decision = Promoter(reg, engine,
+                                telemetry=tel).decide(report)
+            assert decision.decision == "promoted"
+            assert decision.to_generation == gen
+            assert reg.current.generation == gen
+            assert engine.generation == gen
+        finally:
+            queue.stop()
+        kinds = [r["kind"] for r in sink.records]
+        assert "canary" in kinds and "promotion" in kinds
+        assert all(not schema.validate_record(r) for r in sink.records)
+
+    def test_quality_regression_rejected_head_stays(self, tmp_path):
+        tel, sink = _telemetry()
+        reg, engine, queue, ctl = _serving_stack(tmp_path, tel)
+        try:
+            gen = reg.publish(_model(seed=3, scale=40.0))  # terrible
+            assert ctl.start_canary(gen, epoch=1)
+            for i in range(6):
+                ctl.submit(_rng(i).normal(size=(3, D)).astype(
+                    np.float32)).result(timeout=30)
+            report = ctl.finish_canary()
+            assert report.verdict == "fail"
+            decision = Promoter(reg, engine,
+                                telemetry=tel).decide(report)
+            assert decision.decision == "rejected"
+            assert reg.current.generation == 1  # HEAD never moved
+        finally:
+            queue.stop()
+
+    def test_missing_candidate_refused_preflight(self, tmp_path):
+        tel, sink = _telemetry()
+        reg, engine, queue, ctl = _serving_stack(tmp_path, tel)
+        try:
+            assert not ctl.start_canary(42, epoch=1)
+            report = ctl.finish_canary()
+            assert report.verdict == "refused"
+            assert report.refusals
+            decision = Promoter(reg, engine,
+                                telemetry=tel).decide(report)
+            assert decision.decision == "rejected"
+            assert decision.gate_status == "refused"
+        finally:
+            queue.stop()
+
+    def test_spec_mismatch_refused_preflight(self, tmp_path):
+        tel, _ = _telemetry()
+        reg, engine, queue, ctl = _serving_stack(tmp_path, tel)
+        try:
+            r = _rng(4)
+            gen = reg.publish(LinearRegressionModel(
+                r.normal(size=D).astype(np.float32), 0.0))
+            assert not ctl.start_canary(gen)
+            report = ctl.finish_canary()
+            assert report.verdict == "refused"
+            assert any("spec mismatch" in s for s in report.refusals)
+            assert report.record.get("candidate_spec")
+        finally:
+            queue.stop()
+
+    def test_fault_injected_pass_rolls_back(self, tmp_path):
+        """The drill's story in miniature: the canary is lied to
+        (quality_override), the repoint happens, the post-promotion
+        check catches the live regression, and HEAD rolls back —
+        recovery action, flight path and all."""
+        tel, sink = _telemetry()
+        reg, engine, queue, ctl = _serving_stack(tmp_path, tel)
+        Xv, yv = _data(seed=5, n=96)
+        try:
+            good_loss = float(log_loss(
+                reg.current.model.predict_proba(Xv), yv))
+            gen = reg.publish(_model(seed=3, scale=40.0))  # corrupted
+            assert ctl.start_canary(gen, epoch=2,
+                                    quality_override=good_loss)
+            for i in range(6):
+                ctl.submit(_rng(i).normal(size=(3, D)).astype(
+                    np.float32)).result(timeout=30)
+            report = ctl.finish_canary()
+            assert report.verdict == "pass"  # the lie worked
+            assert report.record["quality_fault_injected"] is True
+
+            def post_check(loaded):
+                live = float(log_loss(
+                    loaded.model.predict_proba(Xv), yv))
+                ok = live <= good_loss * 1.5
+                return ok, "" if ok else f"live loss {live:.3f}"
+
+            decision = Promoter(reg, engine, telemetry=tel,
+                                post_check=post_check).decide(report)
+            assert decision.decision == "rolled_back"
+            assert decision.to_generation == 1
+            assert reg.current.generation == 1
+            assert engine.generation == 1
+        finally:
+            queue.stop()
+        rollbacks = [r for r in sink.records
+                     if r.get("kind") == "recovery"
+                     and r.get("action") == "rollback_generation"]
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["from_generation"] == 2
+        promo = [r for r in sink.records
+                 if r.get("kind") == "promotion"][-1]
+        assert promo["decision"] == "rolled_back"
+        assert promo["evidence"]["post_check"]
+
+    def test_double_start_raises(self, tmp_path):
+        tel, _ = _telemetry()
+        reg, engine, queue, ctl = _serving_stack(tmp_path, tel)
+        try:
+            gen = reg.publish(_model(seed=99))
+            assert ctl.start_canary(gen)
+            with pytest.raises(RuntimeError):
+                ctl.start_canary(gen)
+            ctl.finish_canary()
+        finally:
+            queue.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the report rollup
+
+
+class TestPipelineReport:
+    def test_pipeline_section_and_filter(self, tmp_path, capsys):
+        from tools import agd_report
+
+        path = tmp_path / "p.jsonl"
+        recs = [
+            schema.canary_record("rX", 5, "pass", epoch=1,
+                                 baseline_generation=4,
+                                 shadow_requests=30,
+                                 quality_delta=-0.01, p99_ms=2.0),
+            schema.promotion_record("rX", "promoted", epoch=1,
+                                    candidate_generation=5,
+                                    from_generation=4,
+                                    to_generation=5),
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        assert agd_report.main([str(path), "--pipeline"]) == 0
+        out = capsys.readouterr().out
+        assert "== pipeline" in out and "promoted" in out \
+            and "g5" in out
+        assert agd_report.main([str(path)]) == 0
+        assert "== pipeline" in capsys.readouterr().out
+
+    def test_pipeline_filter_empty_errors(self, tmp_path, capsys):
+        from tools import agd_report
+
+        path = tmp_path / "empty.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(schema.stamp(
+                {"name": "x"}, tool="t")) + "\n")
+        assert agd_report.main([str(path), "--pipeline"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the drill tool (reduced smoke; the full drill is the CI acceptance)
+
+
+class TestPipelineDrillTool:
+    def test_reduced_smoke(self, tmp_path):
+        from tools import pipeline_drill
+
+        rc = pipeline_drill.main([
+            "--out", str(tmp_path), "--epochs", "2",
+            "--fail-epoch", "2", "--clients", "2", "--iters", "6",
+            "--rows", "64", "--min-shadow", "4", "--slice", "1.0"])
+        assert rc == 0
+        records = schema.read_jsonl(
+            str(tmp_path / "pipeline_drill.jsonl"))
+        decisions = [r["decision"] for r in records
+                     if r.get("kind") == "promotion"]
+        assert decisions.count("promoted") == 1
+        assert decisions.count("rolled_back") == 1

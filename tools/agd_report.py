@@ -67,7 +67,8 @@ def _kind(rec: dict) -> Optional[str]:
     if k in ("run", "iteration", "span", "metrics", "attempt",
              "recovery", "numerics_failure", "contract_pin",
              "serve_request", "serve_latency", "trace_summary",
-             "scaling_curve", "skew_estimate", "rebalance"):
+             "scaling_curve", "skew_estimate", "rebalance",
+             "canary", "promotion"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -425,6 +426,60 @@ def summarize_scheduling(skews: List[dict], rebalances: List[dict],
     return _table(headers, rows)
 
 
+def summarize_pipeline(canaries: List[dict], promotions: List[dict],
+                       recoveries: List[dict]) -> str:
+    """The continuous-learning rollup (``canary`` / ``promotion``
+    records plus ``rollback_generation`` recovery actions from
+    ``spark_agd_tpu.pipeline``): one row per promotion decision,
+    joined to its canary window by candidate generation — the
+    generation ledger an operator reads to see which candidates
+    earned HEAD, which were turned away, and which had to be
+    un-promoted."""
+    by_candidate: Dict[tuple, dict] = {}
+    for rec in canaries:
+        key = (rec.get("run_id", "-"), rec.get("generation"))
+        by_candidate[key] = rec  # file order: keep the newest window
+    rollbacks = {(r.get("run_id", "-"), r.get("from_generation"))
+                 for r in recoveries
+                 if r.get("action") == "rollback_generation"}
+    headers = ["run_id", "epoch", "candidate", "canary", "q_delta",
+               "shadow_reqs", "p99_ms", "decision", "head"]
+    rows = []
+    for rec in promotions:
+        run_id = rec.get("run_id", "-")
+        cand = rec.get("candidate_generation")
+        can = by_candidate.get((run_id, cand), {})
+        decision = rec.get("decision", "-")
+        if (run_id, cand) in rollbacks and decision != "rolled_back":
+            decision += "*"  # a later record tells the rollback story
+        head = rec.get("to_generation")
+        rows.append([
+            _fmt(run_id)[:18], _fmt(rec.get("epoch")),
+            f"g{cand}" if cand is not None else "-",
+            _fmt(can.get("verdict", "-"))
+            + ("!" if can.get("quality_fault_injected") else ""),
+            _fmt(can.get("quality_delta"), 4),
+            _fmt(can.get("shadow_requests")),
+            _fmt(can.get("p99_ms"), 2),
+            decision,
+            f"g{head}" if head is not None else "-",
+        ])
+    lines = [_table(headers, rows)]
+    orphans = [k for k in by_candidate
+               if not any(r.get("candidate_generation") == k[1]
+                          and r.get("run_id", "-") == k[0]
+                          for r in promotions)]
+    if orphans:
+        lines.append(f"note: {len(orphans)} canary window(s) never "
+                     "reached a promotion decision")
+    refused = sum(1 for r in canaries if r.get("verdict") == "refused")
+    if refused:
+        lines.append(f"note: {refused} canary window(s) REFUSED to "
+                     "grade (thin shadow traffic, spec mismatch, or "
+                     "contention)")
+    return "\n".join(lines)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -520,6 +575,11 @@ def main(argv=None) -> int:
                         "(skew_estimate/rebalance records and "
                         "speculative executions; the gate lives in "
                         "tools/perf_gate.py --rebalance)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="print only the == pipeline == rollup "
+                        "(canary/promotion records and rollbacks; "
+                        "the gate lives in tools/perf_gate.py "
+                        "--promotion)")
     args = p.parse_args(argv)
 
     if args.compare:
@@ -539,6 +599,7 @@ def main(argv=None) -> int:
     attempts, recoveries, numerics, pins = [], [], [], []
     serve_reqs, serve_lats, curves = [], [], []
     skews, rebalances = [], []
+    canaries, promotions = [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -567,6 +628,10 @@ def main(argv=None) -> int:
             skews.append(rec)
         elif k == "rebalance":
             rebalances.append(rec)
+        elif k == "canary":
+            canaries.append(rec)
+        elif k == "promotion":
+            promotions.append(rec)
         elif k is None:
             unknown += 1
 
@@ -580,6 +645,15 @@ def main(argv=None) -> int:
               f"{len(rebalances)} rebalances, {len(spec_recs)} "
               f"speculative executions) ==")
         print(summarize_scheduling(skews, rebalances, recoveries))
+        return 0
+
+    if args.pipeline:
+        if not (canaries or promotions):
+            print("no canary/promotion records found", file=sys.stderr)
+            return 1
+        print(f"== pipeline ({len(canaries)} canaries, "
+              f"{len(promotions)} promotion decisions) ==")
+        print(summarize_pipeline(canaries, promotions, recoveries))
         return 0
 
     if args.scaling:
@@ -623,6 +697,10 @@ def main(argv=None) -> int:
               f"{len(rebalances)} rebalances, {len(spec_recs)} "
               f"speculative executions) ==")
         print(summarize_scheduling(skews, rebalances, recoveries))
+    if canaries or promotions:
+        print(f"\n== pipeline ({len(canaries)} canaries, "
+              f"{len(promotions)} promotion decisions) ==")
+        print(summarize_pipeline(canaries, promotions, recoveries))
     tracing = summarize_tracing(records, recoveries, args.trace)
     if tracing:
         print("\n== tracing ==")

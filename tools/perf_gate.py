@@ -61,6 +61,17 @@ def main(argv=None) -> int:
                         "its post-rebalance straggler score below the "
                         "pre-rebalance value; exit 2 when the "
                         "boundary spans are missing)")
+    p.add_argument("--promotion", action="store_true",
+                   help="single-file mode: gate promotion over "
+                        "BASELINE.jsonl's canary records (held-out "
+                        "quality AND shadow p50/p99 latency must both "
+                        "hold; exit 2 on too few shadow requests, "
+                        "cross-generation spec mismatch, or "
+                        "contention-flagged latency)")
+    p.add_argument("--quality-threshold", type=float, default=None,
+                   metavar="REL",
+                   help="--promotion: relative held-out-loss "
+                        "regression allowed (default 0.05)")
     p.add_argument("--threshold", action="append", metavar="NAME=REL",
                    help="override one metric's relative threshold "
                         "(repeatable); 'collectives' is an ABSOLUTE "
@@ -91,8 +102,26 @@ def main(argv=None) -> int:
                                          require_rebalance=True)
         print(perfgate.format_rebalance_report(result))
         return result.exit_code()
+    if args.promotion:
+        if args.candidate is not None:
+            p.error("--promotion is single-file: pass only RECORDS.jsonl")
+        try:
+            records = perfgate.load_records(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: cannot read records: {e}",
+                  file=sys.stderr)
+            return 2
+        kw = {"require_canary": True}
+        if args.quality_threshold is not None:
+            kw["quality_threshold"] = args.quality_threshold
+        if args.threshold:
+            kw["thresholds"] = _parse_thresholds(args.threshold, p)
+        result = perfgate.gate_promotion(records, **kw)
+        print(perfgate.format_promotion_report(result))
+        return result.exit_code()
     if args.candidate is None:
-        p.error("CANDIDATE.jsonl is required (unless --rebalance)")
+        p.error("CANDIDATE.jsonl is required (unless --rebalance "
+                "or --promotion)")
 
     thresholds = _parse_thresholds(args.threshold, p)
     try:

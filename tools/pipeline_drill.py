@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Continuous-learning drill — CI proof the train→serve loop closes.
+
+One process, CPU-only, under sustained serve_drill-level concurrent
+load the whole time: ``--clients`` threads fire live requests through
+the canary controller against serving HEAD while the pipeline runs
+``--epochs`` warm-started training epochs.  Each epoch:
+
+1. **train** — ``pipeline.ContinuousTrainer`` runs one supervised AGD
+   epoch (compile-once staged build, shared segment cache, per-epoch
+   checkpointer) and **publishes** the result as a candidate
+   generation through the manifest commit protocol;
+2. **canary** — ``pipeline.CanaryController`` shadow-serves the
+   candidate on a slice of the live traffic (a second ``ServeEngine``
+   beside HEAD) until enough shadow evidence accumulates, then grades
+   it through the REAL ``obs.perfgate.gate_promotion`` (held-out
+   quality AND shadow p50/p99);
+3. **promote** — ``pipeline.Promoter`` repoints HEAD on a passing
+   gate, re-checks quality against the LIVE generation, and rolls
+   back automatically when the post-check fails.
+
+At ``--fail-epoch`` the drill corrupts the PUBLISHED candidate's
+weights while lying to the canary's quality leg with the clean
+model's held-out loss (``quality_override``, stamped
+``quality_fault_injected``) — the canary passes, the repoint happens,
+and the post-promotion check must catch the regression and roll HEAD
+back to the previously-serving generation, emitting the
+``rollback_generation`` recovery action and a flight-recorder dump.
+
+PASS (exit 0) requires: at least one ``promoted`` decision and
+exactly one ``rolled_back``; ZERO dropped admitted requests across
+the whole run; every emitted record schema-valid; the promotion gate
+re-run over the emitted canary records agreeing with the recorded
+verdicts; and the whole train→publish→canary→promote→rollback story
+assembling into ONE connected causal tree (``obs.timeline``) that
+``tools/agd_trace.py`` reconstructs (exit 0).  Any miss prints the
+reason and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/pipeline_drill.py [--out DIR] [-v]
+
+CPU-deterministic apart from wall-clock; runs in under a minute.  See
+``docs/CONTINUOUS.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+if _HERE not in sys.path:  # `import agd_trace` under pytest too
+    sys.path.append(_HERE)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/pipeline_drill.py",
+        description="continuous-learning pipeline drill")
+    p.add_argument("--out", default=None,
+                   help="artifact directory (default: a tempdir)")
+    p.add_argument("--epochs", type=int, default=4,
+                   help="training epochs / candidate generations "
+                        "(default 4)")
+    p.add_argument("--fail-epoch", type=int, default=3,
+                   help="epoch whose published candidate is corrupted "
+                        "(0 disables the forced rollback; default 3)")
+    p.add_argument("--clients", type=int, default=4,
+                   help="concurrent live-traffic threads (default 4)")
+    p.add_argument("--features", type=int, default=8)
+    p.add_argument("--rows", type=int, default=192,
+                   help="training rows per epoch minibatch")
+    p.add_argument("--iters", type=int, default=30,
+                   help="AGD iterations per epoch")
+    p.add_argument("--slice", type=float, default=0.5,
+                   help="canary traffic slice fraction (default 0.5)")
+    p.add_argument("--min-shadow", type=int, default=16,
+                   help="shadow requests required before a canary "
+                        "window may close (default 16)")
+    p.add_argument("--latency-slack", type=float, default=5.0,
+                   help="relative p50/p99 slack for the canary gate "
+                        "(generous: CI hosts are contended)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.epochs < 2 or (args.fail_epoch
+                           and not 1 < args.fail_epoch <= args.epochs):
+        print("need >= 2 epochs and 1 < fail-epoch <= epochs",
+              file=sys.stderr)
+        return 1
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import agd
+    from spark_agd_tpu.core import smooth as smooth_lib
+    from spark_agd_tpu.models.evaluation import log_loss
+    from spark_agd_tpu.models.glm import LogisticRegressionModel
+    from spark_agd_tpu.obs import (JSONLSink, Telemetry, perfgate,
+                                   schema, timeline,
+                                   trace as trace_lib)
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.pipeline import (CanaryController,
+                                        ContinuousTrainer, Promoter)
+    from spark_agd_tpu.resilience.supervisor import ResiliencePolicy
+    from spark_agd_tpu.serve import (MicroBatchQueue, ModelRegistry,
+                                     ServeEngine)
+    from spark_agd_tpu.utils import compile_cache
+
+    failures = []
+
+    def check(ok, what):
+        tag = "ok" if ok else "FAIL"
+        if args.verbose or not ok:
+            print(f"[{tag}] {what}")
+        if not ok:
+            failures.append(what)
+        return ok
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="pipeline_drill_")
+    os.makedirs(out_dir, exist_ok=True)
+    jsonl = os.path.join(out_dir, "pipeline_drill.jsonl")
+    telemetry = Telemetry([JSONLSink(jsonl)], flight_dir=out_dir)
+    compile_cache.enable(os.path.join(out_dir, "xla_cache"),
+                         min_compile_time_secs=0)
+
+    D = args.features
+    rng = np.random.default_rng(args.seed)
+    w_true = rng.normal(size=D).astype(np.float32)
+
+    def make_batch(seed):
+        r = np.random.default_rng(seed)
+        X = r.normal(size=(args.rows, D)).astype(np.float32)
+        pr = 1.0 / (1.0 + np.exp(-(X @ w_true)))
+        y = (r.random(args.rows) < pr).astype(np.float32)
+        return X, y
+
+    Xv, yv = make_batch(10_000)  # the held-out quality set
+
+    def make_model(w):
+        return LogisticRegressionModel(
+            np.asarray(w, np.float32), 0.0, threshold=0.5)
+
+    def holdout_loss(model):
+        return float(log_loss(model.predict_proba(Xv), yv))
+
+    corrupted = {}  # epoch -> clean weights (the canary's lie)
+
+    def weight_fault(epoch, w):
+        if epoch != args.fail_epoch:
+            return w
+        corrupted[epoch] = np.asarray(w, np.float32)
+        r = np.random.default_rng(777)
+        return jnp.asarray(np.asarray(w, np.float32)
+                           + r.normal(size=D).astype(np.float32) * 25.0)
+
+    # -- bootstrap: generation 1 serves while epoch 1 trains -------------
+    registry = ModelRegistry(os.path.join(out_dir, "registry"),
+                             telemetry=telemetry)
+    registry.publish(make_model(np.zeros(D, np.float32)))
+    engine = ServeEngine(make_model(np.zeros(D, np.float32)),
+                         generation=1, max_batch=16, min_bucket=4,
+                         telemetry=telemetry)
+    registry.refresh(engine)
+    queue = MicroBatchQueue(engine, max_wait_us=1500,
+                            max_queue_rows=64 * 16,
+                            telemetry=telemetry).start()
+    controller = CanaryController(
+        registry, engine, queue, telemetry=telemetry,
+        holdout=(Xv, yv), slice_fraction=args.slice,
+        min_shadow_requests=args.min_shadow,
+        thresholds={"p50_ms": args.latency_slack,
+                    "p99_ms": args.latency_slack})
+
+    last_good = {"loss": holdout_loss(registry.current.model)}
+
+    def post_check(loaded):
+        live = holdout_loss(loaded.model)
+        # generous 50% relative bound vs the last healthy HEAD — a
+        # corrupted candidate regresses by orders of magnitude
+        if live <= last_good["loss"] * 1.5 + 1e-6:
+            return True, ""
+        return False, (f"holdout loss {live:.4f} regressed vs last "
+                       f"healthy HEAD {last_good['loss']:.4f}")
+
+    promoter = Promoter(registry, engine, telemetry=telemetry,
+                        post_check=post_check)
+    trainer = ContinuousTrainer(
+        registry, LogisticGradient(),
+        prox=(pair := smooth_lib.make_prox(L2Prox(), 0.01))[0],
+        reg_value=pair[1],
+        w0=jnp.zeros(D, jnp.float32),
+        config=agd.AGDConfig(convergence_tol=0.0,
+                             num_iterations=args.iters),
+        make_model=make_model,
+        policy=ResiliencePolicy(max_attempts=3, backoff_base=0.01,
+                                backoff_max=0.05, jitter=0.0, seed=0,
+                                segment_iters=max(5, args.iters // 2)),
+        telemetry=telemetry,
+        checkpoint_path=os.path.join(out_dir, "ckpt", "epoch.npz"),
+        weight_fault=weight_fault if args.fail_epoch else None)
+
+    # -- sustained live load under ONE root trace span -------------------
+    root_span = telemetry.trace_span("pipeline_drill", tool="pipeline")
+    root_ctx = root_span.__enter__()
+    stop = threading.Event()
+    served = {"n": 0, "dropped": 0}
+    lock = threading.Lock()
+
+    def client(idx):
+        crng = np.random.default_rng(1000 + idx)
+        with trace_lib.activate(root_ctx):
+            while not stop.is_set():
+                n = int(crng.integers(1, 17))
+                op = "predict_proba" if (served["n"] % 3) else "predict"
+                X = crng.normal(size=(n, D)).astype(np.float32)
+                try:
+                    controller.submit(X, op).result(timeout=60)
+                except Exception:  # noqa: BLE001 — counted, not raised
+                    with lock:
+                        served["dropped"] += 1
+                    continue
+                with lock:
+                    served["n"] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.clients)]
+    for t in threads:
+        t.start()
+
+    # -- the loop: train -> publish -> canary -> promote -----------------
+    decisions = []
+    reports = []
+    try:
+        for epoch in range(1, args.epochs + 1):
+            X, y = make_batch(epoch)
+            er = trainer.run_epoch(X, y)
+            lie = None
+            if epoch == args.fail_epoch and epoch in corrupted:
+                lie = holdout_loss(make_model(corrupted[epoch]))
+            controller.start_canary(er.generation, epoch=epoch,
+                                    quality_override=lie)
+            deadline = time.monotonic() + 30.0
+            while (controller.shadow_count < args.min_shadow
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            report = controller.finish_canary()
+            reports.append(report)
+            decision = promoter.decide(report)
+            decisions.append(decision)
+            if decision.decision == "promoted":
+                last_good["loss"] = holdout_loss(
+                    registry.current.model)
+            if args.verbose:
+                print(f"epoch {epoch}: g{er.generation} "
+                      f"loss={er.final_loss:.4f} "
+                      f"canary={report.verdict} "
+                      f"-> {decision.decision} "
+                      f"(HEAD g{decision.to_generation})")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        queue.emit_latency()
+        queue.stop()
+        root_span.__exit__(None, None, None)
+
+    # -- the loop's outcome ----------------------------------------------
+    by_decision = {}
+    for d in decisions:
+        by_decision.setdefault(d.decision, []).append(d)
+    n_promoted = len(by_decision.get("promoted", []))
+    n_rolled = len(by_decision.get("rolled_back", []))
+    check(n_promoted >= 1,
+          f"at least one generation promoted on a passing gate "
+          f"({n_promoted} promoted)")
+    if args.fail_epoch:
+        check(n_rolled == 1,
+              f"exactly one forced failed canary rolled back "
+              f"({n_rolled} rollbacks)")
+        rb = by_decision.get("rolled_back", [])
+        check(bool(rb) and rb[0].to_generation
+              == rb[0].from_generation,
+              "the rollback repointed HEAD to the previously-serving "
+              "generation")
+        check(bool(rb) and registry.current is not None
+              and registry.current.generation
+              != rb[0].candidate_generation,
+              "the corrupted candidate is NOT serving after the drill")
+    check(all(r.verdict == "pass" for r in reports)
+          or any(d.decision != "promoted" for d in decisions),
+          "every canary verdict fed a typed decision")
+    check(served["dropped"] == 0 and served["n"] > 0,
+          f"zero dropped admitted requests under sustained load "
+          f"({served['n']} served, {served['dropped']} dropped)")
+
+    # -- the emitted evidence --------------------------------------------
+    telemetry.flush()
+    records = schema.read_jsonl(jsonl)
+    bad = [(i, errs) for i, rec in enumerate(records, 1)
+           for errs in [schema.validate_record(rec)] if errs]
+    check(records and not bad,
+          f"all {len(records)} emitted records schema-valid"
+          + (f" — first bad: {bad[0]}" if bad else ""))
+    canaries = [r for r in records if r.get("kind") == "canary"]
+    promotions = [r for r in records if r.get("kind") == "promotion"]
+    rollbacks = [r for r in records if r.get("kind") == "recovery"
+                 and r.get("action") == "rollback_generation"]
+    dumps = [r for r in records if r.get("kind") == "recovery"
+             and r.get("action") == "flight_dump"]
+    check(len(canaries) == args.epochs
+          and len(promotions) == args.epochs,
+          f"one canary and one promotion record per epoch "
+          f"({len(canaries)}/{len(promotions)} for {args.epochs})")
+    expect_rb = 1 if args.fail_epoch else 0
+    check(len(rollbacks) == expect_rb and len(dumps) >= expect_rb,
+          f"the rollback rode the resilience machinery "
+          f"({len(rollbacks)} rollback_generation, {len(dumps)} "
+          "flight_dump records)")
+
+    # the REAL promotion gate, re-run over the emitted canary records,
+    # must agree with the verdicts the controller recorded
+    gate = perfgate.gate_promotion(
+        canaries, thresholds={"p50_ms": args.latency_slack,
+                              "p99_ms": args.latency_slack},
+        min_shadow_requests=args.min_shadow, require_canary=True)
+    verdicts_pass = all(r.get("verdict") == "pass" for r in canaries)
+    check(gate.exit_code() == (0 if verdicts_pass else 1)
+          or bool(gate.refusals) == any(
+              r.get("verdict") == "refused" for r in canaries),
+          f"gate_promotion over the emitted canaries agrees with the "
+          f"recorded verdicts (gate={gate.status()})")
+
+    # -- one causal tree tells the whole story ---------------------------
+    tree = timeline.analyze(records, root_ctx.trace_id)
+    check(tree is not None and tree.connected,
+          "the drill's spans form ONE connected causal tree"
+          + ("" if tree is None else
+             f" (spans={tree.spans}, roots={tree.roots})"))
+    spans = timeline.collect_spans(records, root_ctx.trace_id)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    for name, want in (("pipeline_epoch", args.epochs),
+                       ("canary", args.epochs),
+                       ("promotion", args.epochs)):
+        got = by_name.get(name, [])
+        check(len(got) == want,
+              f"{want} {name!r} span(s) in the tree ({len(got)})")
+        check(bool(got) and all(s.parent_id == root_ctx.span_id
+                                for s in got),
+              f"every {name!r} span is a child of the drill root")
+    check(len(by_name.get("serve_request", [])) > 0,
+          "live request spans ride the same tree as the pipeline")
+    if tree is not None:
+        telemetry.trace_summary(**tree.summary_fields(),
+                                tool="pipeline")
+    telemetry.run_summary(
+        tool="pipeline_drill", name="continuous_loop",
+        algorithm="agd", platform="cpu",
+        iters=trainer.total_iters, requests=served["n"])
+    telemetry.close()
+
+    # the consumer CLI must reconstruct the story from the artifact
+    import agd_trace
+    check(agd_trace.main([jsonl, "--trace", root_ctx.trace_id]) == 0,
+          "tools/agd_trace.py reconstructs the drill's trace tree")
+
+    if args.verbose:
+        print(f"artifacts: {jsonl}")
+    if failures:
+        print(f"PIPELINE DRILL FAILED: {len(failures)} check(s): "
+              + "; ".join(failures[:4]))
+        return 1
+    head = registry.current.generation if registry.current else "?"
+    print(f"PIPELINE DRILL PASSED: {args.epochs} epochs, "
+          f"{n_promoted} promoted, {n_rolled} rolled back, "
+          f"HEAD g{head}, {served['n']} live requests with zero "
+          "drops, one connected trace tree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
