@@ -76,6 +76,10 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     # typed promotion decision
     "canary": ((), frozenset({"generation", "verdict"})),
     "promotion": ((), frozenset({"decision"})),
+    # the serve fleet router (serve.router): one routing decision and
+    # one replica-health classification change
+    "fleet_route": ((), frozenset({"decision"})),
+    "replica_verdict": ((), frozenset({"replica", "verdict"})),
 }
 
 

@@ -22,6 +22,7 @@ observes.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Iterable, List
 
 from .sinks import Sink
@@ -40,6 +41,11 @@ class EventBus:
         self._emit_here = None  # lazily resolved host gate
         self.sink_errors = 0
         self._warned = False
+        # the serving plane emits from many threads at once (client
+        # spans, router decisions, queue latencies); a raw file write
+        # interleaves under that load, so one bus-level lock keeps
+        # every sink's record boundaries intact
+        self._lock = threading.Lock()
 
     def _host_ok(self) -> bool:
         if self.host_mode == "all":
@@ -57,19 +63,20 @@ class EventBus:
     def emit(self, record: dict) -> None:
         if not self._host_ok():
             return
-        for sink in self.sinks:
-            try:
-                sink.emit(record)
-            except Exception as e:  # noqa: BLE001 — observability must
-                # never kill the observed run
-                self.sink_errors += 1
-                if not self._warned:
-                    self._warned = True
-                    logger.warning(
-                        "telemetry sink %s failed (%s: %s); further "
-                        "sink errors are counted silently "
-                        "(bus.sink_errors)",
-                        type(sink).__name__, type(e).__name__, e)
+        with self._lock:
+            for sink in self.sinks:
+                try:
+                    sink.emit(record)
+                except Exception as e:  # noqa: BLE001 — observability
+                    # must never kill the observed run
+                    self.sink_errors += 1
+                    if not self._warned:
+                        self._warned = True
+                        logger.warning(
+                            "telemetry sink %s failed (%s: %s); "
+                            "further sink errors are counted "
+                            "silently (bus.sink_errors)",
+                            type(sink).__name__, type(e).__name__, e)
 
     def flush(self) -> None:
         for sink in self.sinks:

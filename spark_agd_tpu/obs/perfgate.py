@@ -1089,3 +1089,223 @@ def format_report(result: GateResult, *, verbose: bool = False) -> str:
                          + "; ".join(keys[:4])
                          + (" …" if len(keys) > 4 else ""))
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Fleet gate (serve.router traffic shift away from a chaos-slowed replica)
+# ---------------------------------------------------------------------------
+
+# the slowed replica must lose at least this fraction of its pre-chaos
+# traffic share for the router's "routes around slow hosts" claim to
+# count as demonstrated
+DEFAULT_FLEET_MIN_DROP = 0.25
+
+# a traffic-share comparison over fewer routed requests than this on
+# either side of the chaos boundary is sampling noise — refuse
+DEFAULT_FLEET_MIN_REQUESTS = 8
+
+
+@dataclasses.dataclass
+class FleetGateResult:
+    """The fleet-routing gate's outcome: after a ``slow_replica``
+    chaos fault fires, the slowed replica's share of routed traffic
+    (``fleet_route`` records, who actually served) must drop by at
+    least ``min_drop`` relative to its pre-chaos share.  Typed exit-2
+    refusals for comparisons that cannot be made honestly: no routes,
+    no chaos boundary, too few requests on a side, or a contaminated
+    window (the slowed replica was evicted or killed mid-window — its
+    share then drops for a reason that is NOT routing policy)."""
+
+    slow_replica: Optional[int]
+    boundary_unix: Optional[float]
+    pre_share: Optional[float]
+    post_share: Optional[float]
+    pre_counts: Dict[int, int]
+    post_counts: Dict[int, int]
+    refusals: List[str]
+    min_drop: float = DEFAULT_FLEET_MIN_DROP
+
+    @property
+    def shifted(self) -> bool:
+        return (self.pre_share is not None
+                and self.post_share is not None
+                and self.post_share
+                <= self.pre_share * (1.0 - self.min_drop))
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.refusals)
+
+    @property
+    def ok(self) -> bool:
+        return not self.refused and self.shifted
+
+    def exit_code(self) -> int:
+        """0 pass, 1 the router did not shift traffic, 2 refused."""
+        if self.refused:
+            return 2
+        return 0 if self.ok else 1
+
+    def status(self) -> str:
+        return ("refused" if self.refused
+                else "pass" if self.ok else "fail")
+
+    def record(self, run_id: Optional[str] = None,
+               tool: str = "fleet_drill") -> dict:
+        """The gate's outcome as one TYPED, schema-stamped run record
+        (the same evidence discipline as the other gates: a refusal is
+        machine-readable, not silence)."""
+        return schema.stamp({
+            "name": "fleet_gate",
+            "gate_status": self.status(),
+            "slow_replica": self.slow_replica,
+            "pre_share": self.pre_share,
+            "post_share": self.post_share,
+            "refusals": list(self.refusals),
+        }, tool=tool, kind="run", run_id=run_id)
+
+
+def _fleet_routes(records: List[dict]) -> List[dict]:
+    return [r for r in records if isinstance(r, dict)
+            and r.get("kind") == "fleet_route"
+            and r.get("decision") in ("route", "hedge")
+            and isinstance(r.get("timestamp_unix"), (int, float))]
+
+
+def _fleet_served_by(rec: dict) -> Optional[int]:
+    # `winner` is who actually answered (hedges); plain routes carry
+    # the same value in both fields
+    who = rec.get("winner", rec.get("replica"))
+    if isinstance(who, bool) or not isinstance(who, int):
+        return None
+    return who
+
+
+def gate_fleet(records: List[dict], *,
+               min_requests: int = DEFAULT_FLEET_MIN_REQUESTS,
+               min_drop: float = DEFAULT_FLEET_MIN_DROP,
+               window_s: Optional[float] = None) -> FleetGateResult:
+    """Gate the router's traffic shift over one run's records: split
+    the served ``fleet_route`` records at the FIRST ``slow_replica``
+    chaos record's timestamp and require the slowed replica's served
+    share to drop by ``min_drop``.  ``window_s`` bounds the post-chaos
+    side (default: everything after the boundary).  Contamination —
+    a ``replica_evict`` recovery or ``kill_replica`` chaos against the
+    slowed replica inside the comparison window — refuses: an evicted
+    replica's share hits zero by EVICTION, which proves nothing about
+    latency-aware routing."""
+    refusals: List[str] = []
+    routes = _fleet_routes(records)
+    slow_faults = sorted(
+        (r for r in records if isinstance(r, dict)
+         and r.get("kind") == "chaos"
+         and r.get("fault") == "slow_replica"
+         and isinstance(r.get("timestamp_unix"), (int, float))),
+        key=lambda r: r["timestamp_unix"])
+    if not routes:
+        refusals.append("no timestamped fleet_route records in the "
+                        "stream — run the fleet with telemetry")
+    if not slow_faults:
+        refusals.append("no timestamped slow_replica chaos record — "
+                        "no boundary to split traffic at")
+    if refusals:
+        return FleetGateResult(
+            slow_replica=None, boundary_unix=None, pre_share=None,
+            post_share=None, pre_counts={}, post_counts={},
+            refusals=refusals, min_drop=min_drop)
+    first = slow_faults[0]
+    slow_replica = first.get("process")
+    if isinstance(slow_replica, bool) or \
+            not isinstance(slow_replica, int):
+        return FleetGateResult(
+            slow_replica=None, boundary_unix=None, pre_share=None,
+            post_share=None, pre_counts={}, post_counts={},
+            refusals=["slow_replica chaos record carries no process "
+                      "— cannot name the slowed replica"],
+            min_drop=min_drop)
+    boundary = float(first["timestamp_unix"])
+    end = boundary + window_s if window_s is not None else None
+
+    pre_counts: Dict[int, int] = {}
+    post_counts: Dict[int, int] = {}
+    for rec in routes:
+        who = _fleet_served_by(rec)
+        if who is None:
+            continue
+        ts = float(rec["timestamp_unix"])
+        if ts < boundary:
+            pre_counts[who] = pre_counts.get(who, 0) + 1
+        elif end is None or ts <= end:
+            post_counts[who] = post_counts.get(who, 0) + 1
+    pre_n, post_n = sum(pre_counts.values()), sum(post_counts.values())
+    for label, n in (("pre", pre_n), ("post", post_n)):
+        if n < min_requests:
+            refusals.append(
+                f"only {n} routed request(s) on the {label}-chaos "
+                f"side (need >= {min_requests}) — not enough signal")
+    if pre_counts.get(slow_replica, 0) == 0 and pre_n >= min_requests:
+        refusals.append(
+            f"slowed replica {slow_replica} served no pre-chaos "
+            "traffic — a share of zero cannot drop")
+
+    window_lo = min((float(r["timestamp_unix"]) for r in routes),
+                    default=boundary)
+    window_hi = (end if end is not None else
+                 max((float(r["timestamp_unix"]) for r in routes),
+                     default=boundary))
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        ts = rec.get("timestamp_unix")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+            continue
+        if not window_lo <= float(ts) <= window_hi:
+            continue
+        if (rec.get("kind") == "recovery"
+                and rec.get("action") == "replica_evict"
+                and rec.get("process") == slow_replica):
+            refusals.append(
+                f"contaminated: replica {slow_replica} was EVICTED "
+                "inside the comparison window — its share drop is "
+                "eviction, not routing")
+            break
+        if (rec.get("kind") == "chaos"
+                and rec.get("fault") == "kill_replica"
+                and rec.get("process") == slow_replica):
+            refusals.append(
+                f"contaminated: replica {slow_replica} was KILLED "
+                "inside the comparison window — its share drop is "
+                "death, not routing")
+            break
+
+    pre_share = (pre_counts.get(slow_replica, 0) / pre_n
+                 if pre_n else None)
+    post_share = (post_counts.get(slow_replica, 0) / post_n
+                  if post_n else None)
+    return FleetGateResult(
+        slow_replica=slow_replica, boundary_unix=boundary,
+        pre_share=pre_share, post_share=post_share,
+        pre_counts=dict(sorted(pre_counts.items())),
+        post_counts=dict(sorted(post_counts.items())),
+        refusals=refusals, min_drop=min_drop)
+
+
+def format_fleet_report(result: FleetGateResult) -> str:
+    """Human-readable fleet-gate report (``tools/fleet_drill.py``'s
+    slow-replica leg)."""
+    lines: List[str] = []
+    if result.refusals:
+        lines.append("FLEET GATE REFUSED:")
+        lines.extend("  " + r for r in result.refusals)
+        return "\n".join(lines)
+    lines.append(
+        f"slow replica {result.slow_replica}: served share "
+        f"{_fmt(result.pre_share)} -> {_fmt(result.post_share)} "
+        f"(pre {result.pre_counts} / post {result.post_counts}; "
+        f"required drop >= {result.min_drop:g})")
+    lines.append(
+        "FLEET GATE: "
+        + ("pass (router shifted traffic away from the slowed "
+           "replica)" if result.ok else
+           "FAIL (the slowed replica kept its traffic share)"))
+    return "\n".join(lines)

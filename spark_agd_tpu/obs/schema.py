@@ -40,7 +40,7 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "chaos", "journal_replay", "degraded", "contract_pin",
          "serve_request", "serve_latency", "trace_summary",
          "scaling_curve", "skew_estimate", "rebalance",
-         "canary", "promotion")
+         "canary", "promotion", "fleet_route", "replica_verdict")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
@@ -50,12 +50,17 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
 # and ``speculative_exec`` are the straggler scheduler's actions
 # (resilience.scheduler); ``rollback_generation`` is the continuous-
 # learning pipeline repointing serving HEAD back to the prior
-# generation after a failed promotion (pipeline.promote).
+# generation after a failed promotion (pipeline.promote);
+# ``replica_evict``/``request_hedge``/``request_retry`` are the fleet
+# router's actions (serve.router): a LOST replica removed from the
+# candidate set, a tail request re-issued to a second replica, and an
+# in-flight request transparently re-served on a survivor.
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
                     "host_lost", "elastic_resume", "degraded_continue",
                     "hot_swap", "flight_dump", "rebalance",
-                    "speculative_exec", "rollback_generation")
+                    "speculative_exec", "rollback_generation",
+                    "replica_evict", "request_hedge", "request_retry")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -134,6 +139,14 @@ _REQUIRED: Dict[str, dict] = {
     # "promoted" | "rejected" | "rolled_back"; from/to generation and
     # the gate evidence ride as optionals
     "promotion": {"run_id": str, "decision": str},
+    # one routing decision of the serve fleet router (serve.router):
+    # ``decision`` is "route" | "hedge" | "retry" | "shed_tenant";
+    # replica/tenant/latency evidence rides as optionals
+    "fleet_route": {"run_id": str, "decision": str},
+    # one replica-health classification change (serve.router, from
+    # HostMonitor.verdicts()): ``verdict`` is "ok" | "slow" | "lost"
+    "replica_verdict": {"run_id": str, "replica": int,
+                        "verdict": str},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -252,6 +265,9 @@ _OPTIONAL: Dict[str, dict] = {
         "op": str, "status": str, "bucket": int, "batch_rows": int,
         "queue_ms": _NUM, "latency_ms": _NUM, "generation": int,
         "model": str, "error": (str, type(None)), "algorithm": str,
+        # fleet attribution (serve.router / serve.fleet): which tenant
+        # submitted the request and which replica served it
+        "tenant": str, "replica": int,
         "tool": str, "timestamp_unix": _NUM,
     },
     "serve_latency": {
@@ -259,6 +275,9 @@ _OPTIONAL: Dict[str, dict] = {
         "p99_ms": _OPT_NUM, "mean_ms": _OPT_NUM, "max_ms": _OPT_NUM,
         "queue_depth": int, "rejected": int, "errors": int,
         "hot_swaps": int, "generation": int, "window_s": _NUM,
+        # which replica's latency ring the rollup summarizes — the
+        # attribution the router's EWMA pairs its numbers against
+        "replica": int,
         "model": str, "tool": str, "timestamp_unix": _NUM,
     },
     "trace_summary": {
@@ -327,6 +346,28 @@ _OPTIONAL: Dict[str, dict] = {
         "gate_status": str, "evidence": dict, "refusals": list,
         "reason": str, "source": str, "algorithm": str, "tool": str,
         "timestamp_unix": _NUM,
+    },
+    "fleet_route": {
+        # the replica the decision targeted (for hedges: the SECOND
+        # replica the request was re-issued to; ``winner`` which one
+        # answered first)
+        "replica": int, "winner": (int, type(None)),
+        "op": str, "tenant": str, "rows": int, "attempt": int,
+        # the evidence the decision was made on: the request's elapsed
+        # latency, the replica's EWMA estimate, the fleet median, the
+        # replica's outstanding in-flight count, and its verdict
+        "latency_ms": _NUM, "ewma_ms": _OPT_NUM, "median_ms": _OPT_NUM,
+        "outstanding": int, "verdict": str, "generation": int,
+        "error": (str, type(None)), "reason": str,
+        "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
+    },
+    "replica_verdict": {
+        # staleness/phase evidence behind the classification, and the
+        # verdict it transitioned from (absent on the first sighting)
+        "age_s": _OPT_NUM, "phase": (str, type(None)),
+        "previous": (str, type(None)), "generation": int,
+        "source": str, "tool": str, "timestamp_unix": _NUM,
     },
 }
 
@@ -595,6 +636,27 @@ def promotion_record(run_id: str, decision: str, **fields) -> dict:
             "run_id": run_id, "decision": str(decision), **fields}
 
 
+def fleet_route_record(run_id: str, decision: str, **fields) -> dict:
+    """One routing decision of the serve fleet router
+    (``serve.router``): ``decision`` is route/hedge/retry/shed_tenant;
+    ``replica``/``tenant``/``op`` locate the request,
+    ``latency_ms``/``ewma_ms``/``median_ms``/``outstanding`` carry the
+    evidence the router acted on."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "fleet_route",
+            "run_id": run_id, "decision": str(decision), **fields}
+
+
+def replica_verdict_record(run_id: str, replica: int, verdict: str,
+                           **fields) -> dict:
+    """One replica-health classification change (``serve.router``, from
+    ``HostMonitor.verdicts()``): ``verdict`` is ok/slow/lost;
+    ``age_s``/``phase`` the staleness evidence, ``previous`` the
+    verdict it transitioned from."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "replica_verdict",
+            "run_id": run_id, "replica": int(replica),
+            "verdict": str(verdict), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -819,6 +881,23 @@ EXAMPLE_PROMOTION_RECORD = {
     "source": "pipeline.promote", "tool": "pipeline",
 }
 
+EXAMPLE_FLEET_ROUTE_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "fleet_route",
+    "run_id": "r18c2d3e4-1a2b-0", "decision": "hedge",
+    "replica": 2, "winner": 2, "op": "predict", "tenant": "acme",
+    "rows": 3, "attempt": 1, "latency_ms": 18.4, "ewma_ms": 3.1,
+    "median_ms": 2.9, "outstanding": 1, "verdict": "ok",
+    "generation": 5, "error": None, "source": "serve.router",
+    "tool": "serve.router",
+}
+
+EXAMPLE_REPLICA_VERDICT_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "replica_verdict",
+    "run_id": "r18c2d3e4-1a2b-0", "replica": 1, "verdict": "slow",
+    "age_s": 0.8, "phase": "slow", "previous": "ok", "generation": 5,
+    "source": "serve.router", "tool": "serve.router",
+}
+
 # the kind-keyed table selfcheck iterates — graftlint's schema-drift
 # rule cross-checks that EVERY registered kind appears here (and has a
 # Telemetry helper), so a new kind cannot land without selfcheck
@@ -845,6 +924,8 @@ EXAMPLES: Dict[str, dict] = {
     "rebalance": EXAMPLE_REBALANCE_RECORD,
     "canary": EXAMPLE_CANARY_RECORD,
     "promotion": EXAMPLE_PROMOTION_RECORD,
+    "fleet_route": EXAMPLE_FLEET_ROUTE_RECORD,
+    "replica_verdict": EXAMPLE_REPLICA_VERDICT_RECORD,
 }
 
 

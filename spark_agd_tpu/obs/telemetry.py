@@ -297,6 +297,7 @@ class Telemetry:
         (``chaos.<fault>``) so the campaign census rides the metrics
         snapshot."""
         self.registry.counter(f"chaos.{fault}").inc()
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
         rec = schema.chaos_record(self.run_id, fault, **fields)
         self.bus.emit(rec)
         return rec
@@ -328,6 +329,7 @@ class Telemetry:
         (``resilience.<action>``), so the run summary's metrics
         snapshot carries the recovery census."""
         self.registry.counter(f"resilience.{action}").inc()
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
         rec = schema.recovery_record(self.run_id, action, **fields)
         self.bus.emit(rec)
         return rec
@@ -457,6 +459,32 @@ class Telemetry:
         (``pipeline.<decision>``)."""
         self.registry.counter(f"pipeline.{decision}").inc()
         rec = schema.promotion_record(self.run_id, decision, **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def fleet_route(self, *, decision: str, **fields) -> dict:
+        """Emit (and return) a ``fleet_route`` record — one routing
+        decision of the serve fleet router (``serve.router``: route /
+        hedge / retry / shed_tenant) — counted overall
+        (``fleet.routes``) and per decision
+        (``fleet.route.<decision>``)."""
+        self.registry.counter("fleet.routes").inc()
+        self.registry.counter(f"fleet.route.{decision}").inc()
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
+        rec = schema.fleet_route_record(self.run_id, decision, **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def replica_verdict(self, *, replica: int, verdict: str,
+                        **fields) -> dict:
+        """Emit (and return) a ``replica_verdict`` record — one
+        replica-health classification change (``serve.router``, from
+        ``HostMonitor.verdicts()``: ok / slow / lost) — counted per
+        verdict (``fleet.verdict.<verdict>``)."""
+        self.registry.counter(f"fleet.verdict.{verdict}").inc()
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
+        rec = schema.replica_verdict_record(self.run_id, replica,
+                                            verdict, **fields)
         self.bus.emit(rec)
         return rec
 

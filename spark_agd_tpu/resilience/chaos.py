@@ -44,6 +44,16 @@ Fault kinds (:data:`FAULT_KINDS`):
 ``truncate_ckpt``  byte-truncate the newest checkpoint (driver-applied
                    at the next relaunch; ``.bak``/generation fallback)
 ``scramble_ckpt``  overwrite checkpoint bytes in place (same seat)
+``slow_replica``   sleep ``payload`` seconds before serving a request
+                   (a degraded serving replica; fired by
+                   ``before_request``, one-shot or persistent)
+``kill_replica``   self-deliver SIGKILL at a request boundary (a dead
+                   serving replica; the fleet drill's eviction leg)
+
+The replica kinds drive the SERVE fleet (``serve.fleet`` replicas call
+``before_request(request_index)`` per admitted request) with the same
+deterministic seeded interface the training drills use; ``at_iter``
+for them means the request index, not the optimizer iteration.
 
 Everything is deterministic: iterations, targets, payloads, and the
 corruption bytes all derive from the campaign seed.
@@ -66,7 +76,16 @@ from .errors import Preempted, SimulatedDeviceLoss, SupervisorGivingUp
 IN_RUN_KINDS = ("nan", "device_loss", "slow_host", "sigterm", "sigkill",
                 "fatal")
 FILE_KINDS = ("truncate_ckpt", "scramble_ckpt")
-FAULT_KINDS = IN_RUN_KINDS + FILE_KINDS
+# replica-scoped serve-fleet faults, fired per admitted request via
+# ChaosSchedule.before_request (``at_iter`` = request index); appended
+# AFTER the existing kinds so FAULT_KINDS.index-based sort keys (and
+# every seeded campaign that derives from them) are unchanged
+REPLICA_KINDS = ("slow_replica", "kill_replica")
+FAULT_KINDS = IN_RUN_KINDS + FILE_KINDS + REPLICA_KINDS
+
+# the kinds persist=True is meaningful for: a degraded host/replica
+# that stays degraded (kills and poisons are one-shot by nature)
+_PERSISTABLE_KINDS = ("slow_host", "slow_replica")
 
 
 class InjectedFatalError(ValueError):
@@ -102,11 +121,11 @@ class ScheduledFault:
                              f"one of {FAULT_KINDS}")
         if self.at_iter < 0:
             raise ValueError("at_iter must be >= 0")
-        if self.persist and self.kind != "slow_host":
+        if self.persist and self.kind not in _PERSISTABLE_KINDS:
             raise ValueError(
-                f"persist=True is a slow_host modifier; a persistent "
-                f"{self.kind!r} has no meaning (kills and poisons are "
-                "one-shot by nature)")
+                f"persist=True is a {'/'.join(_PERSISTABLE_KINDS)} "
+                f"modifier; a persistent {self.kind!r} has no meaning "
+                "(kills and poisons are one-shot by nature)")
         if not 0.0 < self.decay <= 1.0:
             raise ValueError("decay must be in (0, 1]")
 
@@ -150,8 +169,19 @@ class ChaosSchedule:
         self._persistent = [f for f in ordered
                             if f.kind == "slow_host" and f.persist]
         self._persist_fired = [0] * len(self._persistent)
+        # replica-scoped faults fire at REQUEST boundaries
+        # (before_request), never at segment boundaries — keeping them
+        # out of _pending keeps before_segment's interrupt loop exact
+        self._replica_persistent = [f for f in ordered
+                                    if f.kind == "slow_replica"
+                                    and f.persist]
+        self._replica_fired = [0] * len(self._replica_persistent)
+        self._replica_pending = [f for f in ordered
+                                 if f.kind in REPLICA_KINDS
+                                 and not f.persist]
         self._pending = [f for f in ordered
-                         if f.kind != "nan" and not f.persist]
+                         if f.kind != "nan" and not f.persist
+                         and f.kind not in REPLICA_KINDS]
         self._telemetry = telemetry
         self._seed = seed
         self._sleep = sleep
@@ -239,6 +269,39 @@ class ChaosSchedule:
                     f"injected fatal config error at iteration "
                     f"{global_iter}")
 
+    def before_request(self, request_index: int) -> None:
+        """The serve-fleet mirror of :meth:`before_segment`: a replica
+        calls this once per admitted request (``at_iter`` for replica
+        kinds = request index).  Persistent ``slow_replica`` faults
+        sleep at every request at or past their index (with the same
+        ``phase="slow"`` heartbeat sub-beats, so a slowed replica reads
+        SLOW and never LOST); one-shot ``slow_replica`` sleeps once;
+        ``kill_replica`` flushes telemetry and self-delivers SIGKILL —
+        a dead replica, mid-soak, with the kill on record."""
+        for i, f in enumerate(self._replica_persistent):
+            if f.at_iter > request_index:
+                continue
+            eff = float(f.payload) * (float(f.decay)
+                                      ** self._replica_fired[i])
+            if self._slow_scale is not None:
+                eff *= max(0.0, float(self._slow_scale()))
+            self._replica_fired[i] += 1
+            if eff > 1e-9:
+                self._emit(f, request_index, payload=eff)
+                self._slow_sleep(eff, request_index)
+        while self._replica_pending \
+                and self._replica_pending[0].at_iter <= request_index:
+            f = self._replica_pending.pop(0)
+            self._emit(f, request_index)
+            if f.kind == "slow_replica":
+                self._slow_sleep(float(f.payload) or 0.25,
+                                 request_index)
+                continue
+            if f.kind == "kill_replica":
+                if self._telemetry is not None:
+                    self._telemetry.flush()  # the kill must be on record
+                os.kill(os.getpid(), signal_lib.SIGKILL)
+
     def take_poison(self, global_iter: int) -> bool:
         if self._poison and self._poison[0].at_iter <= global_iter:
             f = self._poison.pop(0)
@@ -249,10 +312,11 @@ class ChaosSchedule:
     @property
     def exhausted(self) -> bool:
         """True once every ONE-SHOT fault has fired.  Persistent
-        slow-host faults are deliberately excluded: they re-fire at
-        every boundary by design, so counting them would make a
-        degraded-host campaign read as eternally unfinished."""
-        return not self._pending and not self._poison
+        slow-host/slow-replica faults are deliberately excluded: they
+        re-fire at every boundary by design, so counting them would
+        make a degraded-host campaign read as eternally unfinished."""
+        return (not self._pending and not self._poison
+                and not self._replica_pending)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -341,6 +405,48 @@ class ChaosCampaign:
         return cls(seed=int(seed), faults=tuple(out), iters=int(iters),
                    process_count=int(process_count))
 
+    @classmethod
+    def generate_fleet(cls, seed: int, *, requests: int = 64,
+                       replica_count: int = 3, max_faults: int = 2,
+                       p_kill: float = 0.5) -> "ChaosCampaign":
+        """Draw one normalized replica-scoped fleet campaign,
+        deterministic in ``seed`` — the serve-fleet twin of
+        :meth:`generate` (a SEPARATE draw path, so the training
+        campaign pool and its seeded histories stay byte-identical).
+        Normalization: faults arm in the first ~70% of the request
+        budget; every fault targets ONE replica (``process`` = replica
+        index) and no replica is hit twice — at least one replica
+        always stays healthy so the router has a survivor to route to;
+        with probability ``p_kill`` a fault is ``kill_replica``,
+        otherwise a persistent ``slow_replica`` with a sub-1 decay."""
+        if replica_count < 2:
+            raise ValueError("a fleet campaign needs >= 2 replicas "
+                             "(one fault victim plus one survivor)")
+        rng = np.random.default_rng(int(seed))
+        n = int(rng.integers(1, max(2, max_faults + 1)))
+        n = min(n, replica_count - 1)  # one survivor, always
+        hi = max(3, int(requests * 0.7))
+        req_at = sorted(rng.choice(
+            np.arange(1, hi), size=min(n, hi - 1), replace=False))
+        victims = rng.choice(np.arange(replica_count),
+                             size=len(req_at), replace=False)
+        out = []
+        for at, victim in zip(req_at, victims):
+            if float(rng.random()) < p_kill:
+                out.append(ScheduledFault(
+                    kind="kill_replica", at_iter=int(at),
+                    process=int(victim)))
+            else:
+                out.append(ScheduledFault(
+                    kind="slow_replica", at_iter=int(at),
+                    process=int(victim),
+                    payload=float(rng.uniform(0.05, 0.2)),
+                    persist=True,
+                    decay=float(rng.uniform(0.85, 1.0))))
+        return cls(seed=int(seed), faults=tuple(out),
+                   iters=int(requests),
+                   process_count=int(replica_count))
+
     @property
     def expects_giveup(self) -> bool:
         return any(f.kind == "fatal" for f in self.faults)
@@ -350,6 +456,18 @@ class ChaosCampaign:
                      ) -> ChaosSchedule:
         mine = [f for f in self.faults if f.kind in IN_RUN_KINDS
                 and (f.process is None or f.process == int(process))]
+        return ChaosSchedule(mine, telemetry=telemetry, seed=self.seed,
+                             sleep=sleep)
+
+    def schedule_for_replica(self, replica: int, *, telemetry=None,
+                             sleep: Callable[[float], None] = time.sleep,
+                             ) -> ChaosSchedule:
+        """The per-replica in-run schedule of a fleet campaign: the
+        REPLICA_KINDS faults targeting ``replica`` (a ``process`` of
+        None means every replica), behind the same ChaosSchedule
+        interface — the replica drives it via ``before_request``."""
+        mine = [f for f in self.faults if f.kind in REPLICA_KINDS
+                and (f.process is None or f.process == int(replica))]
         return ChaosSchedule(mine, telemetry=telemetry, seed=self.seed,
                              sleep=sleep)
 

@@ -99,6 +99,11 @@ class ServeOverloaded(RuntimeError):
             f"serving queue overloaded: {queued_rows} rows queued "
             f"against a limit of {limit_rows}{extra}; back off and "
             "retry")
+        # kept as attributes so the fleet transport can re-raise the
+        # rejection typed on the client side with the numbers intact
+        self.queued_rows = int(queued_rows)
+        self.limit_rows = int(limit_rows)
+        self.detail = detail
         self.queued_rows = int(queued_rows)
         self.limit_rows = int(limit_rows)
 

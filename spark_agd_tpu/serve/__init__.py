@@ -31,11 +31,17 @@ load-generator gate.  See ``docs/SERVING.md``.
 from ..resilience.errors import ServeOverloaded  # noqa: F401
 from .engine import (BucketLadder, ModelSpec, ServeEngine,  # noqa: F401
                      params_of, spec_of)
+from .fleet import (ReplicaHandle, ReplicaServer,  # noqa: F401
+                    discover_replicas)
 from .queue import MicroBatchQueue, ServeResult  # noqa: F401
 from .registry import LoadedModel, ModelRegistry  # noqa: F401
+from .router import (FleetRouter, NoReplicasLeft,  # noqa: F401
+                     ReplicaLatencyTracker, RouteResult)
 
 __all__ = [
-    "BucketLadder", "LoadedModel", "MicroBatchQueue", "ModelRegistry",
-    "ModelSpec", "ServeEngine", "ServeOverloaded", "ServeResult",
-    "params_of", "spec_of",
+    "BucketLadder", "FleetRouter", "LoadedModel", "MicroBatchQueue",
+    "ModelRegistry", "ModelSpec", "NoReplicasLeft", "ReplicaHandle",
+    "ReplicaLatencyTracker", "ReplicaServer", "RouteResult",
+    "ServeEngine", "ServeOverloaded", "ServeResult",
+    "discover_replicas", "params_of", "spec_of",
 ]

@@ -23,7 +23,13 @@ already-fetched batch output.
 Telemetry: one ``serve_request`` record per request (ok / rejected /
 error), and ``serve_latency`` rollups (QPS, p50/p99, queue depth) on
 demand and at shutdown — the record kinds ``tools/agd_report.py``'s
-serving section and the drill's perf gate consume.
+serving section and the drill's perf gate consume.  Per-op queue depth
+rides the ``serve.queue_depth.<op>`` gauges; tenant-attributed rejects
+count under ``serve.tenant_rejected`` (and per tenant) — the fleet
+router's admission-control evidence.  A queue constructed with
+``replica=`` stamps that replica index onto every request/latency
+record, so the router's per-replica EWMA and ``latency_summary()``
+attribute the same numbers to the same replica.
 """
 
 from __future__ import annotations
@@ -34,7 +40,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -73,6 +79,7 @@ class _Request:
     # rule of docs/OBSERVABILITY.md §distributed-tracing
     ctx: Optional[trace_lib.SpanContext] = None
     t_submit_unix: float = 0.0
+    tenant: Optional[str] = None
 
 
 class MicroBatchQueue:
@@ -85,7 +92,7 @@ class MicroBatchQueue:
     def __init__(self, engine: ServeEngine, *,
                  max_wait_us: int = DEFAULT_MAX_WAIT_US,
                  max_queue_rows: Optional[int] = None,
-                 telemetry=None):
+                 telemetry=None, replica: Optional[int] = None):
         self.engine = engine
         self.max_batch = engine.max_batch
         self.max_wait_s = max(0, int(max_wait_us)) / 1e6
@@ -93,8 +100,10 @@ class MicroBatchQueue:
                                if max_queue_rows is None
                                else int(max_queue_rows))
         self.telemetry = telemetry
+        self.replica = None if replica is None else int(replica)
         self._pending: Deque[_Request] = deque()
         self._pending_rows = 0
+        self._pending_rows_by_op: Dict[str, int] = {}
         self._cond = threading.Condition()
         self._stopping = False
         self._started = False
@@ -146,7 +155,25 @@ class MicroBatchQueue:
         return False
 
     # -- admission ---------------------------------------------------------
-    def submit(self, x, op: str = "predict") -> Future:
+    def _attrib(self, tenant: Optional[str] = None) -> dict:
+        """Optional record fields shared by every emit path: the
+        replica this queue serves for, and the submitting tenant."""
+        extra: dict = {}
+        if self.replica is not None:
+            extra["replica"] = self.replica
+        if tenant is not None:
+            extra["tenant"] = tenant
+        return extra
+
+    def _note_depth(self, op: str) -> None:
+        """Refresh the per-op queue-depth gauge (caller holds _cond)."""
+        if self.telemetry is not None:
+            self.telemetry.registry.gauge(
+                f"serve.queue_depth.{op}").set(
+                    self._pending_rows_by_op.get(op, 0))
+
+    def submit(self, x, op: str = "predict",
+               tenant: Optional[str] = None) -> Future:
         """Admit one request (a feature row or a row batch); returns a
         future resolving to a :class:`ServeResult`.  Raises
         ``ServeOverloaded`` (TRANSIENT) at capacity, ``ValueError``
@@ -172,7 +199,8 @@ class MicroBatchQueue:
                              f"{self.engine.ops})")
         req = _Request(rows, op, Future(), time.monotonic(), squeeze,
                        ctx=trace_lib.current_context(),
-                       t_submit_unix=time.time())
+                       t_submit_unix=time.time(),
+                       tenant=None if tenant is None else str(tenant))
         with self._cond:
             if self._stopping or not self._started:
                 raise RuntimeError(
@@ -184,7 +212,12 @@ class MicroBatchQueue:
                 if self.telemetry is not None:
                     self.telemetry.serve_request(
                         rows=n, op=op, status="rejected",
-                        tool="serve.queue")
+                        tool="serve.queue", **self._attrib(req.tenant))
+                    if req.tenant is not None:
+                        self.telemetry.registry.counter(
+                            "serve.tenant_rejected").inc()
+                        self.telemetry.registry.counter(
+                            f"serve.tenant_rejected.{req.tenant}").inc()
                 # the overload ships with its last-seconds timeline;
                 # rate-limited inside the recorder (one dump per
                 # reason per window, not one per rejected request)
@@ -193,6 +226,9 @@ class MicroBatchQueue:
                 raise ServeOverloaded(queued + n, self.max_queue_rows)
             self._pending.append(req)
             self._pending_rows += n
+            self._pending_rows_by_op[op] = (
+                self._pending_rows_by_op.get(op, 0) + n)
+            self._note_depth(op)
             self._cond.notify_all()
         return req.future
 
@@ -245,8 +281,11 @@ class MicroBatchQueue:
                     break
                 req = self._pending.popleft()
                 self._pending_rows -= n
+                self._pending_rows_by_op[op] = (
+                    self._pending_rows_by_op.get(op, 0) - n)
                 rows += n
                 group.append(req)
+            self._note_depth(op)
             return group
 
     def _none_or_retry(self) -> Optional[List[_Request]]:
@@ -272,12 +311,15 @@ class MicroBatchQueue:
         batch_rows = X.shape[0]
         req_ctxs = self._request_contexts(group)
         # causal chain: request spans hang off their submitters; the
-        # coalesced batch span is a child of the FIRST request (a batch
-        # has one parent; the siblings link back via batch_span_id);
-        # the engine call inside inherits the batch context through
-        # the context variable (same worker thread)
+        # coalesced batch span is a SIBLING of the first request's
+        # span, parented on its submitter (whose open record is
+        # already durable in the caller's stream — a worker killed
+        # mid-batch must truncate the tree, never orphan it); the
+        # siblings link back via batch_span_id, and the engine call
+        # inside inherits the batch context through the context
+        # variable (same worker thread)
         batch_span = (self.telemetry.trace_span(
-            "serve_batch", parent=req_ctxs[0], op=op,
+            "serve_batch", parent=group[0].ctx, op=op,
             batch_rows=batch_rows, requests=len(group),
             tool="serve.queue")
             if req_ctxs is not None else None)
@@ -324,7 +366,7 @@ class MicroBatchQueue:
                     generation=res.generation,
                     queue_ms=round(res.queue_ms, 3),
                     latency_ms=round(res.latency_ms, 3),
-                    tool="serve.queue")
+                    tool="serve.queue", **self._attrib(req.tenant))
                 self.telemetry.trace_point(
                     "serve_request", seconds=res.latency_ms / 1e3,
                     ctx=req_ctxs[i], t_start_unix=req.t_submit_unix,
@@ -343,7 +385,7 @@ class MicroBatchQueue:
                 self.telemetry.serve_request(
                     rows=req.rows.shape[0], op=op, status="error",
                     error=f"{type(exc).__name__}: {exc}",
-                    tool="serve.queue")
+                    tool="serve.queue", **self._attrib(req.tenant))
                 if req_ctxs is not None:
                     self.telemetry.trace_point(
                         "serve_request",
@@ -376,6 +418,8 @@ class MicroBatchQueue:
             "hot_swaps": self.engine.hot_swaps,
             "generation": self.engine.generation,
         }
+        if self.replica is not None:
+            summary["replica"] = self.replica
         if lat:
             summary.update(
                 p50_ms=round(_percentile(lat, 0.50), 3),
@@ -383,6 +427,14 @@ class MicroBatchQueue:
                 mean_ms=round(sum(lat) / len(lat), 3),
                 max_ms=round(lat[-1], 3))
         return summary
+
+    def recent_latencies(self) -> List[float]:
+        """The most recent per-request latencies (ms), oldest first —
+        the SAME bounded ring ``latency_summary()`` takes percentiles
+        over, exposed so the fleet router's per-replica EWMA and the
+        rollup agree on the same numbers."""
+        with self._cond:
+            return list(self._latencies_ms)
 
     def emit_latency(self) -> Optional[dict]:
         """Emit (and return) one ``serve_latency`` record with the

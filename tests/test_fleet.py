@@ -1,0 +1,855 @@
+"""Serve-fleet tests: the latency-EWMA router, the replica wire
+protocol, fleet chaos faults, and the traffic-shift gate (``serve/
+fleet.py`` + ``serve/router.py``).
+
+The contracts pinned here are the drill's story told at unit scale:
+statistically-equal replicas share traffic (the spread band), a stuck
+request hedges and the first answer wins, a dead replica is evicted
+once and its in-flight requests retry transparently on a survivor
+(predict is pure), a flooding tenant sheds TYPED while other tenants
+keep flowing, verdict changes emit exactly once, an evicted index is
+sticky until a fresh "ok" heartbeat proves life, and a torn published
+generation never splits the fleet — every replica process falls back
+to the same verifiable generation.  The drill tool gate
+(``tools/fleet_drill.py``) rides at the bottom, chaos-drill style: the
+reduced smoke in tier-1, the full soak behind ``-m 'fleet and slow'``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_agd_tpu.models.glm import LogisticRegressionModel
+from spark_agd_tpu.obs import InMemorySink, Telemetry, schema
+from spark_agd_tpu.obs.perfgate import (FleetGateResult,
+                                        format_fleet_report, gate_fleet)
+from spark_agd_tpu.obs.sinks import JSONLSink
+from spark_agd_tpu.resilience import chaos as chaos_mod
+from spark_agd_tpu.resilience import manifest as mf
+from spark_agd_tpu.resilience.chaos import (ChaosCampaign, ChaosSchedule,
+                                            ScheduledFault)
+from spark_agd_tpu.resilience.errors import ServeOverloaded
+from spark_agd_tpu.serve import (FleetRouter, MicroBatchQueue,
+                                 ModelRegistry, NoReplicasLeft,
+                                 ReplicaHandle, ReplicaLatencyTracker,
+                                 ReplicaServer, ServeEngine,
+                                 discover_replicas)
+from spark_agd_tpu.serve.fleet import replica_file_name
+
+pytestmark = pytest.mark.fleet
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRILL = os.path.join(REPO_ROOT, "tools", "fleet_drill.py")
+
+D = 8  # feature count every fleet fixture model shares
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _logistic(seed=3):
+    r = _rng(seed)
+    return LogisticRegressionModel(
+        (r.normal(size=D) * 0.8).astype(np.float32), 0.25)
+
+
+def _proba_ref(X, model):
+    """f64 reference for op="predict_proba" through the f32 wire."""
+    Xd = np.asarray(X, dtype=np.float32).astype(np.float64)
+    w = np.asarray(model.weights, dtype=np.float64)
+    z = Xd @ w + float(model.intercept)
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+def _tel():
+    return Telemetry([InMemorySink()])
+
+
+def _records(tel):
+    return tel.bus.sinks[0].records
+
+
+def _by_kind(tel, kind, **match):
+    return [r for r in _records(tel) if r.get("kind") == kind
+            and all(r.get(k) == v for k, v in match.items())]
+
+
+class FakeBackend:
+    """In-process router backend: the ``predict`` contract of
+    ``ReplicaHandle`` without a socket.  ``latency_s`` sleeps,
+    ``gate`` blocks until set (deterministic concurrency tests),
+    ``fail`` raises ConnectionError — a dead replica."""
+
+    def __init__(self, replica, *, latency_s=0.0, generation=1,
+                 fail=False, gate=None):
+        self.replica = int(replica)
+        self.latency_s = float(latency_s)
+        self.generation = int(generation)
+        self.fail = fail
+        self.gate = gate
+        self.calls = 0
+        self.in_flight = 0
+        self._lock = threading.Lock()
+
+    def predict(self, rows, op="predict", tenant=None, timeout=30.0):
+        with self._lock:
+            self.calls += 1
+            self.in_flight += 1
+        try:
+            if self.fail:
+                raise ConnectionError(
+                    f"fake replica {self.replica} is dead")
+            if self.gate is not None:
+                self.gate.wait(timeout)
+            if self.latency_s:
+                time.sleep(self.latency_s)
+            n = int(getattr(rows, "shape", [len(rows)])[0])
+            return {"values": [0.5] * n,
+                    "generation": self.generation,
+                    "replica": self.replica,
+                    "latency_ms": self.latency_s * 1e3}
+        finally:
+            with self._lock:
+                self.in_flight -= 1
+
+
+class FakeMonitor:
+    """A ``HostMonitor.verdicts()`` stand-in the tests script."""
+
+    def __init__(self, verdicts=None):
+        self._verdicts = dict(verdicts or {})
+
+    def set(self, replica, verdict):
+        self._verdicts[int(replica)] = verdict
+
+    def verdicts(self):
+        return dict(self._verdicts)
+
+
+# ---------------------------------------------------------------------------
+class TestReplicaLatencyTracker:
+    def test_ewma_math(self):
+        t = ReplicaLatencyTracker(alpha=0.5, floor_ms=0.01)
+        t.observe(0, 10.0)
+        assert t.cost(0) == pytest.approx(10.0)
+        t.observe(0, 20.0)
+        assert t.cost(0) == pytest.approx(15.0)
+        assert t.samples(0) == 2
+
+    def test_unobserved_replica_costs_the_floor(self):
+        t = ReplicaLatencyTracker(floor_ms=0.5)
+        assert t.cost(7) == 0.5
+        assert t.samples(7) == 0
+
+    def test_forget_resets_to_optimistic_floor(self):
+        t = ReplicaLatencyTracker(floor_ms=0.1)
+        t.observe(2, 50.0)
+        t.forget(2)
+        assert t.cost(2) == 0.1
+        assert t.samples(2) == 0
+
+    def test_median_interpolates_and_starts_none(self):
+        t = ReplicaLatencyTracker()
+        assert t.median_ms() is None
+        t.observe(0, 2.0)
+        t.observe(1, 4.0)
+        assert t.median_ms() == pytest.approx(3.0)
+        t.observe(2, 10.0)
+        assert t.median_ms() == pytest.approx(4.0)
+
+    def test_floor_clamps_costs(self):
+        t = ReplicaLatencyTracker(floor_ms=1.0)
+        t.observe(0, 0.001)
+        assert t.cost(0) == 1.0
+        assert t.costs() == {0: 1.0}
+
+    def test_alpha_validation(self):
+        with pytest.raises(ValueError):
+            ReplicaLatencyTracker(alpha=0.0)
+        with pytest.raises(ValueError):
+            ReplicaLatencyTracker(alpha=1.5)
+
+
+# ---------------------------------------------------------------------------
+class TestRouterRouting:
+    def test_constructor_validation(self):
+        b = {0: FakeBackend(0)}
+        with pytest.raises(ValueError):
+            FleetRouter(b, hedge_multiple=1.0)
+        with pytest.raises(ValueError):
+            FleetRouter(b, warm_every=1)
+        with pytest.raises(ValueError):
+            FleetRouter(b, spread_tolerance=0.5)
+        with pytest.raises(ValueError):
+            FleetRouter(b, tenant_max_outstanding=0)
+
+    def test_spread_band_shares_traffic_across_equals(self):
+        backends = {r: FakeBackend(r) for r in range(3)}
+        with FleetRouter(backends) as router:
+            for _ in range(30):
+                res = router.request(np.ones((2, D)))
+                assert res.values == [0.5, 0.5]
+            served = router.stats.per_replica
+        assert sorted(served) == [0, 1, 2]
+        assert all(served[r] >= 3 for r in range(3)), served
+        assert router.stats.requests == 30
+
+    def test_warm_turn_probes_the_most_expensive_member(self):
+        router = FleetRouter({r: FakeBackend(r) for r in range(3)},
+                             warm_every=2)
+        router.tracker.observe(0, 50.0)
+        first = router._candidates(set())   # tick 1: normal ranking
+        second = router._candidates(set())  # tick 2: warm probe
+        assert first[0] != 0
+        assert second[0] == 0
+        assert sorted(second) == [0, 1, 2]
+        router.close()
+
+    def test_route_records_are_schema_valid(self):
+        tel = _tel()
+        with FleetRouter({0: FakeBackend(0)}, telemetry=tel) as router:
+            router.request(np.ones((3, D)), tenant="acme")
+        routes = _by_kind(tel, "fleet_route", decision="route")
+        assert len(routes) == 1
+        rec = routes[0]
+        assert rec["winner"] == 0 and rec["rows"] == 3
+        assert rec["tenant"] == "acme"
+        for r in _records(tel):
+            assert schema.validate_record(r) == [], r
+
+
+class TestRouterHedge:
+    def test_stuck_primary_hedges_and_first_answer_wins(self):
+        tel = _tel()
+        backends = {0: FakeBackend(0, latency_s=0.25),
+                    1: FakeBackend(1)}
+        with FleetRouter(backends, telemetry=tel,
+                         hedge_multiple=2.0, hedge_floor_ms=1.0,
+                         min_hedge_samples=2,
+                         spread_tolerance=1.5) as router:
+            # seed the tracker so 0 is the cheap primary and the
+            # fleet median is trusted (2 samples >= min_hedge_samples)
+            router.tracker.observe(0, 1.0)
+            router.tracker.observe(1, 5.0)
+            res = router.request(np.ones((2, D)))
+        assert res.hedged is True
+        assert res.replica == 1          # the hedge answered first
+        assert res.values == [0.5, 0.5]  # nothing dropped on the way
+        assert router.stats.hedges == 1
+        assert router.stats.hedges_won == 1
+        hedges = _by_kind(tel, "recovery", action="request_hedge")
+        assert len(hedges) == 1 and hedges[0]["process"] == 1
+        routed = _by_kind(tel, "fleet_route", decision="hedge")
+        assert routed and routed[0]["winner"] == 1
+        assert routed[0]["replica"] == 0
+
+    def test_no_hedge_below_the_sample_floor(self):
+        router = FleetRouter({0: FakeBackend(0), 1: FakeBackend(1)},
+                             min_hedge_samples=8)
+        router.tracker.observe(0, 1.0)
+        assert router._hedge_wait_s() is None
+        router.tracker.observe(1, 1.0)
+        assert router._hedge_wait_s() is None  # 2 samples < 8
+        router.close()
+
+
+class TestRouterRetryEvict:
+    def test_dead_primary_evicts_once_and_retries_transparently(self):
+        tel = _tel()
+        backends = {0: FakeBackend(0, fail=True), 1: FakeBackend(1)}
+        with FleetRouter(backends, telemetry=tel) as router:
+            router.tracker.observe(0, 1.0)   # 0 looks cheapest
+            router.tracker.observe(1, 5.0)
+            res = router.request(np.ones((1, D)))
+        assert res.replica == 1 and res.retried and res.attempt == 2
+        assert router.stats.retries == 1
+        assert router.stats.evictions == 1
+        assert router.members == [1]
+        evicts = _by_kind(tel, "recovery", action="replica_evict")
+        assert len(evicts) == 1 and evicts[0]["process"] == 0
+        retries = _by_kind(tel, "recovery", action="request_retry")
+        assert len(retries) == 1
+        assert _by_kind(tel, "fleet_route", decision="retry")
+
+    def test_everything_dead_raises_typed_transient(self):
+        backends = {r: FakeBackend(r, fail=True) for r in range(2)}
+        with FleetRouter(backends) as router:
+            with pytest.raises(NoReplicasLeft) as ei:
+                router.request(np.ones((1, D)))
+        assert isinstance(ei.value, ConnectionError)  # TRANSIENT taxon
+        assert router.stats.evictions == 2
+
+
+class TestRouterTenantAdmission:
+    def test_flooding_tenant_sheds_typed_while_others_flow(self):
+        tel = _tel()
+        gate = threading.Event()
+        slow = FakeBackend(0, gate=gate)
+        with FleetRouter({0: slow}, telemetry=tel,
+                         tenant_max_outstanding=1) as router:
+            results = {}
+
+            def hold():
+                results["alice"] = router.request(
+                    np.ones((1, D)), tenant="alice")
+
+            t = threading.Thread(target=hold)
+            t.start()
+            for _ in range(200):   # wait until alice is in flight
+                if slow.in_flight >= 1:
+                    break
+                time.sleep(0.005)
+            assert slow.in_flight >= 1
+            with pytest.raises(ServeOverloaded) as ei:
+                router.request(np.ones((1, D)), tenant="alice")
+            gate.set()
+            t.join(timeout=5)
+            # the well-behaved tenant was never capped
+            bob = router.request(np.ones((1, D)), tenant="bob")
+        assert "admission cap" in str(ei.value)
+        assert ei.value.limit_rows == 1
+        assert results["alice"].values == [0.5]
+        assert bob.values == [0.5]
+        assert router.stats.shed == {"alice": 1}
+        sheds = _by_kind(tel, "fleet_route", decision="shed_tenant")
+        assert len(sheds) == 1 and sheds[0]["tenant"] == "alice"
+        reg = tel.registry
+        assert reg.counter("serve.tenant_rejected").value == 1
+        assert reg.counter("serve.tenant_rejected.alice").value == 1
+
+
+class TestRouterVerdicts:
+    def test_verdict_sync_emits_changes_only_and_evicts_lost(self):
+        tel = _tel()
+        monitor = FakeMonitor({0: "ok", 1: "slow"})
+        backends = {0: FakeBackend(0), 1: FakeBackend(1)}
+        router = FleetRouter(backends, monitor=monitor, telemetry=tel)
+        assert router.verdict_sync() == {0: "ok", 1: "slow"}
+        assert len(_by_kind(tel, "replica_verdict")) == 2
+        router.verdict_sync()   # no change -> no new records
+        assert len(_by_kind(tel, "replica_verdict")) == 2
+        monitor.set(1, "lost")
+        router.verdict_sync()
+        verdicts = _by_kind(tel, "replica_verdict", replica=1)
+        assert [v["verdict"] for v in verdicts] == ["slow", "lost"]
+        assert verdicts[-1]["previous"] == "slow"
+        assert router.members == [0]
+        assert _by_kind(tel, "recovery", action="replica_evict")
+        for r in _records(tel):
+            assert schema.validate_record(r) == [], r
+        router.close()
+
+    def test_slow_is_deprioritized_but_kept_warm(self):
+        monitor = FakeMonitor({0: "slow", 1: "ok"})
+        router = FleetRouter({0: FakeBackend(0), 1: FakeBackend(1)},
+                             monitor=monitor)
+        router.verdict_sync()
+        ranked = router._candidates(set())
+        assert ranked == [1, 0]  # slow trails but is still a member
+        router.close()
+
+    def test_refresh_membership_join_and_leave(self):
+        b = {r: FakeBackend(r) for r in range(2)}
+        router = FleetRouter({0: b[0]})
+        delta = router.refresh_membership({0: b[0], 1: b[1]})
+        assert delta == {"joined": [1], "left": []}
+        assert router.members == [0, 1]
+        delta = router.refresh_membership({0: b[0]})
+        assert delta == {"joined": [], "left": [1]}
+        assert router.members == [0]
+        router.close()
+
+    def test_evicted_index_is_sticky_until_a_fresh_ok(self):
+        monitor = FakeMonitor({0: "ok", 1: "lost"})
+        b = {r: FakeBackend(r) for r in range(2)}
+        router = FleetRouter(dict(b), monitor=monitor)
+        router.verdict_sync()   # evicts 1
+        assert router.members == [0]
+        # the crashed replica's leftover files age through "slow" —
+        # a membership refresh must NOT resurrect it on that verdict
+        monitor.set(1, "slow")
+        delta = router.refresh_membership(dict(b))
+        assert delta["joined"] == [] and router.members == [0]
+        # a fresh heartbeat ("ok") is proof of life: now it rejoins
+        monitor.set(1, "ok")
+        delta = router.refresh_membership(dict(b))
+        assert delta["joined"] == [1] and router.members == [0, 1]
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fleet_engine():
+    return ServeEngine(_logistic(), generation=1, max_batch=8,
+                       min_bucket=4)
+
+
+class TestReplicaWireProtocol:
+    def test_roundtrip_values_match_the_engine(self, tmp_path,
+                                               fleet_engine):
+        fleet_dir = str(tmp_path / "fleet")
+        model = _logistic()
+        with ReplicaServer(fleet_dir, 0, fleet_engine) as server:
+            handles = discover_replicas(fleet_dir)
+            assert list(handles) == [0]
+            h = handles[0]
+            assert h.port == server.port
+            X = _rng(5).normal(size=(5, D)).astype(np.float32)
+            resp = h.predict(X, op="predict_proba")
+            assert resp["status"] == "ok"
+            assert resp["generation"] == 1 and resp["replica"] == 0
+            np.testing.assert_allclose(
+                np.asarray(resp["values"]), _proba_ref(X, model),
+                atol=1e-4)
+            assert server.requests_seen == 1
+
+    def test_trace_context_rides_the_wire(self, tmp_path):
+        server_tel = _tel()
+        engine = ServeEngine(_logistic(), generation=1, max_batch=8,
+                             min_bucket=4)
+        fleet_dir = str(tmp_path / "fleet")
+        client_tel = _tel()
+        with ReplicaServer(fleet_dir, 0, engine,
+                           telemetry=server_tel):
+            h = discover_replicas(fleet_dir)[0]
+            with client_tel.trace_span("client_request") as ctx:
+                h.predict(np.ones((2, D), dtype=np.float32))
+        # the replica's serve_request span joined the CLIENT's tree
+        spans = [r for r in _records(server_tel)
+                 if r.get("name") == "serve_request"]
+        assert spans
+        assert all(r.get("trace_id") == ctx.trace_id for r in spans)
+
+    def test_replica_side_shed_is_typed_across_the_wire(self, tmp_path,
+                                                        fleet_engine):
+        fleet_dir = str(tmp_path / "fleet")
+        with ReplicaServer(fleet_dir, 1, fleet_engine,
+                           max_queue_rows=2):
+            h = discover_replicas(fleet_dir)[1]
+            with pytest.raises(ServeOverloaded) as ei:
+                h.predict(np.ones((4, D), dtype=np.float32))
+        assert ei.value.queued_rows == 4
+        assert ei.value.limit_rows == 2
+        assert "replica 1 shed" in str(ei.value)
+
+    def test_bad_request_is_a_typed_error_reply(self, tmp_path,
+                                                fleet_engine):
+        fleet_dir = str(tmp_path / "fleet")
+        with ReplicaServer(fleet_dir, 0, fleet_engine):
+            h = discover_replicas(fleet_dir)[0]
+            with pytest.raises(RuntimeError, match="replica 0 error"):
+                h.predict(np.ones((2, D), dtype=np.float32),
+                          op="no_such_op")
+
+    def test_discovery_skips_torn_membership_files(self, tmp_path):
+        fleet_dir = tmp_path / "fleet"
+        fleet_dir.mkdir()
+        (fleet_dir / replica_file_name(7)).write_text("{torn mid-wri")
+        (fleet_dir / replica_file_name(3)).write_text(
+            json.dumps({"replica": 3, "port": 12345}))
+        (fleet_dir / "unrelated.txt").write_text("x")
+        handles = discover_replicas(str(fleet_dir))
+        assert list(handles) == [3]
+        assert isinstance(handles[3], ReplicaHandle)
+        assert handles[3].port == 12345
+
+    def test_clean_stop_is_a_leave_not_a_crash(self, tmp_path,
+                                               fleet_engine):
+        fleet_dir = str(tmp_path / "fleet")
+        server = ReplicaServer(fleet_dir, 2, fleet_engine).start()
+        membership = server.membership_path
+        beat_path = server.heartbeat.path
+        assert os.path.exists(membership) and os.path.exists(beat_path)
+        server.request_stop()   # the SIGTERM-handler half
+        server.stop()
+        # both announcements removed: discovery and the monitor agree
+        # this replica LEFT (a crash would leave them to go stale)
+        assert not os.path.exists(membership)
+        assert not os.path.exists(beat_path)
+        assert discover_replicas(fleet_dir) == {}
+
+
+# ---------------------------------------------------------------------------
+_RACE_WORKER = r"""
+import json, sys, time
+from spark_agd_tpu.serve.registry import ModelRegistry
+
+reg = ModelRegistry(sys.argv[1])
+print("READY", flush=True)
+seen = []
+for _ in range(int(sys.argv[2])):
+    loaded = reg.load_newest()
+    if loaded is not None:
+        seen.append(int(loaded.generation))
+    time.sleep(0.01)
+print(json.dumps(sorted(set(seen))))
+"""
+
+_REFRESH_WORKER = r"""
+import sys
+from spark_agd_tpu.serve.registry import ModelRegistry
+
+reg = ModelRegistry(sys.argv[1])
+reg.refresh(None)
+cur = reg.current
+print(-1 if cur is None else int(cur.generation))
+"""
+
+
+def _spawn_worker(script, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT)
+    return subprocess.Popen(
+        [sys.executable, "-c", script, *[str(a) for a in args]],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+class TestRegistryFleetRaces:
+    def test_concurrent_load_newest_never_sees_a_half_publish(
+            self, tmp_path):
+        reg_dir = str(tmp_path / "registry")
+        registry = ModelRegistry(reg_dir)
+        registry.publish(_logistic(1))
+        workers = [_spawn_worker(_RACE_WORKER, reg_dir, 80)
+                   for _ in range(3)]
+        try:
+            for p in workers:   # wait out the interpreter warmup
+                assert p.stdout.readline().strip() == "READY"
+            published = {1}
+            for seed in (2, 3, 4, 5):
+                published.add(registry.publish(_logistic(seed)))
+                time.sleep(0.15)
+            outs = [p.communicate(timeout=60) for p in workers]
+        finally:
+            for p in workers:
+                p.kill()
+        for p, (out, err) in zip(workers, outs):
+            assert p.returncode == 0, err
+            seen = set(json.loads(out.strip().splitlines()[-1]))
+            # every generation a replica loaded mid-publish is a REAL
+            # committed one — a torn half-publish is invisible
+            assert seen, "worker never loaded a generation"
+            assert seen <= published, (seen, published)
+
+    def test_torn_generation_never_splits_the_fleet(self, tmp_path):
+        reg_dir = str(tmp_path / "registry")
+        tel = _tel()
+        registry = ModelRegistry(reg_dir, telemetry=tel)
+        registry.publish(_logistic(1))
+        g2 = registry.publish(_logistic(2))
+        shard = os.path.join(reg_dir, mf.shard_name(g2, 0))
+        size = os.path.getsize(shard)
+        with open(shard, "r+b") as f:   # tear the newest shard
+            f.truncate(size // 2)
+
+        def fleet_view():
+            procs = [_spawn_worker(_REFRESH_WORKER, reg_dir)
+                     for _ in range(2)]
+            outs = [p.communicate(timeout=60) for p in procs]
+            assert all(p.returncode == 0 for p in procs), outs
+            return [int(out.strip().splitlines()[-1])
+                    for out, _ in outs]
+
+        # every replica process walks back to the SAME verifiable
+        # generation: degraded in lockstep, never split
+        assert fleet_view() == [1, 1]
+        loaded = registry.load_newest()
+        assert loaded is not None and loaded.generation == 1
+        fallbacks = _by_kind(tel, "recovery",
+                             action="checkpoint_fallback")
+        assert fallbacks and fallbacks[0]["generation"] == g2
+        # the next good publish re-converges the whole fleet forward
+        g3 = registry.publish(_logistic(3))
+        assert fleet_view() == [g3, g3]
+
+
+# ---------------------------------------------------------------------------
+class TestFleetChaos:
+    def test_generate_fleet_is_deterministic_and_normalized(self):
+        for seed in range(12):
+            a = ChaosCampaign.generate_fleet(seed, requests=64,
+                                             replica_count=3)
+            b = ChaosCampaign.generate_fleet(seed, requests=64,
+                                             replica_count=3)
+            assert a.faults == b.faults
+            victims = [f.process for f in a.faults]
+            assert len(set(victims)) == len(victims)   # no double-hit
+            assert 1 <= len(a.faults) <= 2             # >= 1 survivor
+            for f in a.faults:
+                assert f.kind in ("slow_replica", "kill_replica")
+                assert 1 <= f.at_iter <= int(64 * 0.7)
+                if f.kind == "slow_replica":
+                    assert f.persist and 0.85 <= f.decay <= 1.0
+                    assert 0.05 <= f.payload <= 0.2
+                else:
+                    assert not f.persist
+
+    def test_generate_fleet_needs_a_survivor(self):
+        with pytest.raises(ValueError):
+            ChaosCampaign.generate_fleet(0, replica_count=1)
+
+    def test_schedule_for_replica_filters_by_victim(self):
+        camp = ChaosCampaign(
+            seed=1, iters=10, process_count=3,
+            faults=(ScheduledFault("slow_replica", at_iter=2,
+                                   process=1, payload=0.01,
+                                   persist=True),
+                    ScheduledFault("kill_replica", at_iter=5,
+                                   process=0)))
+        sleeps = []
+        sched1 = camp.schedule_for_replica(1, sleep=sleeps.append)
+        for i in range(1, 7):
+            sched1.before_request(i)
+        assert [k for k, _ in sched1.fired] == ["slow_replica"] * 5
+        bystander = camp.schedule_for_replica(2)
+        assert bystander.exhausted
+        for i in range(1, 7):
+            bystander.before_request(i)
+        assert bystander.fired == []
+
+    def test_persistent_slow_replica_decays_per_firing(self):
+        sleeps = []
+        sched = ChaosSchedule(
+            [ScheduledFault("slow_replica", at_iter=3, payload=0.1,
+                            persist=True, decay=0.5)],
+            sleep=sleeps.append)
+        sched.before_request(1)
+        assert sleeps == []
+        sched.before_request(3)
+        sched.before_request(4)
+        assert sleeps == pytest.approx([0.1, 0.05])
+        # persistent faults never pend: with no one-shots the schedule
+        # reads exhausted yet keeps firing at every later request
+        assert sched.exhausted
+        sched.before_request(5)
+        assert sleeps == pytest.approx([0.1, 0.05, 0.025])
+
+    def test_one_shot_slow_replica_fires_once(self):
+        sleeps = []
+        sched = ChaosSchedule(
+            [ScheduledFault("slow_replica", at_iter=2, payload=0.05)],
+            sleep=sleeps.append)
+        sched.before_request(2)
+        sched.before_request(3)
+        assert sleeps == [0.05]
+        assert sched.exhausted
+
+    def test_kill_replica_flushes_the_record_before_the_kill(
+            self, monkeypatch):
+        kills = []
+        monkeypatch.setattr(chaos_mod.os, "kill",
+                            lambda pid, sig: kills.append((pid, sig)))
+        tel = _tel()
+        sched = ChaosSchedule(
+            [ScheduledFault("kill_replica", at_iter=2, process=1)],
+            telemetry=tel)
+        sched.before_request(1)
+        assert kills == []
+        sched.before_request(2)
+        assert kills == [(os.getpid(), chaos_mod.signal_lib.SIGKILL)]
+        recs = _by_kind(tel, "chaos", fault="kill_replica")
+        assert len(recs) == 1 and recs[0]["process"] == 1
+
+    def test_persist_is_a_slow_fault_modifier_only(self):
+        with pytest.raises(ValueError, match="persist"):
+            ScheduledFault("kill_replica", at_iter=1, persist=True)
+
+
+# ---------------------------------------------------------------------------
+class TestQueueFleetAttribution:
+    def test_records_carry_replica_and_tenant(self, fleet_engine):
+        tel = _tel()
+        with MicroBatchQueue(fleet_engine, telemetry=tel, replica=5,
+                             max_wait_us=0) as q:
+            res = q.submit(np.ones((3, D), dtype=np.float32),
+                           tenant="acme").result(timeout=10)
+            assert res.rows == 3
+            summary = q.latency_summary()
+            recent = q.recent_latencies()
+        oks = _by_kind(tel, "serve_request", status="ok")
+        assert len(oks) == 1
+        assert oks[0]["replica"] == 5 and oks[0]["tenant"] == "acme"
+        assert summary["replica"] == 5
+        assert recent == [pytest.approx(res.latency_ms)]
+        for r in _records(tel):
+            assert schema.validate_record(r) == [], r
+
+    def test_depth_gauge_tracks_per_op_and_drains_to_zero(
+            self, fleet_engine):
+        tel = _tel()
+        with MicroBatchQueue(fleet_engine, telemetry=tel,
+                             max_wait_us=0) as q:
+            q.submit(np.ones((2, D), dtype=np.float32),
+                     op="predict_proba").result(timeout=10)
+        gauge = tel.registry.gauge("serve.queue_depth.predict_proba")
+        assert gauge.value == 0
+
+    def test_tenant_attributed_rejects_count(self, fleet_engine):
+        tel = _tel()
+        with MicroBatchQueue(fleet_engine, telemetry=tel,
+                             max_queue_rows=2) as q:
+            with pytest.raises(ServeOverloaded):
+                q.submit(np.ones((4, D), dtype=np.float32),
+                         tenant="mallory")
+        rejected = _by_kind(tel, "serve_request", status="rejected")
+        assert len(rejected) == 1
+        assert rejected[0]["tenant"] == "mallory"
+        reg = tel.registry
+        assert reg.counter("serve.tenant_rejected").value == 1
+        assert reg.counter("serve.tenant_rejected.mallory").value == 1
+
+
+# ---------------------------------------------------------------------------
+def _route_rec(ts, who, decision="route", **extra):
+    rec = {"kind": "fleet_route", "decision": decision, "replica": who,
+           "winner": who, "timestamp_unix": float(ts)}
+    rec.update(extra)
+    return rec
+
+
+def _slow_chaos(ts, process):
+    return {"kind": "chaos", "fault": "slow_replica",
+            "process": process, "timestamp_unix": float(ts)}
+
+
+def _synthetic_shift(pre_slow=5, pre_other=5, post_slow=1,
+                     post_other=11):
+    """pre/post routed counts for slow replica 1 around boundary 100."""
+    recs = []
+    t = 90.0
+    for i in range(pre_slow):
+        recs.append(_route_rec(t + i * 0.1, 1))
+    for i in range(pre_other):
+        recs.append(_route_rec(t + 5 + i * 0.1, 0))
+    recs.append(_slow_chaos(100.0, 1))
+    for i in range(post_slow):
+        recs.append(_route_rec(101.0 + i * 0.1, 1))
+    for i in range(post_other):
+        recs.append(_route_rec(102.0 + i * 0.1, 0))
+    return recs
+
+
+class TestFleetGate:
+    def test_traffic_shift_passes(self):
+        result = gate_fleet(_synthetic_shift())
+        assert isinstance(result, FleetGateResult)
+        assert result.slow_replica == 1
+        assert result.pre_share == pytest.approx(0.5)
+        assert result.post_share == pytest.approx(1 / 12)
+        assert result.ok and result.exit_code() == 0
+        assert "FLEET GATE: pass" in format_fleet_report(result)
+        rec = result.record(run_id="r1")
+        assert schema.validate_record(rec) == [], rec
+
+    def test_no_shift_fails(self):
+        result = gate_fleet(_synthetic_shift(post_slow=6,
+                                             post_other=6))
+        assert not result.ok and not result.refused
+        assert result.exit_code() == 1
+        assert "FAIL" in format_fleet_report(result)
+
+    def test_empty_stream_refuses_typed(self):
+        result = gate_fleet([])
+        assert result.refused and result.exit_code() == 2
+        assert len(result.refusals) == 2
+        assert "REFUSED" in format_fleet_report(result)
+
+    def test_missing_chaos_boundary_refuses(self):
+        result = gate_fleet([_route_rec(1.0 + i, 0)
+                             for i in range(20)])
+        assert result.exit_code() == 2
+        assert any("slow_replica chaos" in r for r in result.refusals)
+
+    def test_too_few_requests_on_a_side_refuses(self):
+        result = gate_fleet(_synthetic_shift(post_slow=1,
+                                             post_other=2))
+        assert result.exit_code() == 2
+        assert any("post-chaos" in r for r in result.refusals)
+
+    def test_zero_pre_traffic_refuses(self):
+        result = gate_fleet(_synthetic_shift(pre_slow=0,
+                                             pre_other=10))
+        assert result.exit_code() == 2
+        assert any("cannot drop" in r for r in result.refusals)
+
+    def test_eviction_contamination_refuses(self):
+        recs = _synthetic_shift()
+        recs.append({"kind": "recovery", "action": "replica_evict",
+                     "process": 1, "timestamp_unix": 101.5})
+        result = gate_fleet(recs)
+        assert result.exit_code() == 2
+        assert any("EVICTED" in r for r in result.refusals)
+
+    def test_kill_contamination_refuses(self):
+        recs = _synthetic_shift()
+        recs.append({"kind": "chaos", "fault": "kill_replica",
+                     "process": 1, "timestamp_unix": 101.5})
+        result = gate_fleet(recs)
+        assert result.exit_code() == 2
+        assert any("KILLED" in r for r in result.refusals)
+
+    def test_window_bounds_the_post_side(self):
+        # inside the window the slow replica is drained; far past it
+        # the traffic returns — an unbounded gate would read that as
+        # "no shift", a windowed one must pass
+        recs = _synthetic_shift()
+        recs.extend(_route_rec(500.0 + i * 0.1, 1) for i in range(30))
+        assert gate_fleet(recs).exit_code() == 1
+        assert gate_fleet(recs, window_s=10.0).exit_code() == 0
+
+
+class TestFleetReport:
+    def test_fleet_rollup_cli(self, tmp_path, capsys):
+        path = str(tmp_path / "fleet.jsonl")
+        tel = Telemetry([JSONLSink(path)])
+        tel.fleet_route(decision="route", replica=0, winner=0,
+                        latency_ms=1.2, tool="serve.router")
+        tel.fleet_route(decision="hedge", replica=0, winner=1,
+                        latency_ms=9.0, tool="serve.router")
+        tel.fleet_route(decision="shed_tenant", tenant="mallory",
+                        tool="serve.router")
+        tel.replica_verdict(replica=0, verdict="slow",
+                            tool="serve.router")
+        tel.recovery(action="replica_evict", process=2,
+                     source="serve.router")
+        tel.flush()
+        from tools import agd_report
+
+        assert agd_report.main(["--fleet", path]) == 0
+        out = capsys.readouterr().out
+        assert "== fleet" in out
+        assert "mallory" in out
+
+
+# ---------------------------------------------------------------------------
+class TestFleetDrillTool:
+    def test_smoke_soak_exits_zero(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, DRILL, "--smoke",
+             "--out", str(tmp_path / "drill")],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+        assert proc.returncode == 0, (proc.stdout[-4000:]
+                                      + proc.stderr[-4000:])
+        assert "FLEET DRILL PASSED" in proc.stdout
+
+    @pytest.mark.slow
+    def test_full_soak_exits_zero(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, DRILL,
+             "--out", str(tmp_path / "drill")],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+        assert proc.returncode == 0, (proc.stdout[-4000:]
+                                      + proc.stderr[-4000:])
+        assert "FLEET DRILL PASSED" in proc.stdout

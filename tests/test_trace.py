@@ -677,8 +677,10 @@ class TestServeTracing:
         calls = [s for s in spans if s.name == "engine_call"]
         assert len(reqs) == 2 and batches and calls
         assert all(s.parent_id == root.span_id for s in reqs)
-        req_ids = {s.span_id for s in reqs}
-        assert all(b.parent_id in req_ids for b in batches)
+        # the batch span is a SIBLING of the requests it serves,
+        # parented on the submitter's already-durable context: a
+        # worker killed mid-batch truncates the tree, never orphans it
+        assert all(b.parent_id == root.span_id for b in batches)
         batch_ids = {b.span_id for b in batches}
         assert all(c.parent_id in batch_ids for c in calls)
         # siblings link back to the batch they rode in

@@ -68,7 +68,7 @@ def _kind(rec: dict) -> Optional[str]:
              "recovery", "numerics_failure", "contract_pin",
              "serve_request", "serve_latency", "trace_summary",
              "scaling_curve", "skew_estimate", "rebalance",
-             "canary", "promotion"):
+             "canary", "promotion", "fleet_route", "replica_verdict"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -374,6 +374,80 @@ def summarize_scaling(curves: List[dict]) -> str:
     return "\n\n".join(blocks)
 
 
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * len(sorted_vals) + 0.5)) - 1))
+    return sorted_vals[idx]
+
+
+def summarize_fleet(routes: List[dict], verdicts: List[dict],
+                    serve_reqs: List[dict],
+                    recoveries: List[dict]) -> str:
+    """The fleet rollup (``fleet_route`` / ``replica_verdict`` records
+    from ``serve.router``, ``replica_evict``/``request_hedge``/
+    ``request_retry`` recoveries): per replica — who served how much
+    and at what tail, who was evicted, how the hedge races went, and
+    which tenants were shed.  The router-side mirror of the per-queue
+    serving section above it."""
+    served: Dict[int, List[float]] = defaultdict(list)
+    hedges_won: Dict[int, int] = defaultdict(int)
+    hedges_lost: Dict[int, int] = defaultdict(int)
+    retries: Dict[int, int] = defaultdict(int)
+    sheds: Dict[str, int] = defaultdict(int)
+    for rec in routes:
+        d = rec.get("decision")
+        if d in ("route", "hedge"):
+            who = rec.get("winner", rec.get("replica"))
+            if isinstance(who, int) and not isinstance(who, bool):
+                lat = rec.get("latency_ms")
+                served[who].append(
+                    float(lat) if isinstance(lat, (int, float))
+                    and not isinstance(lat, bool) else float("nan"))
+            if d == "hedge":
+                primary = rec.get("replica")
+                if who is not None and who != primary:
+                    hedges_won[who] += 1
+                elif primary is not None:
+                    hedges_lost[primary] += 1
+        elif d == "retry":
+            who = rec.get("replica")
+            if isinstance(who, int) and not isinstance(who, bool):
+                retries[who] += 1
+        elif d == "shed_tenant":
+            sheds[str(rec.get("tenant", "-"))] += 1
+    evicted = {rec.get("process") for rec in recoveries
+               if rec.get("action") == "replica_evict"}
+    last_verdict: Dict[int, str] = {}
+    for rec in verdicts:
+        p = rec.get("replica")
+        if isinstance(p, int) and not isinstance(p, bool):
+            last_verdict[p] = str(rec.get("verdict", "-"))
+    replicas = sorted(set(served) | set(retries) | set(last_verdict)
+                      | {p for p in evicted if isinstance(p, int)})
+    headers = ["replica", "served", "p50_ms", "p99_ms", "hedges_won",
+               "hedges_lost_to", "retries_from", "verdict", "evicted"]
+    rows = []
+    for rep in replicas:
+        lat = sorted(v for v in served.get(rep, []) if v == v)
+        rows.append([
+            str(rep), str(len(served.get(rep, []))),
+            _fmt(_percentile(lat, 0.50)), _fmt(_percentile(lat, 0.99)),
+            str(hedges_won.get(rep, 0)), str(hedges_lost.get(rep, 0)),
+            str(retries.get(rep, 0)),
+            last_verdict.get(rep, "-"),
+            "yes" if rep in evicted else "-",
+        ])
+    out = [_table(headers, rows)]
+    if sheds:
+        out.append("")
+        out.append(_table(
+            ["tenant", "shed_requests"],
+            [[t, str(n)] for t, n in sorted(sheds.items())]))
+    return "\n".join(out)
+
+
 def summarize_scheduling(skews: List[dict], rebalances: List[dict],
                          recoveries: List[dict]) -> str:
     """The straggler-scheduling rollup (``skew_estimate`` /
@@ -580,6 +654,11 @@ def main(argv=None) -> int:
                         "(canary/promotion records and rollbacks; "
                         "the gate lives in tools/perf_gate.py "
                         "--promotion)")
+    p.add_argument("--fleet", action="store_true",
+                   help="print only the == fleet == rollup "
+                        "(fleet_route/replica_verdict records, "
+                        "evictions/hedges/retries/tenant sheds; the "
+                        "gate lives in tools/fleet_drill.py)")
     args = p.parse_args(argv)
 
     if args.compare:
@@ -600,6 +679,7 @@ def main(argv=None) -> int:
     serve_reqs, serve_lats, curves = [], [], []
     skews, rebalances = [], []
     canaries, promotions = [], []
+    fleet_routes, fleet_verdicts = [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -632,6 +712,10 @@ def main(argv=None) -> int:
             canaries.append(rec)
         elif k == "promotion":
             promotions.append(rec)
+        elif k == "fleet_route":
+            fleet_routes.append(rec)
+        elif k == "replica_verdict":
+            fleet_verdicts.append(rec)
         elif k is None:
             unknown += 1
 
@@ -662,6 +746,17 @@ def main(argv=None) -> int:
             return 1
         print(f"== scaling ({len(curves)} ladder(s)) ==")
         print(summarize_scaling(curves))
+        return 0
+
+    if args.fleet:
+        if not (fleet_routes or fleet_verdicts):
+            print("no fleet_route/replica_verdict records found",
+                  file=sys.stderr)
+            return 1
+        print(f"== fleet ({len(fleet_routes)} route decisions, "
+              f"{len(fleet_verdicts)} verdict changes) ==")
+        print(summarize_fleet(fleet_routes, fleet_verdicts,
+                              serve_reqs, recoveries))
         return 0
 
     if runs:
@@ -701,6 +796,11 @@ def main(argv=None) -> int:
         print(f"\n== pipeline ({len(canaries)} canaries, "
               f"{len(promotions)} promotion decisions) ==")
         print(summarize_pipeline(canaries, promotions, recoveries))
+    if fleet_routes or fleet_verdicts:
+        print(f"\n== fleet ({len(fleet_routes)} route decisions, "
+              f"{len(fleet_verdicts)} verdict changes) ==")
+        print(summarize_fleet(fleet_routes, fleet_verdicts,
+                              serve_reqs, recoveries))
     tracing = summarize_tracing(records, recoveries, args.trace)
     if tracing:
         print("\n== tracing ==")

@@ -1,0 +1,781 @@
+#!/usr/bin/env python
+"""The FLEET drill — CI proof that multi-replica serving survives
+replica death, routes around slow hosts, and degrades by shedding —
+never by dropping.
+
+One command spawns a real serve fleet (each replica a separate
+interpreter running :class:`spark_agd_tpu.serve.fleet.ReplicaServer`
+over loopback TCP, rendezvousing ONCE through the same gloo machinery
+the training drills use, heartbeating into a shared directory) and
+drives it through the whole robustness story with ≥4 concurrent
+clients, verifying EVERY answer against a numpy reference for the
+generation that produced it:
+
+1. **warm soak** — the :class:`FleetRouter` spreads statistically
+   equal replicas evenly (the spread band; pure min-EWMA routing
+   would collapse onto one host).
+2. **slow replica** — a persistent ``slow_replica`` chaos fault
+   degrades one replica mid-soak.  Its injected sleeps sub-beat
+   ``phase="slow"`` so the :class:`HostMonitor` verdicts it SLOW
+   (never lost); the router's EWMA leaves the spread band and traffic
+   measurably shifts — gated by the REAL ``obs.perfgate.gate_fleet``,
+   which REFUSES (exit 2) contaminated measurements.  The keep-warm
+   trickle still probes it, hedged against a healthy replica: first
+   answer wins, so the probe costs the client ~the hedge window, not
+   the stall.
+3. **replica death** — a ``kill_replica`` fault SIGKILLs a *different*
+   replica mid-request.  The router sees the connection reset, evicts
+   (``replica_evict``), and transparently retries the in-flight
+   request on a survivor (``request_retry``) — predict is pure, so
+   the retry is safe.  Zero admitted requests drop.
+4. **mid-soak hot swap** — the parent publishes generation 2 while
+   clients hammer the fleet; every replica's registry poll loop picks
+   it up (``hot_swap`` recovery) and both generations serve correct
+   answers during the transition, zero drops.  Surviving replicas'
+   exit summaries prove the swap went fleet-wide.
+5. **elastic join** — a fresh replica process joins the running
+   fleet at the generation boundary (it loads the newest generation
+   on start); ``refresh_membership`` adopts it and it serves traffic.
+   Clean leaves at teardown remove their membership + heartbeat
+   files; the crashed replica leaves its files behind — that
+   asymmetry is the verdict story.
+6. **tenant flood** — one tenant floods past the admission cap and is
+   shed with typed ``ServeOverloaded`` (``shed_tenant`` decisions)
+   while another tenant's p99 stays within budget.
+
+PASS (exit 0) requires all of the above, plus: every record across
+every stream schema-valid; ``gate_fleet`` exit 0 on the real records,
+exit 2 on a synthetically contaminated copy and on an empty stream;
+``tools/agd_report.py --fleet`` renders the rollup; and the whole
+story — parent, clients, hedges, retries, every replica — reconstructs
+as ONE connected trace tree under ``tools/agd_trace.py``.  Any miss
+prints the reason and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/fleet_drill.py [--smoke] [-v]
+
+``--smoke`` is the reduced tier-1 preset (~half the traffic, same
+story).  Internally re-invokes itself with ``--child`` per replica.
+See ``docs/SERVING.md`` §fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_FEATURES = 8
+ROW_POOL = 64
+SLOW_REPLICA = 2     # chaos-slowed mid-soak (leg 2)
+KILL_REPLICA = 1     # SIGKILLed mid-request (leg 3)
+JOIN_REPLICA = 3     # joins the running fleet (leg 5)
+
+_PRESETS = {
+    "full": dict(warm=96, slow=150, death=4000, swap_a=60, swap_b=150,
+                 join=60, hog=160, hog_threads=8, alice=48,
+                 slow_at=40, kill_at=120, slow_s=0.4, pace=0.004),
+    "smoke": dict(warm=36, slow=72, death=2000, swap_a=24, swap_b=90,
+                  join=30, hog=96, hog_threads=6, alice=32,
+                  slow_at=14, kill_at=55, slow_s=0.3, pace=0.002),
+}
+
+
+def _configure_jax(n_devices: int = 1, gloo: bool = True):
+    """Platform + precision config, BEFORE any backend use (same
+    ordering contract as tools/straggler_drill.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}")
+    if gloo:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — newer jax: default works
+            pass
+    return jax
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _model_pair():
+    """Two deterministic model parameterizations (generation 1 / 2)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    w1 = rng.normal(scale=0.8, size=N_FEATURES)
+    w2 = -0.5 * w1 + rng.normal(scale=0.2, size=N_FEATURES)
+    return (w1, 0.25), (w2, -0.1)
+
+
+def _row_pool():
+    import numpy as np
+
+    return np.random.default_rng(11).normal(
+        size=(ROW_POOL, N_FEATURES))
+
+
+def _proba_ref(X, w, b):
+    import numpy as np
+
+    # the wire casts rows to f32 — mirror it so the reference matches
+    z = np.asarray(X, np.float32).astype(np.float64) @ w + b
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+# -- the replica child -----------------------------------------------------
+
+def child_main(args) -> int:
+    """One replica process: gloo rendezvous once, then serve forever
+    (until SIGTERM, or a kill_replica fault gets there first)."""
+    distributed = args.nproc > 1
+    jax = _configure_jax(1, gloo=distributed)
+
+    import numpy as np
+
+    from spark_agd_tpu.obs import JSONLSink, Telemetry
+    from spark_agd_tpu.parallel import multihost as mh
+    from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                ScheduledFault)
+    from spark_agd_tpu.serve import (ModelRegistry, ReplicaServer,
+                                     ServeEngine)
+
+    fleet_dir = os.path.join(args.workdir, "fleet")
+    if distributed:
+        # the fleet rendezvouses through the training stack's gloo
+        # machinery ONCE (a synchronized start barrier), then leaves
+        # the coordination service: a replica SIGKILLed later must
+        # never be able to wedge a survivor inside a collective
+        mh.initialize(args.addr, args.nproc, args.replica)
+        ranks = mh.process_allgather_int64(np.array([args.replica]))
+        assert sorted(int(r) for r in ranks[:, 0]) == list(
+            range(args.nproc)), f"bad rendezvous: {ranks!r}"
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — already torn down is fine
+            pass
+
+    tel = Telemetry([JSONLSink(os.path.join(
+        args.workdir, f"drill-fleet.h{args.replica:03d}.jsonl"))])
+    registry = ModelRegistry(args.registry_dir, telemetry=tel)
+    loaded = registry.load_newest()
+    if loaded is None:
+        print("no published generation to serve", file=sys.stderr)
+        return 1
+    engine = ServeEngine(loaded.model, generation=loaded.generation,
+                         max_batch=32, min_bucket=4)
+
+    faults = []
+    if args.kill_at >= 0:
+        faults.append(ScheduledFault("kill_replica",
+                                     at_iter=args.kill_at,
+                                     process=args.replica))
+    if args.slow_at >= 0:
+        faults.append(ScheduledFault("slow_replica",
+                                     at_iter=args.slow_at,
+                                     process=args.replica,
+                                     payload=args.slow_s,
+                                     persist=True))
+    chaos = ChaosSchedule(faults, telemetry=tel) if faults else None
+
+    server = ReplicaServer(
+        fleet_dir, args.replica, engine, registry=registry,
+        telemetry=tel, chaos=chaos, max_queue_rows=args.queue_rows,
+        beat_every_s=1.0, poll_every_s=0.25)
+    signal.signal(signal.SIGTERM,
+                  lambda *_: server.request_stop())
+    server.start()
+    print(f"DRILL_CHILD_OK replica={args.replica} port={server.port} "
+          f"generation={loaded.generation}", flush=True)
+    while not server._stop.is_set():
+        time.sleep(0.1)
+    server.stop()
+    summary = {"replica": args.replica,
+               "requests_seen": server.requests_seen,
+               "generation": int(engine.generation)}
+    with open(os.path.join(args.workdir,
+                           f"summary-fleet-p{args.replica}.json"),
+              "w") as f:
+        json.dump(summary, f)
+    tel.flush()
+    print(f"DRILL_CHILD_DONE replica={args.replica} "
+          f"requests={summary['requests_seen']} "
+          f"generation={summary['generation']}", flush=True)
+    return 0
+
+
+# -- the parent ------------------------------------------------------------
+
+class _Abort(Exception):
+    """A setup step the rest of the drill cannot run without failed."""
+
+
+def _spawn_replica(args, replica: int, *, nproc: int, addr: str,
+                   kill_at: int, slow_at: int):
+    me = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(me))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return subprocess.Popen(
+        [sys.executable, me, "--child", "--replica", str(replica),
+         "--nproc", str(nproc), "--addr", addr,
+         "--workdir", args.workdir, "--registry", args.registry_dir,
+         "--kill-at", str(kill_at), "--slow-at", str(slow_at),
+         "--slow-s", str(args.slow_s),
+         "--queue-rows", str(args.queue_rows)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+
+
+def _reap(procs, timeout):
+    outs = {}
+    try:
+        for r, p in procs.items():
+            out, err = p.communicate(timeout=timeout)
+            outs[r] = (p.returncode, out.decode(), err.decode())
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def parent_main(args) -> int:  # noqa: C901 — one linear drill story
+    import tempfile
+
+    failures: list = []
+
+    def check(ok: bool, what: str):
+        tag = "ok" if ok else "FAIL"
+        if not ok:
+            failures.append(what)
+        if args.verbose or not ok:
+            print(f"{tag}: {what}")
+
+    def require(ok: bool, what: str):
+        check(ok, what)
+        if not ok:
+            raise _Abort(what)
+
+    preset = _PRESETS["smoke" if args.smoke else "full"]
+    args.slow_s = preset["slow_s"]
+    args.workdir = args.out or tempfile.mkdtemp(prefix="fleet_drill_")
+    args.registry_dir = os.path.join(args.workdir, "registry")
+    fleet_dir = os.path.join(args.workdir, "fleet")
+    for d in (args.registry_dir, fleet_dir):
+        os.makedirs(d, exist_ok=True)
+    for stale in (glob.glob(os.path.join(args.workdir, "*.json*"))
+                  + glob.glob(os.path.join(fleet_dir, "*"))
+                  + glob.glob(os.path.join(args.registry_dir, "*"))):
+        os.unlink(stale)
+
+    _configure_jax(1, gloo=False)
+    import numpy as np
+
+    from spark_agd_tpu.models.glm import LogisticRegressionModel
+    from spark_agd_tpu.obs import (JSONLSink, Telemetry, schema,
+                                   timeline)
+    from spark_agd_tpu.obs import trace as trace_lib
+    from spark_agd_tpu.obs.perfgate import (format_fleet_report,
+                                            gate_fleet)
+    from spark_agd_tpu.resilience.distributed import HostMonitor
+    from spark_agd_tpu.resilience.errors import ServeOverloaded
+    from spark_agd_tpu.serve import (FleetRouter, ModelRegistry,
+                                     discover_replicas)
+
+    (w1, b1), (w2, b2) = _model_pair()
+    registry = ModelRegistry(args.registry_dir)
+    g1 = registry.publish(LogisticRegressionModel(w1, intercept=b1))
+    require(g1 == 1, f"generation 1 published (got {g1})")
+
+    tel = Telemetry([JSONLSink(os.path.join(args.workdir,
+                                            "drill-fleet.jsonl"))])
+    root_span = tel.trace_span("fleet_drill", tool="fleet_drill")
+    root_ctx = root_span.__enter__()
+    os.environ[trace_lib.TRACE_ENV] = root_ctx.to_env_value()
+
+    X = _row_pool()
+    refs = {1: _proba_ref(X, w1, b1)}
+    drops: list = []
+    lock = threading.Lock()
+
+    def _soak(phase, n, collect, *, threads=4,
+              tenants=("alice", "bob"), pace_s=None, stop_when=None):
+        """``n`` requests across ``threads`` concurrent clients, every
+        answer verified against the reference for ITS generation.
+        Typed sheds are recorded; anything else untyped is a DROP."""
+        pace = preset["pace"] if pace_s is None else pace_s
+        counter = iter(range(n))
+
+        def worker(t):
+            with tel.trace_span(f"{phase}_client{t}",
+                                parent=root_ctx):
+                while stop_when is None or not stop_when():
+                    with lock:
+                        i = next(counter, None)
+                    if i is None:
+                        return
+                    k = 4 + (i % 5)
+                    lo = (i * 7) % (ROW_POOL - 8)
+                    tenant = tenants[i % len(tenants)]
+                    try:
+                        res = router.request(X[lo:lo + k],
+                                             op="predict_proba",
+                                             tenant=tenant)
+                    except ServeOverloaded as e:
+                        with lock:
+                            collect.append({"shed": True,
+                                            "tenant": tenant,
+                                            "detail": str(e)})
+                        continue
+                    except Exception as e:  # noqa: BLE001 — a drop
+                        with lock:
+                            drops.append(
+                                (phase, f"{type(e).__name__}: {e}"))
+                        continue
+                    ref = refs.get(res.generation)
+                    vals = np.asarray(res.values, np.float64).ravel()
+                    good = (ref is not None and vals.shape == (k,)
+                            and np.allclose(vals, ref[lo:lo + k],
+                                            atol=1e-4))
+                    with lock:
+                        collect.append({
+                            "replica": res.replica,
+                            "generation": res.generation,
+                            "latency_ms": res.latency_ms,
+                            "value_ok": bool(good),
+                            "hedged": res.hedged,
+                            "retried": res.retried})
+                    if pace:
+                        time.sleep(pace)
+
+        ts = [threading.Thread(target=worker, args=(t,),
+                               name=f"{phase}-client{t}")
+              for t in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    def _served(results):
+        by = {}
+        for r in results:
+            if "replica" in r:
+                by[r["replica"]] = by.get(r["replica"], 0) + 1
+        return by
+
+    def _values_ok(results, what):
+        bad = [r for r in results if "value_ok" in r
+               and not r["value_ok"]]
+        check(not bad, f"{what}: every answer matches the numpy "
+                       f"reference for its generation "
+                       + (f"(first bad: {bad[0]})" if bad else
+                          f"({len(results)} answers)"))
+
+    port = _free_port()
+    procs = {}
+    router = None
+    try:
+        for i in range(3):
+            procs[i] = _spawn_replica(
+                args, i, nproc=3, addr=f"localhost:{port}",
+                kill_at=(preset["kill_at"]
+                         if i == KILL_REPLICA else -1),
+                slow_at=(preset["slow_at"]
+                         if i == SLOW_REPLICA else -1))
+
+        def _await_members(want, deadline_s):
+            t0 = time.time()
+            while time.time() - t0 < deadline_s:
+                found = discover_replicas(fleet_dir)
+                if want <= set(found):
+                    return found
+                for r in sorted(want):
+                    p = procs.get(r)
+                    if p is not None and p.poll() is not None:
+                        _, err = p.communicate()
+                        require(False,
+                                f"replica {r} died before joining "
+                                f"(rc={p.returncode})\n"
+                                f"{err.decode()[-2000:]}")
+                time.sleep(0.2)
+            require(False, f"replicas {sorted(want)} announced "
+                           f"membership within {deadline_s:g}s")
+
+        handles = _await_members({0, 1, 2}, 120.0)
+        check(True, f"fleet up: {sorted(handles)} announced")
+
+        monitor = HostMonitor(fleet_dir, stale_after_s=4.0,
+                              slow_after_s=1.5, telemetry=tel)
+        router = FleetRouter(handles, monitor=monitor, telemetry=tel,
+                             tenant_max_outstanding=64,
+                             request_timeout_s=30.0)
+
+        # -- leg 1: warm soak — even spread, all correct ------------------
+        warm: list = []
+        _soak("warm", preset["warm"], warm)
+        check(not drops, f"zero drops through the warm soak "
+                         f"({drops[:3]})")
+        _values_ok(warm, "warm soak")
+        by = _served(warm)
+        floor = preset["warm"] // 8
+        check(all(by.get(r, 0) >= floor for r in range(3)),
+              f"spread band: every replica served >= {floor} of "
+              f"{preset['warm']} warm requests (got {by})")
+
+        # -- leg 2: slow replica — verdict, shift, hedged probes ----------
+        slow_seen = [False]
+        stop_poll = threading.Event()
+
+        def _poll_verdicts():
+            while not stop_poll.wait(0.03):
+                if monitor.verdicts().get(SLOW_REPLICA) == "slow":
+                    slow_seen[0] = True
+
+        poller = threading.Thread(target=_poll_verdicts,
+                                  name="verdict-poller")
+        poller.start()
+        slow_recs: list = []
+        _soak("slow", preset["slow"], slow_recs)
+        stop_poll.set()
+        poller.join()
+        check(not drops, f"zero drops through the slow soak "
+                         f"({drops[:3]})")
+        _values_ok(slow_recs, "slow soak")
+        check(slow_seen[0],
+              f"HostMonitor verdicted replica {SLOW_REPLICA} SLOW "
+              "while its injected sleeps sub-beat phase=\"slow\"")
+        check(SLOW_REPLICA in router.members,
+              "the slow replica stays a member — deprioritized and "
+              "kept warm, never evicted (slow != lost)")
+        check(router.stats.hedges >= 1,
+              f"the tail was hedged: keep-warm probes to the slowed "
+              f"replica raced a second copy "
+              f"(hedges={router.stats.hedges})")
+        check(router.stats.hedges_won >= 1,
+              f"at least one hedge WON — first answer wins, the "
+              f"client never pays the stall "
+              f"(won={router.stats.hedges_won})")
+
+        # -- leg 3: replica death — evict + transparent retry -------------
+        death: list = []
+        _soak("death", preset["death"], death,
+              stop_when=lambda: procs[KILL_REPLICA].poll() is not None)
+        killed_rc = procs[KILL_REPLICA].wait(timeout=30)
+        check(killed_rc == -signal.SIGKILL,
+              f"kill_replica SIGKILLed replica {KILL_REPLICA} "
+              f"mid-soak (rc={killed_rc})")
+        _soak("death_after", 24, death)
+        check(not drops, f"zero drops through replica death — every "
+                         f"admitted request answered ({drops[:3]})")
+        _values_ok(death, "death soak")
+        check(router.stats.retries >= 1,
+              f"in-flight requests on the dead replica were "
+              f"transparently retried on a survivor "
+              f"(retries={router.stats.retries})")
+        check(router.stats.evictions >= 1
+              and KILL_REPLICA not in router.members,
+              f"the dead replica was evicted "
+              f"(members={router.members})")
+
+        # -- leg 4: mid-soak publish + fleet-wide hot swap ----------------
+        refs[2] = _proba_ref(X, w2, b2)
+        published = {}
+
+        def _publish_late():
+            time.sleep(0.15)
+            published["generation"] = registry.publish(
+                LogisticRegressionModel(w2, intercept=b2))
+
+        swap: list = []
+        _soak("swap_pre", preset["swap_a"], swap)
+        publisher = threading.Thread(target=_publish_late,
+                                     name="publisher")
+        publisher.start()
+        _soak("swap", preset["swap_b"], swap, pace_s=0.005)
+        publisher.join()
+        check(published.get("generation") == 2,
+              f"generation 2 published mid-soak "
+              f"(got {published.get('generation')})")
+        check(not drops, f"zero drops through the hot swap "
+                         f"({drops[:3]})")
+        _values_ok(swap, "hot-swap soak")
+        gens = {r["generation"] for r in swap if "generation" in r}
+        check(1 in gens, "generation 1 still served during the swap")
+        settle: list = []
+        t0 = time.time()
+        while time.time() - t0 < 15.0:
+            _soak("settle", 4, settle, threads=1)
+            if settle and settle[-1].get("generation") == 2:
+                break
+        check(bool(settle) and settle[-1].get("generation") == 2,
+              "the fleet settled on generation 2 after the swap")
+        _values_ok(settle, "settle probes")
+
+        # -- leg 5: elastic join at the generation boundary ---------------
+        procs[JOIN_REPLICA] = _spawn_replica(
+            args, JOIN_REPLICA, nproc=1, addr="none",
+            kill_at=-1, slow_at=-1)
+        _await_members({JOIN_REPLICA}, 120.0)
+        monitor.poll()
+        alive = {r: h for r, h in discover_replicas(fleet_dir).items()
+                 if monitor.verdicts().get(r) != "lost"}
+        delta = router.refresh_membership(alive)
+        check(JOIN_REPLICA in delta["joined"]
+              and KILL_REPLICA not in router.members,
+              f"replica {JOIN_REPLICA} joined the running fleet at "
+              f"the generation boundary — and the crashed replica's "
+              f"stale membership file did NOT resurrect it "
+              f"(delta={delta})")
+        join_recs: list = []
+        _soak("join", preset["join"], join_recs)
+        check(not drops, f"zero drops through the join soak "
+                         f"({drops[:3]})")
+        _values_ok(join_recs, "join soak")
+        check(_served(join_recs).get(JOIN_REPLICA, 0) >= 1,
+              f"the joined replica serves traffic "
+              f"(served={_served(join_recs)})")
+
+        # -- leg 6: tenant flood — shed typed, others in budget -----------
+        router.tenant_max_outstanding = 2
+        hog: list = []
+        alice: list = []
+        hog_t = threading.Thread(
+            target=_soak, args=("flood_hog", preset["hog"], hog),
+            kwargs=dict(threads=preset["hog_threads"],
+                        tenants=("mallory",), pace_s=0.0),
+            name="flood-hog")
+        hog_t.start()
+        _soak("flood_alice", preset["alice"], alice, threads=1,
+              tenants=("alice",), pace_s=0.005)
+        hog_t.join()
+        router.tenant_max_outstanding = 64
+        sheds = [r for r in hog if r.get("shed")]
+        check(len(sheds) >= 1,
+              f"the flooding tenant was shed with typed "
+              f"ServeOverloaded (sheds={len(sheds)}/{len(hog)})")
+        check(any("admission cap" in s["detail"] for s in sheds),
+              "sheds name the tenant admission cap"
+              + (f" (first: {sheds[0]['detail']})" if sheds else ""))
+        check(not drops, f"zero drops through the flood — shedding "
+                         f"is typed, never a drop ({drops[:3]})")
+        _values_ok([r for r in hog if "value_ok" in r],
+                   "admitted flood requests")
+        check(not any(r.get("shed") for r in alice),
+              "the well-behaved tenant was never shed")
+        lats = sorted(r["latency_ms"] for r in alice
+                      if "latency_ms" in r)
+        p99 = lats[min(len(lats) - 1,
+                       int(0.99 * len(lats)))] if lats else None
+        check(p99 is not None and p99 <= args.flood_budget_ms,
+              f"the well-behaved tenant's p99 stayed in budget under "
+              f"the flood ({p99 if p99 is None else round(p99, 1)}ms "
+              f"<= {args.flood_budget_ms:g}ms)")
+
+        # -- teardown: clean leaves vs the crash --------------------------
+        router.close()
+        for r, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        outs = _reap(procs, timeout=60)
+        for r, (rc, out, err) in sorted(outs.items()):
+            if r == KILL_REPLICA:
+                continue
+            check(rc == 0 and "DRILL_CHILD_DONE" in out,
+                  f"replica {r} left cleanly on SIGTERM (rc={rc})"
+                  + ("" if rc == 0 else f"\n{err[-2000:]}"))
+        for r in sorted(set(procs) - {KILL_REPLICA}):
+            path = os.path.join(args.workdir,
+                                f"summary-fleet-p{r}.json")
+            ok = False
+            if os.path.exists(path):
+                with open(path) as f:
+                    ok = json.load(f)["generation"] == 2
+            check(ok, f"replica {r}'s exit summary proves it served "
+                      "generation 2 — the hot swap went fleet-wide")
+        leftovers = set(discover_replicas(fleet_dir))
+        check(leftovers == {KILL_REPLICA},
+              f"clean leavers removed their membership files; only "
+              f"the crashed replica's survives (got "
+              f"{sorted(leftovers)})")
+    except _Abort:
+        pass
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    # -- the record evidence ----------------------------------------------
+    root_span.__exit__(None, None, None)
+    tel.flush()
+    jsonls = sorted(glob.glob(os.path.join(args.workdir,
+                                           "drill-fleet*.jsonl*")))
+    records = []
+    for path in jsonls:
+        records.extend(schema.read_jsonl(path))
+    invalid = [(i, errs) for i, rec in enumerate(records, 1)
+               if (errs := schema.validate_record(
+                   json.loads(json.dumps(rec, default=str))))]
+    check(not invalid,
+          f"all {len(records)} records across {len(jsonls)} streams "
+          "are schema-valid"
+          + (f" (first bad: {invalid[0]})" if invalid else ""))
+
+    kinds: dict = {}
+    actions: dict = {}
+    decisions: dict = {}
+    for r in records:
+        kinds[r.get("kind")] = kinds.get(r.get("kind"), 0) + 1
+        if r.get("kind") == "recovery":
+            actions[r["action"]] = actions.get(r["action"], 0) + 1
+        if r.get("kind") == "fleet_route":
+            decisions[r["decision"]] = (
+                decisions.get(r["decision"], 0) + 1)
+    check(kinds.get("replica_verdict", 0) >= 1,
+          f"replica_verdict records on the stream "
+          f"(x{kinds.get('replica_verdict', 0)})")
+    for want in ("route", "hedge", "retry", "shed_tenant"):
+        check(decisions.get(want, 0) >= 1,
+              f"fleet_route decision {want!r} on the stream "
+              f"(x{decisions.get(want, 0)})")
+    for want in ("replica_evict", "request_hedge", "request_retry",
+                 "hot_swap"):
+        check(actions.get(want, 0) >= 1,
+              f"recovery action {want!r} on the stream "
+              f"(x{actions.get(want, 0)})")
+
+    gate = gate_fleet(records)
+    print(format_fleet_report(gate))
+    check(gate.exit_code() == 0,
+          f"gate_fleet PASSES on the real records: the slowed "
+          f"replica's served share {gate.pre_share} -> "
+          f"{gate.post_share} (status={gate.status()})")
+    gate_rec = gate.record(run_id=tel.run_id)
+    check(not schema.validate_record(
+        json.loads(json.dumps(gate_rec, default=str))),
+          "the fleet_gate evidence record is schema-valid")
+    if gate.boundary_unix is not None:
+        poisoned = records + [{
+            "kind": "recovery", "action": "replica_evict",
+            "process": SLOW_REPLICA,
+            "timestamp_unix": gate.boundary_unix + 0.01}]
+        check(gate_fleet(poisoned).exit_code() == 2,
+              "gate_fleet REFUSES (exit 2) a contaminated copy — an "
+              "eviction of the slowed replica inside the window")
+    check(gate_fleet([]).exit_code() == 2,
+          "gate_fleet REFUSES (exit 2) an empty stream")
+
+    tids = timeline.trace_ids(records)
+    check(len(tids) == 1,
+          f"the whole story is ONE trace tree ({len(tids)} trace "
+          f"ids: {tids[:4]})")
+    if tids:
+        rep = timeline.analyze(records, tids[0])
+        check(rep.connected,
+              "the trace tree is CONNECTED — parent, clients, "
+              "hedges, retries, and every replica hang off one root")
+
+    tools = os.path.dirname(os.path.abspath(__file__))
+    cli = subprocess.run(
+        [sys.executable, os.path.join(tools, "agd_trace.py")] + jsonls,
+        capture_output=True, text=True, timeout=120)
+    check(cli.returncode == 0 and "connected=yes" in cli.stdout
+          and "connected=NO" not in cli.stdout,
+          f"tools/agd_trace.py reconstructs the story "
+          f"(rc={cli.returncode})"
+          + ("" if cli.returncode == 0 else f"\n{cli.stderr[-800:]}"))
+    cli = subprocess.run(
+        [sys.executable, os.path.join(tools, "agd_report.py"),
+         "--fleet"] + jsonls,
+        capture_output=True, text=True, timeout=120)
+    check(cli.returncode == 0 and "== fleet" in cli.stdout,
+          f"tools/agd_report.py --fleet renders the rollup "
+          f"(rc={cli.returncode})"
+          + ("" if cli.returncode == 0 else f"\n{cli.stderr[-800:]}"))
+
+    if router is not None:
+        print(f"fleet stats: {router.stats}")
+    print(f"drill artifacts under {args.workdir} "
+          f"({len(records)} records in {len(jsonls)} streams)")
+    return _verdict(failures, args)
+
+
+def _verdict(failures, args) -> int:
+    if failures:
+        print(f"FLEET DRILL FAILED ({len(failures)} checks):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("FLEET DRILL PASSED: replica death survived with zero "
+          "drops (evict + transparent retry), the slowed replica "
+          "verdicted SLOW and measurably drained (gate_fleet), tail "
+          "probes hedged and won, a mid-soak publish hot-swapped "
+          "fleet-wide across both generations, a fresh replica "
+          "joined elastically, and the flooding tenant shed typed "
+          "while the quiet tenant's p99 held")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/fleet_drill.py",
+        description="multi-replica serve-fleet robustness drill")
+    p.add_argument("--child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--replica", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--nproc", type=int, default=1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--addr", default="none", help=argparse.SUPPRESS)
+    p.add_argument("--workdir", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--registry", dest="registry_dir", default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--kill-at", type=int, default=-1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--slow-at", type=int, default=-1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--slow-s", type=float, default=0.4,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--queue-rows", type=int, default=256,
+                   help="replica-level queue backpressure bound "
+                        "(default 256)")
+    p.add_argument("--smoke", action="store_true",
+                   help="reduced tier-1 preset: same story, ~half "
+                        "the traffic")
+    p.add_argument("--flood-budget-ms", type=float, default=1500.0,
+                   help="p99 budget for the well-behaved tenant "
+                        "during the flood (default 1500)")
+    p.add_argument("--out", default=None,
+                   help="directory for the registry/heartbeats/JSONLs "
+                        "(default: a fresh temp dir)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,10 @@ PASS (exit 0) additionally requires every record in the emitted JSONL
 the canonical ``obs.schema``; that the soak's traced spans
 (``obs.trace``) assemble into ONE connected causal tree — every
 request span a child of the soak root (explicit cross-thread
-propagation through the queue), every batch span under a request,
+propagation through the queue), every batch span a SIBLING of the
+requests it serves — parented on the submitting client's context,
+whose open record is already durable, so a worker killed mid-batch
+truncates the tree instead of orphaning it —
 every engine_call under a batch, with BOTH generations visible on
 request spans across the mid-trace hot swap; and that the overload
 leg's automatic flight-recorder dump (``obs.flight``) replays clean
@@ -317,12 +320,18 @@ def main(argv=None) -> int:
     check(all(s.parent_id == root_ctx.span_id for s in req_spans),
           "every request span is a child of the soak root (explicit "
           "cross-thread propagation held)")
-    req_ids = {s.span_id for s in req_spans}
     batch_ids = {s.span_id for s in batch_spans}
     check(batch_spans
-          and all(s.parent_id in req_ids for s in batch_spans),
-          f"every batch span ({len(batch_spans)}) parents under a "
-          "request span")
+          and all(s.parent_id == root_ctx.span_id
+                  for s in batch_spans),
+          f"every batch span ({len(batch_spans)}) parents on the "
+          "submitting client's context — a durable sibling of its "
+          "request spans, so a mid-batch crash truncates, never "
+          "orphans")
+    check(all(s.record.get("batch_span_id") in batch_ids
+              for s in req_spans),
+          "every request span links to the batch it rode in "
+          "(batch_span_id)")
     check(engine_spans
           and all(s.parent_id in batch_ids for s in engine_spans),
           f"every engine_call span ({len(engine_spans)}) parents "
