@@ -80,6 +80,10 @@ HELPER_SIGNATURES: Dict[str, Tuple[Tuple[str, ...], frozenset]] = {
     # one replica-health classification change
     "fleet_route": ((), frozenset({"decision"})),
     "replica_verdict": ((), frozenset({"replica", "verdict"})),
+    # the streaming data plane (data.streaming): one poisoned-shard
+    # quarantine decision and one completed streamed pass
+    "shard_quarantine": ((), frozenset({"shard"})),
+    "stream_epoch": ((), frozenset({"epoch", "batches"})),
 }
 
 

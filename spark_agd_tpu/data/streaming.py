@@ -17,7 +17,11 @@ Python ints (no 2^31 wrap at any scale; see ``ops.losses._count``).
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, Optional, Tuple
+import dataclasses
+import logging
+import time
+from typing import (Callable, Iterable, Iterator, NamedTuple, Optional,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +31,10 @@ from ..core import tvec
 from ..ops.losses import Gradient
 from ..ops.sparse import CSRMatrix, RowShardedCSR
 from ..parallel import dist_smooth, mesh as mesh_lib
+from ..resilience import retry as retry_lib
+from ..resilience.errors import StreamDataLoss
+
+logger = logging.getLogger("spark_agd_tpu")
 
 
 def iter_array_batches(X, y, batch_rows: int,
@@ -128,6 +136,167 @@ def iter_csr_batches(indptr, indices, values, n_features: int, y,
         yield Xb, yb, mb
 
 
+@dataclasses.dataclass(frozen=True)
+class QuarantinePolicy:
+    """When may a streamed epoch continue after poisoned shards?
+
+    A shard that still fails parse/validation after its retry budget is
+    QUARANTINED: recorded as a typed ``shard_quarantine`` telemetry
+    record, skipped for the rest of the process's life (sticky — the
+    batch sequence must be identical on every subsequent pass or the
+    mid-epoch cursor would replay different math), and the epoch
+    continues degraded — the data-plane analogue of
+    ``resilience.degrade``.  ``min_data_fraction`` is the honesty
+    floor: once fewer than this fraction of shards is healthy the
+    stream refuses with a typed
+    :class:`~spark_agd_tpu.resilience.errors.StreamDataLoss` instead
+    of silently fitting a sliver of the data."""
+
+    min_data_fraction: float = 0.5
+
+    def __post_init__(self):
+        if not 0.0 <= self.min_data_fraction <= 1.0:
+            raise ValueError("min_data_fraction must be in [0, 1]")
+
+
+class StreamCursor(NamedTuple):
+    """Mid-epoch resume point: which pass (since the last boundary
+    checkpoint), which batch within it, plus the accumulator carry —
+    everything needed to continue a streamed smooth evaluation from
+    the last committed batch instead of restarting the epoch.
+
+    ``pass_offset`` counts smooth/smooth-loss PASSES begun since the
+    last boundary commit (a resumed process replays the boundary warm
+    state deterministically, so its pass counter re-aligns);
+    ``batch_index`` is the number of batches already folded into
+    ``acc_leaves``; ``n`` is the host-side row count so far.  Leaves
+    round-trip through npz as exact bytes, so a resumed pass is
+    bit-identical to the uninterrupted one (pinned in tier-1)."""
+
+    pass_offset: int
+    batch_index: int
+    n: int
+    acc_leaves: Tuple[np.ndarray, ...]
+
+
+# npz entry names of an encoded cursor — all under the ``stream_``
+# namespace ``utils.checkpoint`` reserves for rider entries
+_CUR_PASS = "stream_pass"
+_CUR_BATCH = "stream_batch"
+_CUR_N = "stream_n"
+_CUR_LEN = "stream_acc_len"
+_CUR_ACC = "stream_acc_"
+
+
+def cursor_to_extra(cursor: StreamCursor) -> dict:
+    """Encode a cursor as checkpoint rider entries (plain arrays)."""
+    extra = {_CUR_PASS: np.asarray(int(cursor.pass_offset)),
+             _CUR_BATCH: np.asarray(int(cursor.batch_index)),
+             _CUR_N: np.asarray(int(cursor.n), np.int64),
+             _CUR_LEN: np.asarray(len(cursor.acc_leaves))}
+    for i, leaf in enumerate(cursor.acc_leaves):
+        extra[f"{_CUR_ACC}{i}"] = np.asarray(leaf)
+    return extra
+
+
+def cursor_from_extras(extras) -> Optional[StreamCursor]:
+    """Decode the cursor out of loaded checkpoint extras; None when the
+    entries are absent or torn (a partial rider means the epoch restarts
+    from the boundary — correct, just slower)."""
+    if not extras or _CUR_PASS not in extras:
+        return None
+    try:
+        k = int(extras[_CUR_LEN])
+        leaves = tuple(np.asarray(extras[f"{_CUR_ACC}{i}"])
+                       for i in range(k))
+        return StreamCursor(int(extras[_CUR_PASS]),
+                            int(extras[_CUR_BATCH]),
+                            int(extras[_CUR_N]), leaves)
+    except KeyError:
+        return None
+
+
+class StreamCheckpoint:
+    """The mid-epoch commit protocol between :func:`fold_stream` and an
+    ``AutoCheckpointer`` (or ``DistributedCheckpointer``): every
+    ``every_batches`` folded batches the current :class:`StreamCursor`
+    is force-saved as rider entries on the LAST BOUNDARY warm state
+    (``AutoCheckpointer.update_stream``), so a preemption mid-pass
+    resumes from the boundary and replays forward to the cursor —
+    skipping the already-committed batches without re-running their
+    kernels — instead of restarting the epoch.
+
+    Wiring: constructing this sets ``checkpointer.stream_hook = self``;
+    the checkpointer then reports boundary commits (which reset the
+    pass counter and invalidate any pending cursor) and hands over
+    loaded rider entries (:meth:`adopt`) whether ``load()`` ran before
+    or after construction.  ``on_commit(count)`` (optional) fires after
+    each durable commit — the stream drill's SIGKILL trigger."""
+
+    def __init__(self, checkpointer, *, every_batches: int,
+                 on_commit: Optional[Callable[[int], None]] = None):
+        if every_batches < 1:
+            raise ValueError("every_batches must be >= 1")
+        self.checkpointer = checkpointer
+        self.every_batches = int(every_batches)
+        self.on_commit = on_commit
+        self.commits = 0
+        self._pass = 0  # passes begun since the last boundary commit
+        self._pending: Optional[StreamCursor] = None
+        checkpointer.stream_hook = self
+        if getattr(checkpointer, "loaded_extras", None):
+            self.adopt(checkpointer.loaded_extras)
+
+    def begin_pass(self) -> Tuple[int, Optional[StreamCursor]]:
+        """Start one streamed pass: returns ``(ordinal, cursor)`` where
+        the cursor is non-None exactly when this pass is the one a
+        loaded checkpoint interrupted (consumed once)."""
+        ordinal = self._pass
+        self._pass += 1
+        cur = None
+        if self._pending is not None \
+                and self._pending.pass_offset == ordinal:
+            cur = self._pending
+            self._pending = None
+        return ordinal, cur
+
+    def maybe_commit(self, ordinal: int, batch_index: int, acc,
+                     ns) -> bool:
+        """Commit the cursor when the batch cadence is due.  ``acc`` is
+        the live accumulator (any pytree; leaves are pulled to host
+        arrays — this is the one sync point of a streamed pass), ``ns``
+        the per-batch count list."""
+        if batch_index % self.every_batches:
+            return False
+        leaves = tuple(np.asarray(x)
+                       for x in jax.tree_util.tree_leaves(acc))
+        cur = StreamCursor(int(ordinal), int(batch_index),
+                           sum(int(x) for x in ns), leaves)
+        if not self.checkpointer.update_stream(cursor_to_extra(cur)):
+            return False  # no boundary carry yet to anchor the cursor
+        self.commits += 1
+        if self.on_commit is not None:
+            self.on_commit(self.commits)
+        return True
+
+    # -- AutoCheckpointer hook interface ----------------------------------
+    def on_boundary(self) -> None:
+        """A boundary commit landed: the carry is exact again, so the
+        pass counter resets and any not-yet-consumed cursor is stale.
+        A boundary seen before any pass began (the supervisor seeding
+        its checkpointer right after load) keeps the pending cursor —
+        nothing has been replayed yet."""
+        if self._pass > 0:
+            self._pending = None
+        self._pass = 0
+
+    def adopt(self, extras) -> None:
+        """Arm the pending cursor from loaded checkpoint extras."""
+        cur = cursor_from_extras(extras)
+        if cur is not None:
+            self._pending = cur
+
+
 class StreamingDataset:
     """A re-iterable source of ``(X, y, mask)`` macro-batches.
 
@@ -140,6 +309,10 @@ class StreamingDataset:
                  batch_rows: Optional[int] = None):
         self._factory = factory
         self.batch_rows = batch_rows
+        # path -> reason for shards the hardened reader has poisoned-out
+        # (``from_libsvm_parts(quarantine=...)``); empty for in-memory
+        # sources
+        self.quarantined: dict = {}
 
     @classmethod
     def from_arrays(cls, X, y, batch_rows: int, mask=None):
@@ -162,7 +335,11 @@ class StreamingDataset:
                           with_csc="lazy",
                           nnz_pad: Optional[int] = None,
                           binarize_labels: bool = True,
-                          retries=None, telemetry=None):
+                          retries=None, telemetry=None,
+                          validate=False,
+                          quarantine=None,
+                          read_timeout: Optional[float] = None,
+                          chaos=None):
         """Stream LIBSVM partition files (e.g. a Spark job's part-*
         output — the north star's ingest seam) as fixed-shape CSR
         macro-batches WITHOUT ever materializing the full dataset: one
@@ -180,36 +357,146 @@ class StreamingDataset:
         sparse columns), and out-of-range indices fail at parse time
         rather than silently clamping inside the compiled gather.
 
-        ``retries`` (a ``resilience.RetryPolicy``, default 3 attempts):
-        each part's parse runs under the shared retrying helper, so a
-        transient IO error mid-stream costs a backoff, not the whole
-        fit — the streamed smooth re-reads every part EVERY evaluation,
-        multiplying exposure to flaky storage.  Retries are logged and,
-        when ``telemetry`` is given, land as ``recovery`` records.
+        Fault hardening (the streamed smooth re-reads every part EVERY
+        evaluation, multiplying exposure to flaky storage):
+
+        - ``retries`` (a ``resilience.RetryPolicy``, default
+          ``ingest.DEFAULT_READ_RETRIES``): each shard read runs under
+          the shared retry engine — transient IO errors back off and
+          re-read; each retry logs and (with ``telemetry``) emits a
+          ``recovery`` record.
+        - ``read_timeout`` (seconds per ATTEMPT): overlays
+          ``attempt_timeout`` on the policy, so a reader that HANGS
+          (NFS stall, wedged parser) raises a TRANSIENT
+          ``AttemptTimeout`` instead of wedging the epoch.
+        - ``validate`` (``False`` / ``"raise"`` / ``"drop"``): the
+          ``ingest`` validation policy per shard — ``"raise"`` = typed
+          ``DataValidationError`` (FATAL) on the first bad row,
+          ``"drop"`` = discard invalid rows, log, and count them on the
+          ``data.invalid_records`` telemetry counter.
+        - ``quarantine`` (``True`` / :class:`QuarantinePolicy` /
+          ``None`` = off): a shard STILL failing after its retry budget
+          is quarantined (typed ``shard_quarantine`` record; sticky on
+          ``dataset.quarantined``) and the epoch continues degraded —
+          until fewer than ``min_data_fraction`` of shards survive, at
+          which point the stream refuses with
+          :class:`~spark_agd_tpu.resilience.errors.StreamDataLoss`.
+        - ``chaos`` (a ``resilience.chaos.ChaosSchedule``): fault
+          injection for the drill — ``before_shard`` fires inside the
+          retried read, so ``slow_reader``/``hang_reader`` sleeps run
+          under the watchdog and ``corrupt_shard`` garbles the file
+          before the parse that discovers it.
         """
-        from .libsvm import load_libsvm
-        from .ingest import _retrying_loader
+        from . import ingest
+        from .. import native
+        from . import libsvm
 
         paths = list(paths)
         if not paths:
             raise ValueError("from_libsvm_parts needs at least one path")
-        load = _retrying_loader(load_libsvm, retries, telemetry)
+        if validate not in (False, "raise", "drop"):
+            raise ValueError(
+                f"validate must be False, 'raise', or 'drop'; "
+                f"got {validate!r}")
+        if quarantine is True:
+            quarantine = QuarantinePolicy()
+        policy = retries if retries is not None \
+            else ingest.DEFAULT_READ_RETRIES
+        if read_timeout is not None:
+            policy = dataclasses.replace(
+                policy, attempt_timeout=float(read_timeout))
+        quarantined: dict = {}
+        visit = [0]  # cumulative shard-visit index (chaos at_iter axis)
 
-        def part_arrays(path):
-            d = load(path, n_features=n_features)
+        def parse_part(path, visit_index=0, use_chaos=True):
+            """ONE attempt at one shard: chaos hook (inside the retry
+            loop, under the watchdog), parse, native-fallback
+            telemetry, index-range check, validation policy."""
+            if use_chaos and chaos is not None:
+                chaos.before_shard(visit_index, path=path)
+            d = libsvm.load_libsvm(path, n_features=n_features)
+            if telemetry is not None:
+                reason = native.pop_fallback_event("libsvm_parser.so")
+                if reason:
+                    telemetry.recovery(
+                        action="native_fallback", reason=reason,
+                        source="streaming")
             if len(d.indices) and int(d.indices.max()) >= n_features:
                 raise ValueError(
                     f"{path}: feature index {int(d.indices.max())} >= "
                     f"n_features={n_features} — an undersized feature "
                     f"space would silently clamp/drop entries in the "
                     f"compiled gather/scatter")
+            if validate:
+                mask = libsvm.invalid_row_mask(d, n_features)
+                n_bad = int(mask.sum())
+                if n_bad and validate == "raise":
+                    raise libsvm.DataValidationError(
+                        path, libsvm.describe_invalid(d, mask))
+                if n_bad:
+                    logger.warning(
+                        "%s: dropping %d invalid row(s) (non-finite "
+                        "features/labels or out-of-range indices)",
+                        path, n_bad)
+                    if telemetry is not None:
+                        telemetry.registry.counter(
+                            "data.invalid_records").inc(n_bad)
+                    d = libsvm.drop_rows(d, mask)
             y = d.binarized_labels() if binarize_labels else d.labels
             return d.indptr, d.indices, d.values, y.astype(np.float32)
 
+        def load_part(path):
+            """One shard under the full retry/quarantine contract;
+            None = quarantined (skip), any raise = FATAL for the
+            epoch."""
+            vi = visit[0]
+            visit[0] += 1
+            attempts = [1]
+
+            def on_retry(n_failures, exc, delay):
+                attempts[0] = n_failures + 1
+                logger.warning(
+                    "stream shard read failed (%s: %s); retry %d/%d "
+                    "in %.2fs", type(exc).__name__, exc, n_failures,
+                    policy.max_attempts - 1, delay)
+
+            try:
+                return retry_lib.call_with_retry(
+                    parse_part, path, visit_index=vi, policy=policy,
+                    label="stream_shard", telemetry=telemetry,
+                    on_retry=on_retry)
+            except Exception as e:  # noqa: BLE001 — policy applied below
+                if quarantine is None:
+                    raise
+                quarantined[path] = f"{type(e).__name__}: {e}"
+                healthy = len(paths) - len(quarantined)
+                frac = healthy / len(paths)
+                logger.warning(
+                    "quarantining shard %s after %d attempt(s): %s "
+                    "(%d/%d shards healthy)", path, attempts[0],
+                    quarantined[path], healthy, len(paths))
+                if telemetry is not None:
+                    telemetry.shard_quarantine(
+                        shard=path, reason=quarantined[path],
+                        attempts=attempts[0],
+                        shard_index=paths.index(path),
+                        healthy=healthy, total=len(paths),
+                        data_fraction=frac, source="streaming")
+                if frac < quarantine.min_data_fraction:
+                    raise StreamDataLoss(
+                        healthy, len(paths),
+                        quarantine.min_data_fraction) from e
+                return None
+
         first_cache = {}
         if nnz_pad is None:
+            # shape inference runs OUTSIDE the chaos/quarantine path:
+            # construction fails loudly on unreadable data rather than
+            # silently sizing the kernel off a degraded subset
             for path in paths:  # first NON-EMPTY part sizes the shape
-                arrays = part_arrays(path)
+                arrays = retry_lib.call_with_retry(
+                    parse_part, path, use_chaos=False, policy=policy,
+                    label="stream_shard", telemetry=telemetry)
                 m0 = _max_batch_nnz(arrays[0], batch_rows)
                 if m0:
                     first_cache[path] = arrays
@@ -220,13 +507,21 @@ class StreamingDataset:
 
         def factory():
             for path in paths:
+                if path in quarantined:  # sticky: stable batch sequence
+                    continue
                 # the inference parse is reused exactly once (first pass)
-                arrays = first_cache.pop(path, None) or part_arrays(path)
+                arrays = first_cache.pop(path, None)
+                if arrays is None:
+                    arrays = load_part(path)
+                if arrays is None:
+                    continue
                 yield from iter_csr_batches(
                     *arrays[:3], n_features, arrays[3], batch_rows,
                     with_csc=with_csc, nnz_pad=nnz_pad)
 
-        return cls(factory, batch_rows)
+        ds = cls(factory, batch_rows)
+        ds.quarantined = quarantined
+        return ds
 
     def __iter__(self):
         return iter(self._factory())
@@ -300,12 +595,28 @@ def make_streaming_smooth(
     pad_to: Optional[int] = None,
     csr_nnz_per_shard: Optional[int] = None,
     prefetch: int = 0,
+    stream_ckpt=None,
+    telemetry=None,
 ):
     """Build host-level ``(smooth, smooth_loss)`` that stream macro-batches.
 
     ``prefetch`` (default 0 = off): background-thread ingest depth for
     the fold — see :func:`fold_stream`; batch k+1's host read/parse
     overlaps batch k's device compute.
+
+    ``stream_ckpt`` (a :class:`StreamCheckpoint`): mid-epoch
+    checkpointing — each smooth/smooth-loss pass registers with the
+    hook and commits its cursor on the batch cadence, so a preemption
+    mid-pass resumes from the last committed batch (see
+    :func:`fold_stream`).  Host AGD interleaves ``smooth`` and
+    ``smooth_loss`` calls deterministically, so the two share ONE pass
+    counter — replay re-issues the identical pass sequence and the
+    armed cursor lands in the right pass.
+
+    ``telemetry``: one ``stream_epoch`` record per completed pass
+    (batches, rows, wall/stall seconds, quarantine count) and one
+    ``recovery(action="stream_resume")`` when a pass consumed a
+    cursor.
 
     Each batch is (optionally) padded to ``pad_to`` rows so XLA compiles ONE
     kernel shape instead of one per ragged tail, then placed on ``mesh``
@@ -352,19 +663,66 @@ def make_streaming_smooth(
         return ev(w, *dist_smooth.csr_shard_args(X, y, mask))
 
     _place = _make_placer(mesh, pad_to, csr_nnz_per_shard)
+    pass_counter = [0]  # completed passes, for the stream_epoch record
+
+    def _emit_pass(stats):
+        pass_counter[0] += 1
+        if telemetry is None or not stats:
+            return
+        resumed = stats.get("resumed_from_batch")
+        if resumed is not None:
+            telemetry.recovery(
+                action="stream_resume", resumed_from_batch=int(resumed),
+                source="streaming")
+        pass_s = stats.get("pass_s", 0.0)
+        stall_s = stats.get("stall_s", 0.0)
+        extra = {}
+        if resumed is not None:
+            extra["resumed_from_batch"] = int(resumed)
+        telemetry.stream_epoch(
+            epoch=pass_counter[0], batches=int(stats.get("batches", 0)),
+            rows=int(stats.get("rows", 0)), pass_s=float(pass_s),
+            stall_s=float(stall_s),
+            stall_fraction=float(stall_s / pass_s) if pass_s > 0 else 0.0,
+            skipped_batches=int(stats.get("skipped_batches", 0)),
+            quarantined=len(getattr(dataset, "quarantined", None) or {}),
+            prefetch=int(prefetch), source="streaming", **extra)
 
     def smooth(w):
+        treedef = jax.tree_util.tree_structure(w)
+
+        def unflatten(leaves):
+            # [Σloss] + grad leaves; reject a cursor whose leaf count
+            # doesn't match this w's structure (stale rider)
+            if len(leaves) != 1 + treedef.num_leaves:
+                return None
+            return [jnp.asarray(leaves[0]),
+                    jax.tree_util.tree_unflatten(
+                        treedef, [jnp.asarray(x) for x in leaves[1:]])]
+
+        stats: dict = {}
         (ls, gs), n = fold_stream(
             batch_sums,
             lambda a, b: [a[0] + b[0], tvec.add(a[1], b[1])],
-            _place, dataset, w, prefetch=prefetch)
+            _place, dataset, w, prefetch=prefetch,
+            stream_ckpt=stream_ckpt, acc_unflatten=unflatten,
+            stats=stats)
+        _emit_pass(stats)
         nf = jnp.asarray(n, ls.dtype)
         return ls / nf, tvec.scale(1.0 / nf, gs)
 
     def smooth_loss(w):
+        def unflatten(leaves):
+            if len(leaves) != 1:
+                return None
+            return [jnp.asarray(leaves[0])]
+
+        stats: dict = {}
         (ls,), n = fold_stream(
             batch_loss_sums, lambda a, b: [a[0] + b[0]], _place, dataset,
-            w, prefetch=prefetch)
+            w, prefetch=prefetch, stream_ckpt=stream_ckpt,
+            acc_unflatten=unflatten, stats=stats)
+        _emit_pass(stats)
         return ls / jnp.asarray(n, ls.dtype)
 
     return smooth, smooth_loss
@@ -448,7 +806,16 @@ class _Prefetcher:
     consuming thread — JAX dispatch ordering is per-thread, and the
     queue bound caps host memory at ``depth`` raw batches.  The sentinel
     marks exhaustion; a producer exception is re-raised at the consumer's
-    next pull, not swallowed."""
+    next pull, not swallowed.
+
+    Shutdown contract (:meth:`close`): every ``put`` is a bounded-wait
+    loop on a stop event, so a consumer that ABANDONS the stream
+    mid-pass (kernel raised, preemption unwinding) can always stop the
+    pump even when the queue is full — the pump can never deadlock
+    holding a batch, and ``close`` joins the thread (with timeout)
+    instead of leaking it.  ``close`` never raises: it runs in the
+    consumer's ``finally`` and must not mask the original exception —
+    pump-side errors still surface through :meth:`__call__`."""
 
     _END = object()
 
@@ -456,17 +823,39 @@ class _Prefetcher:
         import queue
         import threading
 
+        self._queue_mod = queue
         self._q = queue.Queue(maxsize=depth)
         self._err = None
+        self._stop = threading.Event()
 
         def pump():
             try:
                 for b in it:
-                    self._q.put(b)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(b, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
             except BaseException as e:  # noqa: BLE001 — relayed, below
                 self._err = e
             finally:
-                self._q.put(self._END)
+                # the sentinel must land even when the consumer stopped
+                # reading — but a live consumer may still be draining a
+                # full queue, so eviction (dropping a real batch to make
+                # room) is legal ONLY after the stop flag is set
+                while True:
+                    try:
+                        self._q.put(self._END, timeout=0.05)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            try:
+                                self._q.get_nowait()
+                            except queue.Empty:
+                                pass
 
         self._thread = threading.Thread(
             target=pump, name="fold-stream-prefetch", daemon=True)
@@ -480,8 +869,22 @@ class _Prefetcher:
             return None
         return b
 
+    def close(self, timeout: float = 5.0) -> bool:
+        """Stop the pump and join its thread; True when the thread
+        exited within ``timeout``.  Idempotent, never raises."""
+        self._stop.set()
+        # drain so a pump blocked mid-put sees the stop flag promptly
+        while True:
+            try:
+                self._q.get_nowait()
+            except self._queue_mod.Empty:
+                break
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
 
-def fold_stream(kernel, combine, place, dataset, w, prefetch: int = 0):
+
+def fold_stream(kernel, combine, place, dataset, w, prefetch: int = 0, *,
+                stream_ckpt=None, acc_unflatten=None, stats=None):
     """Stream the dataset through ``kernel(w, X, y, mask) -> (sums…, n)``,
     combining device sums with ``combine`` and counts as host ints
     (immune to integer wrap at 1B rows).
@@ -503,24 +906,81 @@ def fold_stream(kernel, combine, place, dataset, w, prefetch: int = 0):
     to ``prefetch`` RAW batches ready, so iteration k+1's ingest runs
     concurrently with iteration k's compute instead of inside the gap
     between dispatches.  ``0`` (default) is the exact single-threaded
-    loop as before — nothing spawned, bit-identical behavior.
+    loop as before — nothing spawned, bit-identical behavior.  The
+    prefetcher is closed (thread joined) on EVERY exit, including a
+    kernel raise mid-pass — the original exception propagates.
+
+    Mid-epoch resume (``stream_ckpt``, a :class:`StreamCheckpoint`):
+    the fold registers each pass via ``begin_pass`` and commits a
+    :class:`StreamCursor` every ``every_batches`` folded batches.  When
+    a loaded checkpoint armed a cursor for THIS pass, the first
+    ``batch_index`` batches are pulled and DISCARDED (no placement, no
+    kernel) and the accumulator is re-seeded from the cursor's leaves
+    via ``acc_unflatten(leaves) -> acc`` (return None to reject a
+    structurally-incompatible cursor — the pass then replays in full,
+    still bit-identical, just slower).
+
+    ``stats`` (optional dict) is filled in place: ``batches``, ``rows``,
+    ``pass_s``, ``stall_s`` (time blocked waiting on ingest — the
+    prefetch-overlap numerator), ``skipped_batches`` and
+    ``resumed_from_batch`` (cursor consumed this pass).
     """
+    t_pass = time.perf_counter()
+    stall = [0.0]
     it = iter(dataset)
+    pf = None
     if prefetch > 0:
-        pull = _Prefetcher(it, prefetch)
+        pf = _Prefetcher(it, prefetch)
+        raw_pull = pf
     else:
-        def pull():
+        def raw_pull():
             return next(it, None)
-    first = pull()
-    if first is None:
-        raise ValueError("streaming dataset yielded no batches")
-    nxt = place(*first)
+
+    def pull():
+        t0 = time.perf_counter()
+        b = raw_pull()
+        stall[0] += time.perf_counter() - t0
+        return b
+
+    ordinal, resume = (stream_ckpt.begin_pass()
+                       if stream_ckpt is not None else (0, None))
     acc = None
     ns = []
-    while nxt is not None:
-        *sums, n = kernel(w, *nxt)  # async dispatch on batch i
-        ns.append(n)
-        acc = sums if acc is None else combine(acc, sums)
-        b = pull()  # host prep of batch i+1 overlaps device work
-        nxt = None if b is None else place(*b)
-    return acc, sum(int(x) for x in ns)
+    skip = 0
+    if resume is not None and acc_unflatten is not None:
+        seeded = acc_unflatten(resume.acc_leaves)
+        if seeded is not None:
+            acc = seeded
+            ns = [int(resume.n)]
+            skip = int(resume.batch_index)
+    batch_index = skip
+    try:
+        for _ in range(skip):  # already folded into the cursor's carry
+            if pull() is None:
+                break
+        first = pull()
+        if first is None and skip == 0:
+            raise ValueError("streaming dataset yielded no batches")
+        nxt = None if first is None else place(*first)
+        while nxt is not None:
+            *sums, n = kernel(w, *nxt)  # async dispatch on batch i
+            ns.append(n)
+            acc = sums if acc is None else combine(acc, sums)
+            batch_index += 1
+            if stream_ckpt is not None:
+                stream_ckpt.maybe_commit(ordinal, batch_index, acc, ns)
+            b = pull()  # host prep of batch i+1 overlaps device work
+            nxt = None if b is None else place(*b)
+    finally:
+        if pf is not None:
+            pf.close()
+    total = sum(int(x) for x in ns)
+    if stats is not None:
+        stats["batches"] = batch_index
+        stats["rows"] = total
+        stats["pass_s"] = time.perf_counter() - t_pass
+        stats["stall_s"] = stall[0]
+        stats["skipped_batches"] = skip
+        if skip:
+            stats["resumed_from_batch"] = skip
+    return acc, total

@@ -24,6 +24,19 @@ _LOCK = threading.Lock()
 # shared .so load protocol state: so_name -> CDLL | None (None = failed,
 # latched so a missing toolchain is probed once per process)
 _LIBS: dict = {}
+# so_name -> typed reason string, recorded the ONE time a library load
+# fell back to the Python path; consumed (once) by pop_fallback_event so
+# the data layer can emit a single telemetry record instead of spamming
+# one per shard read
+_FALLBACK: dict = {}
+
+
+def pop_fallback_event(so_name: str) -> Optional[str]:
+    """One-shot fallback report: the typed reason the named library is
+    unavailable, returned exactly once per process (None afterwards, and
+    None when the library loaded fine)."""
+    with _LOCK:
+        return _FALLBACK.pop(so_name, None)
 
 
 class _ParseResult(ctypes.Structure):
@@ -63,12 +76,24 @@ def _load_lib(so_name: str, configure) -> Optional[ctypes.CDLL]:
         # edits.  A pre-existing .so still serves if the toolchain is gone.
         if not _build(so_name) and not os.path.exists(so):
             _LIBS[so_name] = None
+            _FALLBACK[so_name] = (
+                f"{so_name}: build failed and no pre-built binary; "
+                f"using the Python fallback")
             return None
         try:
             lib = ctypes.CDLL(so)
             configure(lib)
-        except (OSError, AttributeError):
+        except OSError as e:
             lib = None
+            _FALLBACK[so_name] = (
+                f"{so_name}: dlopen failed ({e}); using the Python "
+                f"fallback")
+        except AttributeError as e:
+            lib = None
+            _FALLBACK[so_name] = (
+                f"{so_name}: ABI mismatch — stale binary missing a "
+                f"symbol ({e}); rebuild with `make -C "
+                f"spark_agd_tpu/native`; using the Python fallback")
         _LIBS[so_name] = lib
         return lib
 

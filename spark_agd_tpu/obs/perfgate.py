@@ -1309,3 +1309,153 @@ def format_fleet_report(result: FleetGateResult) -> str:
            "replica)" if result.ok else
            "FAIL (the slowed replica kept its traffic share)"))
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Streaming gate (data.streaming / tools/stream_drill.py)
+# ---------------------------------------------------------------------------
+
+# a prefetched streamed epoch that spent more than this fraction of its
+# wall time BLOCKED on ingest is not overlapping host reads with device
+# compute — the double-buffering claim fails (overlap floor =
+# 1 - ceiling)
+DEFAULT_STREAM_STALL_CEILING = 0.5
+
+# a pass shorter than this is timer noise — such epochs inform the
+# report but are not graded
+DEFAULT_STREAM_MIN_PASS_S = 0.05
+
+
+@dataclasses.dataclass
+class StreamGateResult:
+    """The streamed-ingest gate's outcome: every gradable
+    ``stream_epoch`` record with ``prefetch > 0`` must keep its stall
+    fraction (time blocked on ingest / pass wall time) at or below the
+    ceiling — i.e. prefetch overlap ``1 - stall_fraction`` at or above
+    the floor.  Typed exit-2 refusals for measurements that cannot be
+    graded honestly: a contention-flagged epoch (the stall timer
+    measured the scheduler, not the pipeline), a prefetched epoch
+    missing its stall fields, or — under ``require_stream`` — no
+    streamed epochs at all."""
+
+    epochs: List[dict]
+    graded: int
+    worst_stall: Optional[float]
+    worst_epoch: Optional[int]
+    quarantined: int
+    refusals: List[str]
+    stall_ceiling: float = DEFAULT_STREAM_STALL_CEILING
+
+    @property
+    def worst_overlap(self) -> Optional[float]:
+        return None if self.worst_stall is None else 1.0 - self.worst_stall
+
+    @property
+    def refused(self) -> bool:
+        return bool(self.refusals)
+
+    @property
+    def ok(self) -> bool:
+        if self.refused:
+            return False
+        if self.worst_stall is None:
+            return True  # nothing prefetched: vacuous pass
+        return self.worst_stall <= self.stall_ceiling
+
+    def exit_code(self) -> int:
+        """0 pass, 1 a prefetched epoch stalled past the ceiling,
+        2 refused (contention-flagged / ungradable)."""
+        if self.refused:
+            return 2
+        return 0 if self.ok else 1
+
+
+def gate_stream(records: List[dict], *,
+                stall_ceiling: float = DEFAULT_STREAM_STALL_CEILING,
+                min_pass_s: float = DEFAULT_STREAM_MIN_PASS_S,
+                require_stream: bool = False) -> StreamGateResult:
+    """Gate streamed-ingest overlap over one run's records: every
+    ``stream_epoch`` with ``prefetch > 0`` and a pass long enough to
+    time honestly (``min_pass_s``) must hold ``stall_fraction <=
+    stall_ceiling``.  Epochs without prefetch inform the report but are
+    not graded (serial ingest stalls by construction).  Without any
+    ``stream_epoch`` records the gate passes vacuously unless
+    ``require_stream`` (then: typed refusal)."""
+    epochs = [r for r in records if isinstance(r, dict)
+              and r.get("kind") == "stream_epoch"]
+    refusals: List[str] = []
+    if not epochs and require_stream:
+        refusals.append("no stream_epoch records in the stream — run "
+                        "the streamed fit with telemetry")
+    worst_stall: Optional[float] = None
+    worst_epoch: Optional[int] = None
+    graded = 0
+    for rec in epochs:
+        if rec.get("contention_flagged") is True:
+            refusals.append(
+                f"epoch {rec.get('epoch')}: contention-flagged "
+                "streamed epoch — its stall timings measured the "
+                "scheduler, not the prefetch pipeline")
+            continue
+        prefetch = rec.get("prefetch")
+        if isinstance(prefetch, bool) or not isinstance(prefetch, int) \
+                or prefetch <= 0:
+            continue
+        stall = rec.get("stall_fraction")
+        pass_s = rec.get("pass_s")
+        if not isinstance(stall, (int, float)) or isinstance(stall, bool):
+            refusals.append(
+                f"epoch {rec.get('epoch')}: prefetched stream_epoch "
+                "carries no stall_fraction — overlap cannot be graded")
+            continue
+        if not isinstance(pass_s, (int, float)) or isinstance(
+                pass_s, bool) or float(pass_s) < min_pass_s:
+            continue  # too short to time honestly; not graded
+        graded += 1
+        if worst_stall is None or float(stall) > worst_stall:
+            worst_stall = float(stall)
+            worst_epoch = rec.get("epoch")
+    if require_stream and epochs and graded == 0 and not refusals:
+        refusals.append(
+            f"no gradable prefetched epoch (need prefetch > 0 and "
+            f"pass_s >= {min_pass_s:g}) — nothing to hold to the "
+            "overlap floor")
+    quarantined = max((int(r.get("quarantined") or 0) for r in epochs),
+                      default=0)
+    return StreamGateResult(
+        epochs=epochs, graded=graded, worst_stall=worst_stall,
+        worst_epoch=worst_epoch, quarantined=quarantined,
+        refusals=refusals, stall_ceiling=stall_ceiling)
+
+
+def format_stream_report(result: StreamGateResult) -> str:
+    """Human-readable stream-gate report (``tools/perf_gate.py
+    --stream``)."""
+    lines: List[str] = []
+    if result.refusals:
+        lines.append("STREAM GATE REFUSED:")
+        lines.extend("  " + r for r in result.refusals)
+        return "\n".join(lines)
+    if not result.epochs:
+        return ("STREAM GATE: pass (no stream_epoch records — nothing "
+                "to gate)")
+    if result.worst_stall is None:
+        lines.append(
+            f"{len(result.epochs)} streamed epoch(s), none prefetched "
+            "— overlap not graded")
+    else:
+        lines.append(
+            f"{len(result.epochs)} streamed epoch(s), {result.graded} "
+            f"graded; worst stall fraction {_fmt(result.worst_stall)} "
+            f"(epoch {result.worst_epoch}, overlap "
+            f"{_fmt(result.worst_overlap)}, ceiling "
+            f"{result.stall_ceiling:g})")
+    if result.quarantined:
+        lines.append(f"  {result.quarantined} shard(s) quarantined "
+                     "during the run")
+    lines.append(
+        "STREAM GATE: "
+        + ("pass (prefetch overlap held the floor)" if result.ok else
+           "FAIL (a prefetched epoch stalled on ingest past the "
+           "ceiling)"))
+    return "\n".join(lines)

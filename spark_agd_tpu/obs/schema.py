@@ -40,7 +40,8 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
          "chaos", "journal_replay", "degraded", "contract_pin",
          "serve_request", "serve_latency", "trace_summary",
          "scaling_curve", "skew_estimate", "rebalance",
-         "canary", "promotion", "fleet_route", "replica_verdict")
+         "canary", "promotion", "fleet_route", "replica_verdict",
+         "shard_quarantine", "stream_epoch")
 
 # the recovery actions the resilience layer emits; validation accepts
 # any string (producers may grow new actions), this tuple documents the
@@ -54,13 +55,19 @@ KINDS = ("run", "iteration", "span", "metrics", "program_cost",
 # ``replica_evict``/``request_hedge``/``request_retry`` are the fleet
 # router's actions (serve.router): a LOST replica removed from the
 # candidate set, a tail request re-issued to a second replica, and an
-# in-flight request transparently re-served on a survivor.
+# in-flight request transparently re-served on a survivor;
+# ``native_fallback`` is the one-time typed record of the data plane
+# dropping to the Python parser because the native .so is missing or
+# ABI-mismatched (native/__init__.py); ``stream_resume`` records a
+# streamed pass resuming mid-epoch from a persisted StreamCursor
+# (data.streaming.StreamCheckpoint).
 RECOVERY_ACTIONS = ("retry", "rollback", "preemption_flush",
                     "checkpoint", "checkpoint_fallback", "resume",
                     "host_lost", "elastic_resume", "degraded_continue",
                     "hot_swap", "flight_dump", "rebalance",
                     "speculative_exec", "rollback_generation",
-                    "replica_evict", "request_hedge", "request_retry")
+                    "replica_evict", "request_hedge", "request_retry",
+                    "native_fallback", "stream_resume")
 
 _NUM = (int, float)
 _OPT_NUM = _NUM + (type(None),)
@@ -147,6 +154,18 @@ _REQUIRED: Dict[str, dict] = {
     # HostMonitor.verdicts()): ``verdict`` is "ok" | "slow" | "lost"
     "replica_verdict": {"run_id": str, "replica": int,
                         "verdict": str},
+    # one poisoned-shard quarantine decision (data.streaming.
+    # StreamingDataset): ``shard`` names the part that failed parse/
+    # validation/CRC after its retries; the streamed epoch continues
+    # degraded on the survivors — the data-plane analogue of
+    # resilience.degrade
+    "shard_quarantine": {"run_id": str, "shard": str},
+    # one completed streamed pass over a StreamingDataset
+    # (data.streaming.make_streaming_smooth): ``epoch`` is the pass
+    # ordinal, ``batches`` how many macro-batches the fold consumed;
+    # stall/overlap evidence rides as optionals — the record family
+    # obs.perfgate.gate_stream bounds prefetch stall fraction on
+    "stream_epoch": {"run_id": str, "epoch": int, "batches": int},
 }
 
 # JSON value types the contract-pin observed/expected fields may carry
@@ -368,6 +387,28 @@ _OPTIONAL: Dict[str, dict] = {
         "age_s": _OPT_NUM, "phase": (str, type(None)),
         "previous": (str, type(None)), "generation": int,
         "source": str, "tool": str, "timestamp_unix": _NUM,
+    },
+    "shard_quarantine": {
+        # why the shard was expelled, how many read attempts it got,
+        # and the surviving data fraction the policy judged
+        "reason": str, "attempts": int, "shard_index": int,
+        "rows_lost": (int, type(None)), "healthy": int, "total": int,
+        "data_fraction": _NUM, "epoch": int,
+        "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
+    },
+    "stream_epoch": {
+        # pass accounting: rows folded, wall time of the pass, and the
+        # consumer-side prefetch stall it spent waiting on the reader
+        "rows": int, "pass_s": _NUM, "stall_s": _NUM,
+        "stall_fraction": _NUM,
+        # resume evidence: the batch index a StreamCursor restarted the
+        # pass from (None/absent on an uninterrupted pass)
+        "resumed_from_batch": (int, type(None)), "skipped_batches": int,
+        "quarantined": int, "prefetch": int,
+        "contention_flagged": bool,
+        "source": str, "algorithm": str, "tool": str,
+        "timestamp_unix": _NUM,
     },
 }
 
@@ -657,6 +698,29 @@ def replica_verdict_record(run_id: str, replica: int, verdict: str,
             "verdict": str(verdict), **fields}
 
 
+def shard_quarantine_record(run_id: str, shard: str, **fields) -> dict:
+    """One poisoned-shard quarantine decision (``data.streaming``):
+    ``shard`` names the part expelled after its read retries;
+    ``reason``/``attempts`` explain it, ``healthy``/``total``/
+    ``data_fraction`` carry the degraded-continuation evidence the
+    minimum-data-fraction policy judged."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "shard_quarantine",
+            "run_id": run_id, "shard": str(shard), **fields}
+
+
+def stream_epoch_record(run_id: str, epoch: int, batches: int,
+                        **fields) -> dict:
+    """One completed streamed pass over a ``StreamingDataset``
+    (``data.streaming.make_streaming_smooth``): ``epoch`` is the pass
+    ordinal, ``batches`` the macro-batches folded; ``stall_s``/
+    ``pass_s``/``stall_fraction`` carry the prefetch-overlap evidence
+    ``obs.perfgate.gate_stream`` bounds, ``resumed_from_batch`` the
+    StreamCursor resume point when the pass restarted mid-epoch."""
+    return {"schema_version": SCHEMA_VERSION, "kind": "stream_epoch",
+            "run_id": run_id, "epoch": int(epoch),
+            "batches": int(batches), **fields}
+
+
 def read_jsonl(path: str) -> List[dict]:
     """Parse one record per non-blank line; raises ``ValueError`` naming
     the line on malformed JSON (consumers wanting tolerance — the report
@@ -898,6 +962,25 @@ EXAMPLE_REPLICA_VERDICT_RECORD = {
     "source": "serve.router", "tool": "serve.router",
 }
 
+EXAMPLE_SHARD_QUARANTINE_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "shard_quarantine",
+    "run_id": "r18c2d3e4-1a2b-0", "shard": "parts/part-00003.txt",
+    "shard_index": 3, "reason": "ValueError: malformed LIBSVM line",
+    "attempts": 3, "rows_lost": None, "healthy": 7, "total": 8,
+    "data_fraction": 0.875, "epoch": 2, "source": "streaming",
+    "tool": "stream_drill",
+}
+
+EXAMPLE_STREAM_EPOCH_RECORD = {
+    "schema_version": SCHEMA_VERSION, "kind": "stream_epoch",
+    "run_id": "r18c2d3e4-1a2b-0", "epoch": 5, "batches": 12,
+    "rows": 1536, "pass_s": 0.412, "stall_s": 0.031,
+    "stall_fraction": 0.0752, "resumed_from_batch": 7,
+    "skipped_batches": 7, "quarantined": 1, "prefetch": 2,
+    "contention_flagged": False, "source": "streaming",
+    "tool": "stream_drill",
+}
+
 # the kind-keyed table selfcheck iterates — graftlint's schema-drift
 # rule cross-checks that EVERY registered kind appears here (and has a
 # Telemetry helper), so a new kind cannot land without selfcheck
@@ -926,6 +1009,8 @@ EXAMPLES: Dict[str, dict] = {
     "promotion": EXAMPLE_PROMOTION_RECORD,
     "fleet_route": EXAMPLE_FLEET_ROUTE_RECORD,
     "replica_verdict": EXAMPLE_REPLICA_VERDICT_RECORD,
+    "shard_quarantine": EXAMPLE_SHARD_QUARANTINE_RECORD,
+    "stream_epoch": EXAMPLE_STREAM_EPOCH_RECORD,
 }
 
 

@@ -488,6 +488,37 @@ class Telemetry:
         self.bus.emit(rec)
         return rec
 
+    def shard_quarantine(self, *, shard: str, **fields) -> dict:
+        """Emit (and return) a ``shard_quarantine`` record — one
+        poisoned-shard quarantine decision of the streaming data plane
+        (``data.streaming``) — and count it (``stream.quarantined``),
+        so a degraded epoch is visible in every run summary."""
+        self.registry.counter("stream.quarantined").inc()
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
+        rec = schema.shard_quarantine_record(self.run_id, shard,
+                                             **fields)
+        self.bus.emit(rec)
+        return rec
+
+    def stream_epoch(self, *, epoch: int, batches: int,
+                     **fields) -> dict:
+        """Emit (and return) a ``stream_epoch`` record — one completed
+        streamed pass (``data.streaming.make_streaming_smooth``) —
+        counting passes and batches (``stream.epochs`` /
+        ``stream.batches``) and mirroring the prefetch stall fraction
+        into the ``stream.stall_fraction`` gauge so overlap health
+        rides the metrics snapshot."""
+        self.registry.counter("stream.epochs").inc()
+        self.registry.counter("stream.batches").inc(int(batches))
+        sf = fields.get("stall_fraction")
+        if isinstance(sf, (int, float)) and not isinstance(sf, bool):
+            self.registry.gauge("stream.stall_fraction").set(sf)
+        fields.setdefault("timestamp_unix", round(_time.time(), 3))
+        rec = schema.stream_epoch_record(self.run_id, epoch, batches,
+                                         **fields)
+        self.bus.emit(rec)
+        return rec
+
     def run_summary(self, *, tool: str, **fields) -> dict:
         """Emit (and return) the end-of-run ``run`` record, with the
         registry snapshot attached under ``metrics``."""

@@ -96,14 +96,7 @@ class ContinuousTrainer:
                 self.gradient, X, y, mask)
             if self._build is None:
                 self._build = build
-            checkpointer = None
-            if self.checkpoint_path is not None:
-                checkpointer = AutoCheckpointer(
-                    f"{self.checkpoint_path}.e{epoch:03d}.npz",
-                    every_iters=(self.checkpoint_every
-                                 or self.config.num_iterations),
-                    keep=self.checkpoint_keep,
-                    telemetry=self.telemetry)
+            checkpointer = self._checkpointer(epoch)
             result = run_agd_supervised(
                 prox=self.prox, reg_value=self.reg_value,
                 w0=self.weights, config=self.config,
@@ -112,22 +105,86 @@ class ContinuousTrainer:
                 staged=(self._build, dargs),
                 seg_cache=self._seg_cache,
                 stream_iterations=False)
-            self.weights = result.weights
-            self.total_iters += result.num_iters
-            final_loss = (float(result.loss_history[-1])
-                          if len(result.loss_history) else float("nan"))
-            publish_w = result.weights
-            if self.weight_fault is not None:
-                publish_w = self.weight_fault(epoch, publish_w)
-            generation = self.registry.publish(
-                self.make_model(publish_w),
-                converged=result.converged,
-                prior_iters=self.total_iters)
-            if span is not None:
-                span.note(generation=generation, final_loss=final_loss,
-                          iters=result.num_iters,
-                          retries=result.retries,
-                          rollbacks=result.rollbacks)
-            return EpochResult(epoch=epoch, generation=generation,
-                               final_loss=final_loss,
-                               weights=result.weights, result=result)
+            return self._publish(epoch, span, result)
+
+    def run_epoch_streamed(self, dataset, *, prefetch: int = 0,
+                           stream_every_batches: Optional[int] = None,
+                           mesh=None, pad_to: Optional[int] = None,
+                           on_commit: Optional[Callable] = None
+                           ) -> EpochResult:
+        """Run one warm-started epoch over a ``data.streaming.
+        StreamingDataset`` — the larger-than-HBM twin of
+        :meth:`run_epoch`: the smooth streams macro-batches
+        (``make_streaming_smooth``) and the supervisor drives the HOST
+        AGD loop (``driver="host"`` — a streamed smooth cannot trace
+        into jit).  The full failure taxonomy applies unchanged, plus
+        the data-plane hardening the dataset was built with (retries,
+        shard quarantine, read timeouts).
+
+        ``stream_every_batches`` (with ``checkpoint_path`` set) arms
+        MID-EPOCH checkpointing: a ``StreamCheckpoint`` commits the
+        fold's cursor every N batches, so a preemption mid-pass resumes
+        from the last committed batch instead of the epoch boundary —
+        bit-identical to the uninterrupted epoch.  ``on_commit(count)``
+        is the drill's kill hook.  ``prefetch`` is the background
+        ingest depth of :func:`~spark_agd_tpu.data.streaming.
+        fold_stream`."""
+        from ..data import streaming
+
+        self.epoch += 1
+        epoch = self.epoch
+        span = (self.telemetry.trace_span(
+            "pipeline_epoch", epoch=epoch, tool="pipeline",
+            streamed=True) if self.telemetry is not None else None)
+        with span if span is not None else contextlib.nullcontext():
+            checkpointer = self._checkpointer(epoch)
+            stream_ckpt = None
+            if checkpointer is not None and stream_every_batches:
+                stream_ckpt = streaming.StreamCheckpoint(
+                    checkpointer,
+                    every_batches=int(stream_every_batches),
+                    on_commit=on_commit)
+            sm, sl = streaming.make_streaming_smooth(
+                self.gradient, dataset, mesh=mesh, pad_to=pad_to,
+                prefetch=prefetch, stream_ckpt=stream_ckpt,
+                telemetry=self.telemetry)
+            result = run_agd_supervised(
+                smooth=sm, smooth_loss=sl, prox=self.prox,
+                reg_value=self.reg_value, w0=self.weights,
+                config=self.config, policy=self.policy,
+                telemetry=self.telemetry, checkpointer=checkpointer,
+                driver="host", stream_iterations=False)
+            return self._publish(epoch, span, result)
+
+    def _checkpointer(self, epoch: int) -> Optional[AutoCheckpointer]:
+        if self.checkpoint_path is None:
+            return None
+        return AutoCheckpointer(
+            f"{self.checkpoint_path}.e{epoch:03d}.npz",
+            every_iters=(self.checkpoint_every
+                         or self.config.num_iterations),
+            keep=self.checkpoint_keep,
+            telemetry=self.telemetry)
+
+    def _publish(self, epoch: int, span, result) -> EpochResult:
+        """The shared epoch tail: warm-start carry, candidate publish
+        through the manifest commit protocol, span annotation."""
+        self.weights = result.weights
+        self.total_iters += result.num_iters
+        final_loss = (float(result.loss_history[-1])
+                      if len(result.loss_history) else float("nan"))
+        publish_w = result.weights
+        if self.weight_fault is not None:
+            publish_w = self.weight_fault(epoch, publish_w)
+        generation = self.registry.publish(
+            self.make_model(publish_w),
+            converged=result.converged,
+            prior_iters=self.total_iters)
+        if span is not None:
+            span.note(generation=generation, final_loss=final_loss,
+                      iters=result.num_iters,
+                      retries=result.retries,
+                      rollbacks=result.rollbacks)
+        return EpochResult(epoch=epoch, generation=generation,
+                           final_loss=final_loss,
+                           weights=result.weights, result=result)

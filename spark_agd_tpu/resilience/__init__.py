@@ -72,6 +72,7 @@ from .errors import (  # noqa: F401
     QuorumLost,
     ServeOverloaded,
     SimulatedDeviceLoss,
+    StreamDataLoss,
     SupervisorGivingUp,
     classify_failure,
 )

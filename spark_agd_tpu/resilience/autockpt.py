@@ -84,6 +84,13 @@ class AutoCheckpointer:
         self._prev_handlers = None
         self.saves = 0
         self.preempted = False
+        # mid-epoch rider state (data.streaming.StreamCheckpoint): the
+        # namespaced ``stream_*`` cursor entries the NEXT save carries,
+        # the extras that rode the checkpoint :meth:`load` returned,
+        # and the hook told about boundary commits / loaded extras
+        self._extra = None
+        self.loaded_extras = {}
+        self.stream_hook = None
 
     # -- cadence ----------------------------------------------------------
     def _due(self, prior_iters: int) -> bool:
@@ -103,9 +110,28 @@ class AutoCheckpointer:
         cadence is due (or ``force``).  Returns True when a file was
         written."""
         self._latest = (warm, hist, bool(converged), bool(aborted))
+        # a boundary commit supersedes any mid-epoch cursor: the carry
+        # is exact here, so the next save must NOT claim a partial pass
+        self._extra = None
+        if self.stream_hook is not None:
+            self.stream_hook.on_boundary()
         if not (force or self._due(int(warm.prior_iters))):
             return False
         self._save(*self._latest)
+        return True
+
+    def update_stream(self, extra: dict) -> bool:
+        """Mid-epoch commit: force-write the last boundary carry PLUS
+        the namespaced rider entries (the streaming layer's
+        ``stream_*`` cursor) — a preemption after this save resumes
+        from the boundary warm state and replays forward to the cursor
+        instead of restarting the epoch.  No-op (False) before the
+        first boundary state is seen: a cursor without a carry to
+        anchor it would be meaningless."""
+        if self._latest is None:
+            return False
+        self._extra = dict(extra)
+        self._save(*self._latest, action="checkpoint")
         return True
 
     def flush(self, *, reason: str = "flush") -> bool:
@@ -122,7 +148,7 @@ class AutoCheckpointer:
             self.path, warm,
             None if hist is None else np.asarray(hist),
             converged=converged, aborted=aborted,
-            fingerprint=self.fingerprint)
+            fingerprint=self.fingerprint, extra=self._extra)
         self._last_saved_iters = int(warm.prior_iters)
         self._last_saved_t = self._clock()
         self.saves += 1
@@ -177,6 +203,10 @@ class AutoCheckpointer:
                 # immediately re-save what we just read
                 self._last_saved_iters = int(loaded.warm.prior_iters)
                 self._last_saved_t = self._clock()
+                self.loaded_extras = dict(
+                    getattr(loaded, "extras", None) or {})
+                if self.stream_hook is not None and self.loaded_extras:
+                    self.stream_hook.adopt(self.loaded_extras)
                 return loaded
         if found_any:
             ckpt.logger.warning(

@@ -49,11 +49,24 @@ Fault kinds (:data:`FAULT_KINDS`):
                    ``before_request``, one-shot or persistent)
 ``kill_replica``   self-deliver SIGKILL at a request boundary (a dead
                    serving replica; the fleet drill's eviction leg)
+``slow_reader``    sleep ``payload`` seconds before a shard read (a
+                   degraded data source; fired by ``before_shard``)
+``corrupt_shard``  overwrite the shard FILE's leading bytes with
+                   garbage before the read — the poisoned-shard leg
+                   the quarantine path must absorb typed
+``hang_reader``    sleep ``payload`` seconds before a shard read, with
+                   the payload sized ABOVE the reader's watchdog so
+                   the attempt times out (``AttemptTimeout`` →
+                   TRANSIENT → retry finds the fault popped)
 
 The replica kinds drive the SERVE fleet (``serve.fleet`` replicas call
 ``before_request(request_index)`` per admitted request) with the same
 deterministic seeded interface the training drills use; ``at_iter``
-for them means the request index, not the optimizer iteration.
+for them means the request index, not the optimizer iteration.  The
+reader kinds drive the STREAMING data plane the same way
+(``data.streaming.from_libsvm_parts`` calls ``before_shard(visit,
+path=...)`` inside each retried shard load; ``at_iter`` = the
+cumulative shard visit index across passes).
 
 Everything is deterministic: iterations, targets, payloads, and the
 corruption bytes all derive from the campaign seed.
@@ -81,7 +94,11 @@ FILE_KINDS = ("truncate_ckpt", "scramble_ckpt")
 # AFTER the existing kinds so FAULT_KINDS.index-based sort keys (and
 # every seeded campaign that derives from them) are unchanged
 REPLICA_KINDS = ("slow_replica", "kill_replica")
-FAULT_KINDS = IN_RUN_KINDS + FILE_KINDS + REPLICA_KINDS
+# reader-scoped streaming faults, fired per shard visit via
+# ChaosSchedule.before_shard (``at_iter`` = shard visit index); same
+# append-only contract — AFTER every existing kind
+READER_KINDS = ("slow_reader", "corrupt_shard", "hang_reader")
+FAULT_KINDS = IN_RUN_KINDS + FILE_KINDS + REPLICA_KINDS + READER_KINDS
 
 # the kinds persist=True is meaningful for: a degraded host/replica
 # that stays degraded (kills and poisons are one-shot by nature)
@@ -179,9 +196,14 @@ class ChaosSchedule:
         self._replica_pending = [f for f in ordered
                                  if f.kind in REPLICA_KINDS
                                  and not f.persist]
+        # reader-scoped faults fire at SHARD visits (before_shard),
+        # never at segment boundaries
+        self._reader_pending = [f for f in ordered
+                                if f.kind in READER_KINDS]
         self._pending = [f for f in ordered
                          if f.kind != "nan" and not f.persist
-                         and f.kind not in REPLICA_KINDS]
+                         and f.kind not in REPLICA_KINDS
+                         and f.kind not in READER_KINDS]
         self._telemetry = telemetry
         self._seed = seed
         self._sleep = sleep
@@ -302,6 +324,39 @@ class ChaosSchedule:
                     self._telemetry.flush()  # the kill must be on record
                 os.kill(os.getpid(), signal_lib.SIGKILL)
 
+    def before_shard(self, visit_index: int,
+                     path: Optional[str] = None) -> None:
+        """The streaming data plane's mirror of :meth:`before_request`:
+        the shard loader calls this once per shard visit, INSIDE the
+        retried attempt, so a fault that raises (or corrupts) is
+        absorbed by the same retry/quarantine machinery a real flaky
+        source would exercise.  ``visit_index`` counts shard visits
+        cumulatively across passes; ``path`` is the shard file a
+        ``corrupt_shard`` fault overwrites (the fault still fires — on
+        record — when the caller cannot name a file).
+
+        ``slow_reader`` and ``hang_reader`` both just sleep their
+        payload: the difference is the contract with the caller's
+        watchdog — a slow reader's payload is sized BELOW the attempt
+        timeout (degraded throughput, same result), a hung reader's
+        ABOVE it (the watchdog fires ``AttemptTimeout``, the retry
+        comes back, and the popped fault lets the attempt succeed)."""
+        while self._reader_pending \
+                and self._reader_pending[0].at_iter <= visit_index:
+            f = self._reader_pending.pop(0)
+            self._emit(f, visit_index)
+            if f.kind in ("slow_reader", "hang_reader"):
+                self._slow_sleep(float(f.payload) or 0.25, visit_index)
+                continue
+            # corrupt_shard: stomp the file's leading bytes with text no
+            # LIBSVM parser (native or Python) can read — the epoch must
+            # quarantine the shard typed, not crash or silently skip
+            if path is not None:
+                size = os.path.getsize(path)
+                garbage = b"\x00<chaos:corrupt_shard>\x00 not : libsvm\n"
+                with open(path, "r+b") as fh:
+                    fh.write(garbage[:max(1, size)])
+
     def take_poison(self, global_iter: int) -> bool:
         if self._poison and self._poison[0].at_iter <= global_iter:
             f = self._poison.pop(0)
@@ -316,7 +371,8 @@ class ChaosSchedule:
         re-fire at every boundary by design, so counting them would
         make a degraded-host campaign read as eternally unfinished."""
         return (not self._pending and not self._poison
-                and not self._replica_pending)
+                and not self._replica_pending
+                and not self._reader_pending)
 
 
 @dataclasses.dataclass(frozen=True)

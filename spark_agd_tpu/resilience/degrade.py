@@ -319,9 +319,9 @@ def _load_surviving(directory, m, template, survivors, rank, n_surv,
         m.generation, m.process_count, n_surv, decision.reason,
         len(dropped))
     loaded = LoadedDistCheckpoint(
-        *lc, generation=m.generation,
+        *lc[:5], generation=m.generation,
         saved_process_count=m.process_count, elastic=True,
-        partitions=partitions, row_state=row_state)
+        partitions=partitions, row_state=row_state, extras=lc.extras)
     return DegradedResume(loaded, decision, dropped)
 
 

@@ -126,6 +126,9 @@ class LoadedDistCheckpoint(NamedTuple):
     elastic: bool                       # topology changed on resume
     partitions: Optional[Tuple[str, ...]]  # THIS host's re-split files
     row_state: Dict[str, np.ndarray]    # THIS host's re-split rows
+    # namespaced rider entries (``stream_*`` mid-epoch cursor) — same
+    # contract as utils.checkpoint.LoadedCheckpoint.extras
+    extras: Dict[str, np.ndarray] = {}
 
 
 def _check_embedded_generation(path: str, entries: Dict[str, np.ndarray],
@@ -231,11 +234,11 @@ def _load_generation(directory, m, template, process_index,
         lc = ckpt.checkpoint_from_entries(
             path, ckpt._Entries(path, entries), template, fingerprint)
         return LoadedDistCheckpoint(
-            *lc, generation=m.generation,
+            *lc[:5], generation=m.generation,
             saved_process_count=m.process_count, elastic=False,
             partitions=(tuple(p) if (p := _shard_partitions(entries))
                         is not None else None),
-            row_state=_shard_row_state(entries))
+            row_state=_shard_row_state(entries), extras=lc.extras)
 
     # changed topology: gather every host's shard, re-split
     per_host = []
@@ -281,9 +284,9 @@ def _load_generation(directory, m, template, process_index,
         m.generation, m.process_count, process_index, process_count,
         int(lc.warm.prior_iters))
     return LoadedDistCheckpoint(
-        *lc, generation=m.generation,
+        *lc[:5], generation=m.generation,
         saved_process_count=m.process_count, elastic=True,
-        partitions=partitions, row_state=row_state)
+        partitions=partitions, row_state=row_state, extras=lc.extras)
 
 
 class DistributedCheckpointer(AutoCheckpointer):
@@ -350,7 +353,7 @@ class DistributedCheckpointer(AutoCheckpointer):
         payload = ckpt.warm_payload(
             warm, None if hist is None else np.asarray(hist),
             converged=converged, aborted=aborted,
-            fingerprint=self.fingerprint)
+            fingerprint=self.fingerprint, extra=self._extra)
         payload["generation"] = np.asarray(gen)
         payload["process_index"] = np.asarray(self.process_index)
         payload["process_count"] = np.asarray(self.process_count)
@@ -431,6 +434,9 @@ class DistributedCheckpointer(AutoCheckpointer):
             self._next_generation = loaded.generation + 1
             self._last_saved_iters = int(loaded.warm.prior_iters)
             self._last_saved_t = self._clock()
+            self.loaded_extras = dict(loaded.extras or {})
+            if self.stream_hook is not None and self.loaded_extras:
+                self.stream_hook.adopt(self.loaded_extras)
             if loaded.elastic and loaded.partitions is not None \
                     and self.partitions is None:
                 # adopt the re-split assignment so the NEXT generation

@@ -83,6 +83,27 @@ class QuorumLost(RuntimeError):
             "restart elastically on restored capacity")
 
 
+class StreamDataLoss(RuntimeError):
+    """Too many shards of a streamed dataset are quarantined for the
+    epoch to be statistically honest (``data.streaming.
+    QuarantinePolicy`` refused): the surviving data fraction is below
+    the policy's floor.  The data-plane sibling of :class:`QuorumLost`
+    and classified FATAL for the same reason — retrying cannot
+    un-poison the shards, and silently fitting on a sliver of the data
+    would be worse than stopping."""
+
+    def __init__(self, healthy: int, total: int, min_fraction: float):
+        frac = healthy / total if total else 0.0
+        super().__init__(
+            f"stream data loss: {healthy}/{total} shards healthy "
+            f"({frac:.3f} < minimum data fraction {min_fraction:g}); "
+            "refusing to continue the degraded epoch — restore or "
+            "replace the quarantined shards")
+        self.healthy = int(healthy)
+        self.total = int(total)
+        self.min_fraction = float(min_fraction)
+
+
 class ServeOverloaded(RuntimeError):
     """The serving plane's typed backpressure rejection
     (``serve.queue.MicroBatchQueue``): the micro-batching queue is at
@@ -173,9 +194,10 @@ def classify_failure(exc: BaseException) -> str:
     if isinstance(exc, (NumericsFailureError, FloatingPointError,
                         ZeroDivisionError)):
         return NUMERIC
-    if isinstance(exc, QuorumLost):
-        # unlike HostLost: retrying cannot bring a QUORUM back — must
-        # be checked before the transient isinstance row (RuntimeError)
+    if isinstance(exc, (QuorumLost, StreamDataLoss)):
+        # unlike HostLost: retrying cannot bring a QUORUM (or the
+        # quarantined shards) back — must be checked before the
+        # transient isinstance row (RuntimeError)
         return FATAL
     if isinstance(exc, (SimulatedDeviceLoss, HostLost, ServeOverloaded,
                         TimeoutError, OSError, ConnectionError,

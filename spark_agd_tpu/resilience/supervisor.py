@@ -126,6 +126,7 @@ def run_agd_supervised(
     telemetry=None,
     checkpointer=None,
     staged=None,
+    driver: str = "fused",
     smooth_loss: Optional[Callable] = None,
     faults: Optional["faults_lib.FaultScript"] = None,
     place_w: Optional[Callable] = None,
@@ -146,6 +147,16 @@ def run_agd_supervised(
     remain supported for small problems.  ``place_w`` (optional) maps
     the initial weights onto devices (mesh replication) before the
     first segment.
+
+    ``driver="host"`` runs each segment through ``core.host_agd.
+    run_agd_host`` instead of the fused ``lax.while_loop`` — REQUIRED
+    when ``smooth`` is itself a host-level loop (``data.streaming.
+    make_streaming_smooth``): a streamed smooth cannot trace into jit.
+    The whole supervision envelope — retries, rollbacks,
+    checkpointing, chaos poison, watchdog — is unchanged; only the
+    segment executor differs.  ``staged`` is fused-only (the host
+    driver never embeds data in a program) and per-iteration telemetry
+    streaming does not apply.
 
     ``checkpointer`` (an :class:`~spark_agd_tpu.resilience.autockpt.
     AutoCheckpointer`): resume happens from its surviving generation
@@ -199,6 +210,16 @@ def run_agd_supervised(
         raise ValueError("w0 and config are required")
     if staged is None and smooth is None:
         raise ValueError("pass smooth=... or staged=(build, data_args)")
+    if driver not in ("fused", "host"):
+        raise ValueError(
+            f"driver must be 'fused' or 'host'; got {driver!r}")
+    if driver == "host":
+        if staged is not None:
+            raise ValueError(
+                "staged=(build, data_args) applies to the fused driver "
+                "only; the host driver never embeds data in a program")
+        if smooth is None:
+            raise ValueError("driver='host' needs smooth=...")
     if scheduler is not None and getattr(scheduler, "rebuild", None) \
             is not None and staged is None:
         raise ValueError(
@@ -219,6 +240,16 @@ def run_agd_supervised(
     def run_segment(warm: AGDWarmState, k: int, poisoned: bool):
         cfg_k = dataclasses.replace(config, num_iterations=k)
         key = (k, poisoned)
+        if driver == "host":
+            # host-orchestrated segment: a Python loop calling the
+            # (possibly streamed) smooth per iteration — nothing to
+            # jit or cache, and poison wraps the callable directly
+            from ..core import host_agd
+
+            sm = faults_lib.poison_smooth(smooth) if poisoned else smooth
+            return host_agd.run_agd_host(
+                sm, prox, reg_value, warm.x, cfg_k,
+                smooth_loss=smooth_loss, warm=warm)
         if staged is not None:
             build, dargs = staged
             if getattr(build, "make_agd_run", None) is not None:

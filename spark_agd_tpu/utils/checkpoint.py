@@ -130,6 +130,13 @@ class _Entries:
         except KeyError as e:
             raise CheckpointCorruptError(self._path, e) from e
 
+    def prefixed(self, prefix: str) -> Dict[str, np.ndarray]:
+        """Every entry under a namespace prefix — how rider payloads
+        (the ``stream_*`` mid-epoch cursor) come back out of a file
+        whose core keys predate them."""
+        return {k: v for k, v in self._entries.items()
+                if k.startswith(prefix)}
+
 
 def _load_tree(data, treedef, n: int, name: str):
     """Rebuild one pytree from ``{name}_{i}`` npz entries — the ONE copy
@@ -156,11 +163,19 @@ def problem_fingerprint(w0: Any, config: AGDConfig) -> str:
 
 def warm_payload(warm: AGDWarmState, loss_history=None, *,
                  converged: bool = False, aborted: bool = False,
-                 fingerprint: Optional[str] = None) -> dict:
+                 fingerprint: Optional[str] = None,
+                 extra: Optional[dict] = None) -> dict:
     """The npz payload of one ``AGDWarmState`` checkpoint — the ONE
     encoding :func:`save_checkpoint` and the multi-host shard writer
     (``resilience.distributed``) share, so a distributed shard is a
-    superset of a single-host checkpoint and the loaders never fork."""
+    superset of a single-host checkpoint and the loaders never fork.
+
+    ``extra`` (optional): namespaced rider entries (the streaming
+    layer's ``stream_*`` mid-epoch cursor) saved alongside the core
+    keys; loaders that predate a rider ignore it (the entry set is
+    open), and :func:`checkpoint_from_entries` hands riders back via
+    ``LoadedCheckpoint.extras``.  Keys must not collide with the core
+    payload."""
     payload = {}
     for name, tree in (("x", warm.x), ("z", warm.z)):
         for i, leaf in enumerate(_flat(tree)):
@@ -175,20 +190,30 @@ def warm_payload(warm: AGDWarmState, loss_history=None, *,
         payload["fingerprint"] = np.asarray(fingerprint)
     payload["loss_history"] = (np.zeros(0) if loss_history is None
                                else np.asarray(loss_history))
+    if extra:
+        for k, v in extra.items():
+            if k in payload:
+                raise ValueError(
+                    f"extra checkpoint entry {k!r} collides with a "
+                    "core payload key; namespace rider entries "
+                    "(e.g. 'stream_*')")
+            payload[k] = np.asarray(v)
     return payload
 
 
 def save_checkpoint(path: str, warm: AGDWarmState, loss_history=None,
                     *, converged: bool = False, aborted: bool = False,
-                    fingerprint: Optional[str] = None) -> None:
+                    fingerprint: Optional[str] = None,
+                    extra: Optional[dict] = None) -> None:
     """Atomically write the continuation carry (+ cumulative loss history).
 
     ``converged``/``aborted`` mark a *terminal* checkpoint: the run stopped
     by its own criteria, and resuming must be a no-op rather than extra
-    iterations (or, for abort, a resume from non-finite weights)."""
+    iterations (or, for abort, a resume from non-finite weights).
+    ``extra``: namespaced rider entries — see :func:`warm_payload`."""
     atomic_savez(path, warm_payload(
         warm, loss_history, converged=converged, aborted=aborted,
-        fingerprint=fingerprint))
+        fingerprint=fingerprint, extra=extra))
 
 
 def atomic_savez(path: str, payload: dict):
@@ -221,6 +246,9 @@ class LoadedCheckpoint(NamedTuple):
     converged: bool
     aborted: bool
     fingerprint: Optional[str]
+    # namespaced rider entries (``stream_*`` mid-epoch cursor) that rode
+    # the file; empty for checkpoints written without extras
+    extras: Dict[str, np.ndarray] = {}
 
 
 def checkpoint_from_entries(path: str, data: "_Entries", template: Any,
@@ -259,7 +287,10 @@ def checkpoint_from_entries(path: str, data: "_Entries", template: Any,
     hist = np.asarray(data["loss_history"])
     converged = bool(data["converged"]) if "converged" in data else False
     aborted = bool(data["aborted"]) if "aborted" in data else False
-    return LoadedCheckpoint(warm, hist, converged, aborted, fp)
+    extras = (data.prefixed("stream_") if hasattr(data, "prefixed")
+              else {})
+    return LoadedCheckpoint(warm, hist, converged, aborted, fp,
+                            extras=extras)
 
 
 def load_checkpoint(path: str, template: Any,

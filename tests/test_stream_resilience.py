@@ -1,0 +1,854 @@
+"""Streamed-ingest resilience suite: mid-epoch cursor resume,
+poisoned-shard quarantine, reader chaos, prefetcher shutdown, the
+native-parser fallback event, the stream perf gate, and the drill.
+
+Covers the fault-hardened streaming data plane (``data/streaming.py``)
+end to end on CPU:
+
+- ``_Prefetcher`` shutdown: no leaked pump thread, no deadlock on a
+  full queue, producer exceptions relayed not masked;
+- ``StreamCursor``/``StreamCheckpoint``: npz-exact round-trip, commit
+  cadence, boundary invalidation, and the tier-1 PIN — a mid-epoch
+  kill resumed through the cursor is BIT-IDENTICAL (f64, conftest's
+  x64 default) to the uninterrupted fit;
+- quarantine: a poisoned shard is typed out (``shard_quarantine``),
+  the epoch continues degraded, and the ``min_data_fraction`` floor
+  refuses with ``StreamDataLoss``;
+- reader chaos (``slow_reader``/``hang_reader``/``corrupt_shard``)
+  driving the retry watchdog and quarantine machinery;
+- ``from_libsvm_parts`` error legs: torn files, empty shards, invalid
+  rows under ``validate="drop"`` vs ``"raise"``;
+- ``native`` fallback: one-shot typed event, ABI-mismatch latch, and
+  a Makefile smoke build (skipped without a toolchain);
+- ``perfgate.gate_stream`` + the ``--stream`` CLI and the
+  ``agd_report --streaming`` rollup;
+- a reduced ``tools/stream_drill.py`` smoke (the full drill is the CI
+  acceptance; the longer soak is additionally marked slow).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_agd_tpu.core import agd, smooth as smooth_lib
+from spark_agd_tpu.data import libsvm, streaming
+from spark_agd_tpu.obs import JSONLSink, Telemetry, perfgate, schema
+from spark_agd_tpu.ops.losses import LogisticGradient
+from spark_agd_tpu.ops.prox import L2Prox
+from spark_agd_tpu.resilience import (AutoCheckpointer, ResiliencePolicy,
+                                      StreamDataLoss, run_agd_supervised)
+from spark_agd_tpu.resilience.chaos import (FAULT_KINDS, READER_KINDS,
+                                            ChaosSchedule, ScheduledFault)
+from spark_agd_tpu.resilience.retry import RetryPolicy
+
+pytestmark = pytest.mark.stream
+
+D = 6
+
+
+def _write_parts(tmp_path, n_shards=4, rows=24, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = np.linspace(-1.0, 1.0, D)
+    paths = []
+    for k in range(n_shards):
+        X = rng.standard_normal((rows, D)).astype(np.float32)
+        y = np.where(X @ w_true > 0, 1.0, -1.0)
+        p = str(tmp_path / f"part-{k}.libsvm")
+        libsvm.save_libsvm(p, X, y)
+        paths.append(p)
+    return paths
+
+
+def _fast_retries(**over):
+    kw = dict(max_attempts=3, backoff_base=0.01, backoff_max=0.02,
+              jitter=0.0, seed=0)
+    kw.update(over)
+    return RetryPolicy(**kw)
+
+
+def _rows_of(ds):
+    """Total rows and a content digest across one full pass."""
+    n, tot = 0, 0.0
+    for X, y, mask in ds:
+        m = np.asarray(mask)
+        n += int(m.sum())
+        tot += float((np.asarray(y) * m).sum())
+    return n, tot
+
+
+# ---------------------------------------------------------------------------
+# satellite: prefetcher shutdown
+
+
+class TestPrefetcherShutdown:
+    def _alive_pumps(self):
+        return [t for t in threading.enumerate()
+                if t.name == "fold-stream-prefetch" and t.is_alive()]
+
+    def test_close_joins_abandoned_pump_on_full_queue(self):
+        """A consumer that stops pulling mid-stream must still be able
+        to stop a pump blocked on a FULL queue — no deadlock, no
+        leaked thread."""
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        pf = streaming._Prefetcher(endless(), depth=2)
+        assert pf() == 0  # pump is alive and producing
+        assert pf.close() is True
+        assert pf.close() is True  # idempotent
+        assert not self._alive_pumps()
+
+    def test_sentinel_lands_with_live_consumer(self):
+        """Normal exhaustion with a full queue: the sentinel must wait
+        for the consumer, never evict a real batch (the bug this
+        regression pins: eviction is legal only after close)."""
+        pf = streaming._Prefetcher(iter(range(5)), depth=1)
+        got = []
+        while (b := pf()) is not None:
+            got.append(b)
+            time.sleep(0.01)  # let the pump refill / hit queue.Full
+        assert got == [0, 1, 2, 3, 4]
+        assert pf.close() is True
+
+    def test_producer_exception_relayed_not_swallowed(self):
+        def bad():
+            yield 1
+            raise RuntimeError("disk on fire")
+
+        pf = streaming._Prefetcher(bad(), depth=2)
+        assert pf() == 1
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            while pf() is not None:
+                pass
+        assert pf.close() is True
+
+    def test_fold_stream_closes_pump_when_kernel_raises(self):
+        ds = streaming.StreamingDataset.from_arrays(
+            np.ones((32, D), np.float32), np.ones(32, np.float32),
+            batch_rows=8, mask=np.ones(32, np.float32))
+        calls = [0]
+
+        def kernel(w, X, y, mask):
+            calls[0] += 1
+            if calls[0] == 2:
+                raise ValueError("kernel blew up")
+            return jnp.zeros(()), jnp.asarray(mask).sum()
+
+        with pytest.raises(ValueError, match="kernel blew up"):
+            streaming.fold_stream(
+                kernel, lambda a, b: a, lambda *b: b, ds,
+                jnp.zeros(D), prefetch=2)
+        deadline = time.monotonic() + 5.0
+        while self._alive_pumps() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not self._alive_pumps()
+
+
+# ---------------------------------------------------------------------------
+# cursor + commit protocol
+
+
+class _FakeCheckpointer:
+    def __init__(self, accept=True):
+        self.accept = accept
+        self.saved = []
+        self.stream_hook = None
+        self.loaded_extras = {}
+
+    def update_stream(self, extra):
+        if not self.accept:
+            return False
+        self.saved.append(dict(extra))
+        return True
+
+
+class TestStreamCursor:
+    def _cursor(self):
+        return streaming.StreamCursor(
+            2, 5, 40, (np.float64(1.25) * np.arange(3),
+                       np.asarray(7.5, np.float64)))
+
+    def test_roundtrip_exact(self):
+        cur = self._cursor()
+        back = streaming.cursor_from_extras(
+            streaming.cursor_to_extra(cur))
+        assert (back.pass_offset, back.batch_index, back.n) == (2, 5, 40)
+        assert len(back.acc_leaves) == 2
+        for a, b in zip(cur.acc_leaves, back.acc_leaves):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            np.testing.assert_array_equal(a, b)
+
+    def test_absent_or_torn_extras_return_none(self):
+        assert streaming.cursor_from_extras(None) is None
+        assert streaming.cursor_from_extras({}) is None
+        torn = streaming.cursor_to_extra(self._cursor())
+        del torn["stream_acc_1"]  # torn mid-write
+        assert streaming.cursor_from_extras(torn) is None
+
+    def test_commit_cadence_and_consume_once(self):
+        ck = _FakeCheckpointer()
+        sc = streaming.StreamCheckpoint(ck, every_batches=2)
+        ordinal, cur = sc.begin_pass()
+        assert (ordinal, cur) == (0, None)
+        assert not sc.maybe_commit(ordinal, 1, [np.ones(2)], [8])
+        assert sc.maybe_commit(ordinal, 2, [np.ones(2)], [8, 8])
+        assert sc.commits == 1
+        # arm a cursor for pass 1 and consume it exactly once
+        sc.adopt(streaming.cursor_to_extra(
+            streaming.StreamCursor(1, 2, 16, (np.ones(2),))))
+        ordinal, cur = sc.begin_pass()
+        assert ordinal == 1 and cur is not None
+        assert cur.batch_index == 2
+        assert sc.begin_pass()[1] is None
+
+    def test_boundary_invalidates_stale_cursor(self):
+        ck = _FakeCheckpointer()
+        sc = streaming.StreamCheckpoint(ck, every_batches=2)
+        sc.adopt(streaming.cursor_to_extra(
+            streaming.StreamCursor(0, 2, 16, (np.ones(2),))))
+        # the supervisor seeds its checkpointer BEFORE any pass: the
+        # pending cursor must survive that boundary...
+        sc.on_boundary()
+        assert sc._pending is not None
+        sc.begin_pass()
+        # ...but not a boundary after real passes ran
+        sc.on_boundary()
+        assert sc._pending is None
+
+    def test_no_boundary_carry_no_commit(self):
+        fired = []
+        sc = streaming.StreamCheckpoint(
+            _FakeCheckpointer(accept=False), every_batches=1,
+            on_commit=fired.append)
+        ordinal, _ = sc.begin_pass()
+        assert not sc.maybe_commit(ordinal, 1, [np.ones(2)], [8])
+        assert sc.commits == 0 and fired == []
+
+    def test_every_batches_validated(self):
+        with pytest.raises(ValueError, match="every_batches"):
+            streaming.StreamCheckpoint(_FakeCheckpointer(),
+                                       every_batches=0)
+
+    def test_constructor_adopts_preloaded_extras(self):
+        ck = _FakeCheckpointer()
+        ck.loaded_extras = streaming.cursor_to_extra(
+            streaming.StreamCursor(0, 4, 32, (np.ones(2),)))
+        sc = streaming.StreamCheckpoint(ck, every_batches=2)
+        assert sc.begin_pass()[1].batch_index == 4
+
+
+# ---------------------------------------------------------------------------
+# tentpole pin: bit-identical mid-epoch resume (f64 via conftest x64)
+
+
+class TestMidEpochResume:
+    def _fit(self, paths, tmp_path, *, ckpt=None, on_commit=None,
+             telemetry=None, iters=6):
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), quarantine=True,
+            telemetry=telemetry)
+        stream_ckpt = None
+        if ckpt is not None:
+            stream_ckpt = streaming.StreamCheckpoint(
+                ckpt, every_batches=2, on_commit=on_commit)
+        sm, sl = streaming.make_streaming_smooth(
+            LogisticGradient(), ds, stream_ckpt=stream_ckpt,
+            telemetry=telemetry)
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        return run_agd_supervised(
+            smooth=sm, smooth_loss=sl, prox=px, reg_value=rv,
+            w0=jnp.zeros(D), config=agd.AGDConfig(
+                convergence_tol=0.0, num_iterations=iters),
+            policy=ResiliencePolicy(max_attempts=2, backoff_base=0.01,
+                                    backoff_max=0.02, jitter=0.0,
+                                    seed=0, segment_iters=2),
+            telemetry=telemetry, checkpointer=ckpt, driver="host",
+            stream_iterations=False)
+
+    def test_kill_mid_pass_resumes_bit_identical(self, tmp_path):
+        """THE pin: SIGKILL-equivalent abort inside a cursor commit,
+        relaunch, and the resumed fit must equal the uninterrupted
+        one to the BIT at f64 — weights AND loss history."""
+        paths = _write_parts(tmp_path, n_shards=4, rows=24)
+        base = self._fit(paths, tmp_path)
+
+        ckpt_path = str(tmp_path / "ck.npz")
+        jsonl = str(tmp_path / "resume.jsonl")
+        tel = Telemetry([JSONLSink(jsonl)])
+
+        class Killed(BaseException):
+            """Not an Exception: nothing may catch/retry it."""
+
+        ck = AutoCheckpointer(ckpt_path, every_iters=2, keep=3,
+                              telemetry=tel)
+
+        # 8 batches/pass, every_batches=2 -> 4 commits/pass; 2 passes/
+        # iter, segment=2 -> segment 1 ends after ~16 commits.  Killing
+        # at #18 lands mid-pass in segment 2, past a real boundary.  A
+        # SIGKILLed process never reaches the supervisor's terminal
+        # flush (which would supersede the cursor with a clean-abandon
+        # save), so the simulated kill must suppress it too.
+        def kill(count):
+            if count >= 18:
+                ck.update = lambda *a, **kw: False
+                raise Killed
+        with pytest.raises(Killed):
+            self._fit(paths, tmp_path, ckpt=ck, on_commit=kill,
+                      telemetry=tel)
+
+        ck2 = AutoCheckpointer(ckpt_path, every_iters=2, keep=3,
+                               telemetry=tel)
+        res = self._fit(paths, tmp_path, ckpt=ck2, telemetry=tel)
+        tel.flush()
+
+        assert res.resumed_from > 0
+        assert np.array_equal(np.asarray(res.weights),
+                              np.asarray(base.weights))
+        assert list(map(float, res.loss_history)) == \
+            list(map(float, base.loss_history))
+        # the cursor was CONSUMED, not merely stored: the resumed run
+        # must report a non-zero skip point
+        recs = schema.read_jsonl(jsonl)
+        resumes = [r for r in recs if r.get("kind") == "recovery"
+                   and r.get("action") == "stream_resume"]
+        assert any(int(r.get("resumed_from_batch") or 0) > 0
+                   for r in resumes)
+        epochs = [r for r in recs if r.get("kind") == "stream_epoch"]
+        assert any(r.get("resumed_from_batch") for r in epochs)
+        assert all(not schema.validate_record(
+            json.loads(json.dumps(r, default=str))) for r in recs)
+
+    def test_incompatible_cursor_rejected_replays_full_pass(self):
+        """A structurally-foreign cursor (different leaf count) must be
+        rejected by the unflattener — full replay, same answer."""
+        ds = streaming.StreamingDataset.from_arrays(
+            np.ones((16, D), np.float32), np.ones(16, np.float32),
+            batch_rows=8, mask=np.ones(16, np.float32))
+        sc = streaming.StreamCheckpoint(_FakeCheckpointer(),
+                                        every_batches=100)
+        sc.adopt(streaming.cursor_to_extra(streaming.StreamCursor(
+            0, 1, 8, (np.ones(1), np.ones(1), np.ones(1)))))
+        stats = {}
+        acc, n = streaming.fold_stream(
+            lambda w, X, y, m: (jnp.asarray(m).sum(),
+                                jnp.asarray(m).sum()),
+            lambda a, b: [a[0] + b[0]], lambda *b: b, ds,
+            jnp.zeros(D), stream_ckpt=sc,
+            acc_unflatten=lambda leaves: None,  # reject
+            stats=stats)
+        assert n == 16 and stats["batches"] == 2
+        assert stats["skipped_batches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# quarantine
+
+
+class TestQuarantine:
+    def test_poisoned_shard_typed_and_sticky(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=4)
+        with open(paths[1], "wb") as f:
+            f.write(b"\x00 not libsvm at all\n")
+        jsonl = str(tmp_path / "q.jsonl")
+        tel = Telemetry([JSONLSink(jsonl)])
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), quarantine=True, telemetry=tel)
+        n1, digest1 = _rows_of(ds)
+        assert n1 == 3 * 24
+        assert list(ds.quarantined) == [paths[1]]
+        # sticky: the second pass yields the identical sequence and
+        # does NOT re-attempt (or re-record) the poisoned shard
+        n2, digest2 = _rows_of(ds)
+        assert (n2, digest2) == (n1, digest1)
+        tel.flush()
+        recs = schema.read_jsonl(jsonl)
+        quar = [r for r in recs if r.get("kind") == "shard_quarantine"]
+        assert len(quar) == 1
+        assert quar[0]["shard"] == paths[1]
+        assert quar[0]["data_fraction"] == 0.75
+        assert not schema.validate_record(
+            json.loads(json.dumps(quar[0], default=str)))
+
+    def test_min_data_fraction_refuses_typed(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=2)
+        with open(paths[0], "wb") as f:
+            f.write(b"garbage garbage\n")
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(),
+            quarantine=streaming.QuarantinePolicy(
+                min_data_fraction=0.9))
+        with pytest.raises(StreamDataLoss):
+            list(ds)
+
+    def test_without_quarantine_the_epoch_fails_loudly(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=2)
+        with open(paths[1], "wb") as f:
+            f.write(b"garbage garbage\n")
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries())
+        with pytest.raises(ValueError):
+            list(ds)
+
+    def test_policy_bounds_validated(self):
+        with pytest.raises(ValueError, match="min_data_fraction"):
+            streaming.QuarantinePolicy(min_data_fraction=1.5)
+
+
+# ---------------------------------------------------------------------------
+# reader chaos
+
+
+class TestReaderChaos:
+    def test_reader_kinds_registered(self):
+        assert set(READER_KINDS) == {"slow_reader", "corrupt_shard",
+                                     "hang_reader"}
+        assert set(READER_KINDS) <= set(FAULT_KINDS)
+
+    def test_slow_reader_same_bits_and_exhausts(self, tmp_path):
+        paths = _write_parts(tmp_path)
+        clean = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128)
+        chaos = ChaosSchedule([ScheduledFault(
+            kind="slow_reader", at_iter=0, payload=0.05)])
+        slow = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), chaos=chaos)
+        assert _rows_of(slow) == _rows_of(clean)
+        assert ("slow_reader", 0) in chaos.fired
+        assert chaos.exhausted
+
+    def test_hang_reader_trips_watchdog_then_retry_succeeds(
+            self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=2)
+        jsonl = str(tmp_path / "hang.jsonl")
+        tel = Telemetry([JSONLSink(jsonl)])
+        chaos = ChaosSchedule([ScheduledFault(
+            kind="hang_reader", at_iter=1, payload=0.6)],
+            telemetry=tel)
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), read_timeout=0.2,
+            telemetry=tel, chaos=chaos)
+        n, _ = _rows_of(ds)
+        assert n == 2 * 24  # nothing lost: the retry re-read the shard
+        assert ds.quarantined == {}
+        tel.flush()
+        retries = [r for r in schema.read_jsonl(jsonl)
+                   if r.get("kind") == "recovery"
+                   and r.get("action") == "retry"
+                   and r.get("source") == "stream_shard"]
+        assert retries and "AttemptTimeout" in retries[0]["reason"]
+
+    def test_corrupt_shard_fault_lands_in_quarantine(self, tmp_path):
+        paths = _write_parts(tmp_path)
+        chaos = ChaosSchedule([ScheduledFault(
+            kind="corrupt_shard", at_iter=2)])
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), quarantine=True, chaos=chaos)
+        n, _ = _rows_of(ds)
+        assert n == 3 * 24
+        assert list(ds.quarantined) == [paths[2]]
+        # the fault garbled the FILE, not just the in-memory read
+        with open(paths[2], "rb") as f:
+            assert b"chaos:corrupt_shard" in f.read(64)
+
+
+# ---------------------------------------------------------------------------
+# satellite: from_libsvm_parts error legs
+
+
+class TestFromLibsvmPartsErrorLegs:
+    def test_torn_file_mid_stream_raises(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=2)
+        # a write torn mid-row: trailing "index:" with no value
+        with open(paths[1], "w") as f:
+            f.write("1 0:1.5 2:-0.5\n-1 1:2.0 3:")
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries())
+        with pytest.raises(ValueError):
+            list(ds)
+
+    def test_torn_file_quarantined_when_policy_allows(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=3)
+        with open(paths[0], "w") as f:
+            f.write("1 0:1.5 2:")
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), quarantine=True)
+        n, _ = _rows_of(ds)
+        assert n == 2 * 24 and list(ds.quarantined) == [paths[0]]
+
+    def test_empty_shard_contributes_nothing_not_quarantined(
+            self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=2)
+        empty = str(tmp_path / "part-empty.libsvm")
+        open(empty, "w").close()
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            [paths[0], empty, paths[1]], n_features=D, batch_rows=12,
+            nnz_pad=128, quarantine=True)
+        n, _ = _rows_of(ds)
+        assert n == 2 * 24
+        assert ds.quarantined == {}  # empty is valid, not poisoned
+
+    def test_all_empty_parts_fail_shape_inference(self, tmp_path):
+        empties = []
+        for k in range(2):
+            p = str(tmp_path / f"e{k}.libsvm")
+            open(p, "w").close()
+            empties.append(p)
+        with pytest.raises(ValueError, match="all parts are empty"):
+            streaming.StreamingDataset.from_libsvm_parts(
+                empties, n_features=D, batch_rows=12)
+
+    def _with_bad_rows(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=2)
+        with open(paths[1], "a") as f:
+            # non-finite feature value (LIBSVM text indices are 1-based)
+            f.write("1 2:nan 4:2.0\n")
+        return paths
+
+    @contextlib.contextmanager
+    def _python_parser(self):
+        """Force the Python LIBSVM parser, so the drop leg covers the
+        fallback parser + validation combination (the raise leg runs
+        on the default native path — BOTH parsers happily read ``nan``
+        tokens; validation is the only guard)."""
+        from spark_agd_tpu import native
+
+        with native._LOCK:
+            saved = native._LIBS.get("libsvm_parser.so")
+            native._LIBS["libsvm_parser.so"] = None
+        try:
+            yield
+        finally:
+            with native._LOCK:
+                if saved is not None:
+                    native._LIBS["libsvm_parser.so"] = saved
+                else:
+                    native._LIBS.pop("libsvm_parser.so", None)
+
+    def test_invalid_rows_raise(self, tmp_path):
+        paths = self._with_bad_rows(tmp_path)
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), validate="raise")
+        with pytest.raises(libsvm.DataValidationError,
+                           match="non-finite"):
+            list(ds)
+
+    def test_invalid_rows_dropped_and_counted(self, tmp_path):
+        paths = self._with_bad_rows(tmp_path)
+        tel = Telemetry()
+        with self._python_parser():
+            ds = streaming.StreamingDataset.from_libsvm_parts(
+                paths, n_features=D, batch_rows=12, nnz_pad=128,
+                retries=_fast_retries(), validate="drop",
+                telemetry=tel)
+            n, _ = _rows_of(ds)
+        assert n == 2 * 24  # the appended bad row is gone
+        assert tel.registry.counter("data.invalid_records").value == 1
+
+    def test_validate_value_checked(self, tmp_path):
+        paths = _write_parts(tmp_path, n_shards=1)
+        with pytest.raises(ValueError, match="validate"):
+            streaming.StreamingDataset.from_libsvm_parts(
+                paths, n_features=D, batch_rows=12, validate="maybe")
+
+
+# ---------------------------------------------------------------------------
+# satellite: native fallback + Makefile smoke
+
+
+class TestNativeFallback:
+    def test_pop_fallback_event_is_one_shot(self):
+        from spark_agd_tpu import native
+
+        with native._LOCK:
+            native._FALLBACK["phantom.so"] = "phantom reason"
+        assert native.pop_fallback_event("phantom.so") == \
+            "phantom reason"
+        assert native.pop_fallback_event("phantom.so") is None
+
+    def test_abi_mismatch_latched_typed(self):
+        from spark_agd_tpu import native
+
+        with native._LOCK:
+            saved_lib = native._LIBS.pop("libsvm_parser.so", None)
+            saved_ev = native._FALLBACK.pop("libsvm_parser.so", None)
+        try:
+            def bad_configure(lib):
+                raise AttributeError("parse_libsvm_v9 not found")
+
+            assert native._load_lib("libsvm_parser.so",
+                                    bad_configure) is None
+            reason = native.pop_fallback_event("libsvm_parser.so")
+            assert reason and "ABI mismatch" in reason
+            assert "make -C spark_agd_tpu/native" in reason
+            # latched: the next load does not re-probe
+            assert native._load_lib("libsvm_parser.so",
+                                    bad_configure) is None
+        finally:
+            with native._LOCK:
+                native._LIBS.pop("libsvm_parser.so", None)
+                native._FALLBACK.pop("libsvm_parser.so", None)
+                if saved_lib is not None:
+                    native._LIBS["libsvm_parser.so"] = saved_lib
+                if saved_ev is not None:
+                    native._FALLBACK["libsvm_parser.so"] = saved_ev
+
+    def test_streaming_emits_one_fallback_record(self, tmp_path):
+        from spark_agd_tpu import native
+
+        paths = _write_parts(tmp_path, n_shards=2)
+        with native._LOCK:
+            saved = native._LIBS.get("libsvm_parser.so")
+            native._LIBS["libsvm_parser.so"] = None  # toolchain "gone"
+            native._FALLBACK["libsvm_parser.so"] = (
+                "libsvm_parser.so: build failed and no pre-built "
+                "binary; using the Python fallback")
+        jsonl = str(tmp_path / "fb.jsonl")
+        tel = Telemetry([JSONLSink(jsonl)])
+        try:
+            ds = streaming.StreamingDataset.from_libsvm_parts(
+                paths, n_features=D, batch_rows=12, nnz_pad=128,
+                telemetry=tel)
+            n, _ = _rows_of(ds)
+            assert n == 2 * 24  # Python fallback: same rows
+            list(ds)  # second pass: no second event
+        finally:
+            with native._LOCK:
+                native._FALLBACK.pop("libsvm_parser.so", None)
+                if saved is not None:
+                    native._LIBS["libsvm_parser.so"] = saved
+                else:
+                    native._LIBS.pop("libsvm_parser.so", None)
+        tel.flush()
+        evts = [r for r in schema.read_jsonl(jsonl)
+                if r.get("kind") == "recovery"
+                and r.get("action") == "native_fallback"]
+        assert len(evts) == 1
+        assert "Python fallback" in evts[0]["reason"]
+
+    def test_makefile_smoke_build(self, tmp_path):
+        cxx = os.environ.get("CXX", "g++")
+        if shutil.which(cxx) is None or shutil.which("make") is None:
+            pytest.skip(f"no toolchain ({cxx}/make) on this host")
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "spark_agd_tpu", "native")
+        for name in ("Makefile", "libsvm_parser.cpp",
+                     "shard_balance.cpp"):
+            shutil.copy(os.path.join(src, name), tmp_path)
+        proc = subprocess.run(["make", "-s", "all"], cwd=tmp_path,
+                              capture_output=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr.decode()
+        assert (tmp_path / "libsvm_parser.so").exists()
+        assert (tmp_path / "shard_balance.so").exists()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the stream perf gate + report rollup
+
+
+def _epoch(**over):
+    rec = {"kind": "stream_epoch", "run_id": "r1", "epoch": 1,
+           "batches": 8, "rows": 96, "pass_s": 1.0, "stall_s": 0.1,
+           "stall_fraction": 0.1, "prefetch": 2, "quarantined": 0,
+           "source": "streaming"}
+    rec.update(over)
+    return rec
+
+
+@pytest.mark.perfgate
+class TestGateStream:
+    def test_pass_under_ceiling(self):
+        g = perfgate.gate_stream([_epoch()], require_stream=True)
+        assert g.ok and not g.refused and g.exit_code() == 0
+        assert g.worst_overlap == pytest.approx(0.9)
+
+    def test_fail_over_ceiling(self):
+        g = perfgate.gate_stream(
+            [_epoch(), _epoch(epoch=2, stall_fraction=0.8,
+                              stall_s=0.8)])
+        assert not g.ok and g.exit_code() == 1
+        assert g.worst_epoch == 2
+
+    def test_contention_flagged_refused(self):
+        g = perfgate.gate_stream([_epoch(contention_flagged=True)])
+        assert g.refused and g.exit_code() == 2
+
+    def test_no_epochs_refused_only_when_required(self):
+        assert perfgate.gate_stream([]).exit_code() == 0  # vacuous
+        assert perfgate.gate_stream(
+            [], require_stream=True).exit_code() == 2
+
+    def test_prefetched_epoch_missing_stall_refused(self):
+        g = perfgate.gate_stream([_epoch(stall_fraction=None)])
+        assert g.refused
+
+    def test_short_pass_not_graded(self):
+        g = perfgate.gate_stream([_epoch(pass_s=0.001)])
+        assert g.graded == 0 and g.exit_code() == 0
+
+    def test_unprefetched_epoch_not_graded(self):
+        g = perfgate.gate_stream([_epoch(prefetch=0)])
+        assert g.graded == 0
+
+    def test_quarantine_surfaced_in_report(self):
+        g = perfgate.gate_stream([_epoch(quarantined=2)])
+        assert g.quarantined == 2
+        assert "quarantined" in perfgate.format_stream_report(g)
+
+    def test_cli_stream_exit_codes(self, tmp_path):
+        from tools import perf_gate as cli
+
+        def run(recs, *extra):
+            path = tmp_path / "s.jsonl"
+            with open(path, "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+            return cli.main([str(path), "--stream", *extra])
+
+        assert run([_epoch()]) == 0
+        assert run([_epoch(stall_fraction=0.8)]) == 1
+        assert run([_epoch(stall_fraction=0.8)],
+                   "--stall-ceiling", "0.9") == 0
+        assert run([_epoch(contention_flagged=True)]) == 2
+        assert run([]) == 2  # --stream requires stream evidence
+
+
+class TestStreamingReport:
+    def test_streaming_rollup_renders(self, tmp_path, capsys):
+        from tools import agd_report
+
+        path = str(tmp_path / "r.jsonl")
+        with open(path, "w") as f:
+            for rec in (
+                _epoch(),
+                _epoch(epoch=2, resumed_from_batch=4,
+                       skipped_batches=4, quarantined=1),
+                {"kind": "shard_quarantine", "run_id": "r1",
+                 "shard": "/data/part-3", "reason": "ValueError: bad",
+                 "attempts": 3, "data_fraction": 0.75,
+                 "source": "streaming"},
+                {"kind": "recovery", "run_id": "r1",
+                 "action": "stream_resume", "resumed_from_batch": 4,
+                 "source": "streaming"},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        assert agd_report.main(["--streaming", path]) == 0
+        out = capsys.readouterr().out
+        assert "== streaming ==" in out or "== streaming" in out
+        assert "/data/part-3" in out
+        assert "e2@b4" in out  # the resume point
+
+    def test_streaming_filter_empty_exits_1(self, tmp_path):
+        from tools import agd_report
+
+        path = str(tmp_path / "none.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "span", "run_id": "x",
+                                "name": "s", "seconds": 0.1}) + "\n")
+        assert agd_report.main(["--streaming", path]) == 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor/trainer wiring
+
+
+class TestHostDriverWiring:
+    def test_driver_validated(self):
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        with pytest.raises(ValueError, match="driver"):
+            run_agd_supervised(
+                smooth=lambda w: (jnp.zeros(()), w), prox=px,
+                reg_value=rv, w0=jnp.zeros(D),
+                config=agd.AGDConfig(num_iterations=2),
+                driver="fpga")
+
+    def test_host_driver_rejects_staged(self):
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        with pytest.raises(ValueError, match="staged"):
+            run_agd_supervised(
+                smooth=lambda w: (jnp.zeros(()), w), prox=px,
+                reg_value=rv, w0=jnp.zeros(D),
+                config=agd.AGDConfig(num_iterations=2),
+                staged=(None, None), driver="host")
+
+    def test_trainer_streamed_epoch_publishes(self, tmp_path):
+        from spark_agd_tpu.models.glm import LogisticRegressionModel
+        from spark_agd_tpu.pipeline.trainer import ContinuousTrainer
+        from spark_agd_tpu.serve.registry import ModelRegistry
+
+        parts = tmp_path / "parts"
+        parts.mkdir()
+        paths = _write_parts(parts)
+        ds = streaming.StreamingDataset.from_libsvm_parts(
+            paths, n_features=D, batch_rows=12, nnz_pad=128,
+            retries=_fast_retries(), quarantine=True)
+        px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        trainer = ContinuousTrainer(
+            reg, LogisticGradient(), prox=px, reg_value=rv,
+            w0=np.zeros(D), config=agd.AGDConfig(
+                num_iterations=4, convergence_tol=0.0),
+            make_model=lambda w: LogisticRegressionModel(
+                np.asarray(w, np.float32), 0.0),
+            checkpoint_path=str(tmp_path / "ck.npz"),
+            checkpoint_every=2)
+        r1 = trainer.run_epoch_streamed(ds, stream_every_batches=4)
+        r2 = trainer.run_epoch_streamed(ds, stream_every_batches=4)
+        assert (r1.generation, r2.generation) == (1, 2)
+        assert r2.epoch == 2
+        assert np.isfinite(r2.final_loss)
+        assert not np.allclose(np.asarray(r1.weights),
+                               np.asarray(r2.weights))
+
+
+# ---------------------------------------------------------------------------
+# the drill (reduced smoke tier-1; fuller soak marked slow)
+
+
+class TestStreamDrillTool:
+    def test_reduced_smoke(self, tmp_path):
+        from tools import stream_drill
+
+        rc = stream_drill.main(["--out", str(tmp_path), "--iters", "4"])
+        assert rc == 0
+        recs = []
+        for phase in ("parent", "baseline", "faulted", "resume"):
+            recs.extend(schema.read_jsonl(
+                str(tmp_path / f"drill-{phase}.jsonl")))
+        assert any(r.get("kind") == "shard_quarantine" for r in recs)
+        assert any(r.get("kind") == "recovery"
+                   and r.get("action") == "stream_resume"
+                   for r in recs)
+
+    @pytest.mark.slow
+    def test_full_soak(self, tmp_path):
+        from tools import stream_drill
+
+        rc = stream_drill.main(["--out", str(tmp_path),
+                                "--iters", "10", "--segment", "2",
+                                "--kill-at-commit", "26"])
+        assert rc == 0

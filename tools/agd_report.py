@@ -68,7 +68,8 @@ def _kind(rec: dict) -> Optional[str]:
              "recovery", "numerics_failure", "contract_pin",
              "serve_request", "serve_latency", "trace_summary",
              "scaling_curve", "skew_estimate", "rebalance",
-             "canary", "promotion", "fleet_route", "replica_verdict"):
+             "canary", "promotion", "fleet_route", "replica_verdict",
+             "stream_epoch", "shard_quarantine"):
         return k
     # legacy pre-schema rows
     if "iter" in rec and "loss" in rec:
@@ -554,6 +555,75 @@ def summarize_pipeline(canaries: List[dict], promotions: List[dict],
     return "\n".join(lines)
 
 
+def summarize_streaming(epochs: List[dict], quarantines: List[dict],
+                        recoveries: List[dict]) -> str:
+    """The streamed-ingest rollup (``stream_epoch`` /
+    ``shard_quarantine`` records from ``data.streaming``, plus
+    ``stream_resume``/``native_fallback`` recovery actions): per run —
+    epochs and batches streamed, shards quarantined, total prefetch
+    stall time against pass time, and every mid-epoch resume point —
+    the data-plane mirror of the resilience section."""
+    per_run: Dict[str, dict] = defaultdict(
+        lambda: {"epochs": 0, "batches": 0, "rows": 0, "pass_s": 0.0,
+                 "stall_s": 0.0, "quarantined": 0, "resumes": [],
+                 "fallbacks": 0, "prefetch": None})
+    for rec in epochs:
+        e = per_run[rec.get("run_id", "-")]
+        e["epochs"] += 1
+        e["batches"] += int(rec.get("batches", 0) or 0)
+        e["rows"] += int(rec.get("rows", 0) or 0)
+        for key in ("pass_s", "stall_s"):
+            v = rec.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                e[key] += float(v)
+        q = rec.get("quarantined")
+        if isinstance(q, int) and not isinstance(q, bool):
+            e["quarantined"] = max(e["quarantined"], q)
+        if rec.get("prefetch") is not None:
+            e["prefetch"] = rec.get("prefetch")
+        r = rec.get("resumed_from_batch")
+        if r is not None:
+            e["resumes"].append(f"e{rec.get('epoch', '?')}@b{r}")
+    for rec in quarantines:
+        e = per_run[rec.get("run_id", "-")]
+        e["quarantined"] = max(e["quarantined"], 1)
+    for rec in recoveries:
+        action = rec.get("action")
+        e = per_run[rec.get("run_id", "-")]
+        if action == "native_fallback":
+            e["fallbacks"] += 1
+        elif action == "stream_resume":
+            tag = f"@b{rec.get('resumed_from_batch', '?')}"
+            if not any(p.endswith(tag) for p in e["resumes"]):
+                e["resumes"].append(tag)
+    headers = ["run_id", "epochs", "batches", "rows", "pass_s",
+               "stall_s", "stall_frac", "prefetch", "quarantined",
+               "resume_points", "native_fallbacks"]
+    rows = []
+    for run_id, e in sorted(per_run.items()):
+        frac = (e["stall_s"] / e["pass_s"]) if e["pass_s"] > 0 else None
+        rows.append([
+            _fmt(run_id)[:18], str(e["epochs"]), str(e["batches"]),
+            str(e["rows"]), _fmt(e["pass_s"], 4), _fmt(e["stall_s"], 4),
+            _fmt(frac, 3), _fmt(e["prefetch"]),
+            str(e["quarantined"]),
+            ", ".join(e["resumes"]) or "-",
+            str(e["fallbacks"]),
+        ])
+    out = [_table(headers, rows)]
+    if quarantines:
+        qrows = [[_fmt(q.get("run_id", "-"))[:18],
+                  _fmt(q.get("shard"))[:48],
+                  _fmt(q.get("attempts")),
+                  _fmt(q.get("data_fraction"), 3),
+                  _fmt(q.get("reason"))[:50]]
+                 for q in quarantines]
+        out.append("")
+        out.append(_table(["run_id", "shard", "attempts",
+                           "data_fraction", "reason"], qrows))
+    return "\n".join(out)
+
+
 def _iteration_summary(records: List[dict], eps: float) -> dict:
     """Aggregate convergence facts of one file's iteration streams."""
     losses = [float(r["loss"]) for r in
@@ -654,6 +724,11 @@ def main(argv=None) -> int:
                         "(canary/promotion records and rollbacks; "
                         "the gate lives in tools/perf_gate.py "
                         "--promotion)")
+    p.add_argument("--streaming", action="store_true",
+                   help="print only the == streaming == rollup "
+                        "(stream_epoch/shard_quarantine records, "
+                        "resume points and native fallbacks; the gate "
+                        "lives in tools/perf_gate.py --stream)")
     p.add_argument("--fleet", action="store_true",
                    help="print only the == fleet == rollup "
                         "(fleet_route/replica_verdict records, "
@@ -680,6 +755,7 @@ def main(argv=None) -> int:
     skews, rebalances = [], []
     canaries, promotions = [], []
     fleet_routes, fleet_verdicts = [], []
+    stream_epochs, quarantines = [], []
     iters_by_run: Dict[str, List[dict]] = defaultdict(list)
     unknown = 0
     for rec in records:
@@ -716,6 +792,10 @@ def main(argv=None) -> int:
             fleet_routes.append(rec)
         elif k == "replica_verdict":
             fleet_verdicts.append(rec)
+        elif k == "stream_epoch":
+            stream_epochs.append(rec)
+        elif k == "shard_quarantine":
+            quarantines.append(rec)
         elif k is None:
             unknown += 1
 
@@ -746,6 +826,17 @@ def main(argv=None) -> int:
             return 1
         print(f"== scaling ({len(curves)} ladder(s)) ==")
         print(summarize_scaling(curves))
+        return 0
+
+    if args.streaming:
+        if not (stream_epochs or quarantines):
+            print("no stream_epoch/shard_quarantine records found",
+                  file=sys.stderr)
+            return 1
+        print(f"== streaming ({len(stream_epochs)} epochs, "
+              f"{len(quarantines)} quarantines) ==")
+        print(summarize_streaming(stream_epochs, quarantines,
+                                  recoveries))
         return 0
 
     if args.fleet:
@@ -801,6 +892,11 @@ def main(argv=None) -> int:
               f"{len(fleet_verdicts)} verdict changes) ==")
         print(summarize_fleet(fleet_routes, fleet_verdicts,
                               serve_reqs, recoveries))
+    if stream_epochs or quarantines:
+        print(f"\n== streaming ({len(stream_epochs)} epochs, "
+              f"{len(quarantines)} quarantines) ==")
+        print(summarize_streaming(stream_epochs, quarantines,
+                                  recoveries))
     tracing = summarize_tracing(records, recoveries, args.trace)
     if tracing:
         print("\n== tracing ==")

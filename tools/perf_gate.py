@@ -68,6 +68,16 @@ def main(argv=None) -> int:
                         "hold; exit 2 on too few shadow requests, "
                         "cross-generation spec mismatch, or "
                         "contention-flagged latency)")
+    p.add_argument("--stream", action="store_true",
+                   help="single-file mode: gate streamed-ingest "
+                        "overlap over BASELINE.jsonl's stream_epoch "
+                        "records (every prefetched epoch must keep "
+                        "its stall fraction under the ceiling; exit 2 "
+                        "on contention-flagged or ungradable epochs)")
+    p.add_argument("--stall-ceiling", type=float, default=None,
+                   metavar="FRAC",
+                   help="--stream: max allowed stall fraction for a "
+                        "prefetched epoch (default 0.5)")
     p.add_argument("--quality-threshold", type=float, default=None,
                    metavar="REL",
                    help="--promotion: relative held-out-loss "
@@ -102,6 +112,21 @@ def main(argv=None) -> int:
                                          require_rebalance=True)
         print(perfgate.format_rebalance_report(result))
         return result.exit_code()
+    if args.stream:
+        if args.candidate is not None:
+            p.error("--stream is single-file: pass only RECORDS.jsonl")
+        try:
+            records = perfgate.load_records(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: cannot read records: {e}",
+                  file=sys.stderr)
+            return 2
+        kw = {"require_stream": True}
+        if args.stall_ceiling is not None:
+            kw["stall_ceiling"] = args.stall_ceiling
+        result = perfgate.gate_stream(records, **kw)
+        print(perfgate.format_stream_report(result))
+        return result.exit_code()
     if args.promotion:
         if args.candidate is not None:
             p.error("--promotion is single-file: pass only RECORDS.jsonl")
@@ -120,8 +145,8 @@ def main(argv=None) -> int:
         print(perfgate.format_promotion_report(result))
         return result.exit_code()
     if args.candidate is None:
-        p.error("CANDIDATE.jsonl is required (unless --rebalance "
-                "or --promotion)")
+        p.error("CANDIDATE.jsonl is required (unless --rebalance, "
+                "--promotion, or --stream)")
 
     thresholds = _parse_thresholds(args.threshold, p)
     try:

@@ -1,0 +1,390 @@
+#!/usr/bin/env python
+"""The streamed-ingest survival drill — CI proof the streaming data
+plane absorbs every fault class it claims to.
+
+A parent process writes K LIBSVM partition files, then runs three
+child fits over them (host-driver streamed AGD, prefetch on):
+
+1. **baseline** — the healthy shards only (the victim excluded), no
+   faults, uninterrupted: the reference loss.
+2. **faulted** — ALL shards under a scripted ``ChaosSchedule``:
+   a ``slow_reader`` (degraded source, payload under the read
+   watchdog), a ``hang_reader`` (payload ABOVE the watchdog →
+   ``AttemptTimeout`` → data-plane retry), and a ``corrupt_shard``
+   stomping the victim file at its first visit (→ typed
+   ``shard_quarantine``, epoch continues degraded).  Mid-epoch, after
+   a scripted number of cursor commits, the child SIGKILLs itself
+   from inside the ``StreamCheckpoint`` commit hook — the hard
+   preemption.
+3. **resume** — a fresh child over the same checkpoint chain: it must
+   adopt the mid-epoch cursor (``stream_resume`` on record), re-absorb
+   the still-corrupt victim, and run to completion.
+
+PASS (exit 0) requires: the faulted child died by SIGKILL; the victim
+was quarantined TYPED in both the faulted and resumed runs; the
+hung read was retried; the resumed run consumed a mid-epoch cursor
+and its final loss matches the baseline within ``--tol`` (default
+1e-6 — the quarantined victim makes the two batch sequences
+identical); every record across all four JSONLs is schema-valid; the
+whole drill is ONE connected trace tree; and ``perfgate.gate_stream``
+grades the streamed epochs without refusing.  Any miss prints the
+reason and exits 1.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/stream_drill.py [--out DIR] [-v]
+
+CPU-deterministic; runs in well under a minute.  See
+``docs/STREAMING.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_FEATURES = 8
+N_SHARDS = 8
+ROWS_PER_SHARD = 32
+VICTIM = 3          # the shard corrupt_shard stomps at first visit
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python tools/stream_drill.py",
+        description="streamed-ingest survival drill")
+    p.add_argument("--iters", type=int, default=6,
+                   help="AGD iteration budget per fit (default 6)")
+    p.add_argument("--segment", type=int, default=2,
+                   help="supervisor segment length = checkpoint "
+                        "cadence (default 2)")
+    p.add_argument("--batch-rows", type=int, default=16,
+                   help="streamed macro-batch rows (default 16)")
+    p.add_argument("--every-batches", type=int, default=4,
+                   help="mid-epoch cursor commit cadence (default 4)")
+    p.add_argument("--kill-at-commit", type=int, default=14,
+                   help="SIGKILL the faulted child inside this cursor "
+                        "commit (default 14: past the first segment "
+                        "boundary, mid-pass in the second segment)")
+    p.add_argument("--read-timeout", type=float, default=2.0,
+                   help="per-attempt shard read watchdog seconds "
+                        "(default 2.0; the hang payload sits above it)")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="|final loss - baseline| bound (default 1e-6)")
+    p.add_argument("--out", default=None,
+                   help="work directory (default: a fresh temp dir)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    # child plumbing
+    p.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--phase", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--workdir", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def _shard_paths(workdir, include_victim: bool):
+    paths = [os.path.join(workdir, "parts", f"part-{k}.libsvm")
+             for k in range(N_SHARDS)]
+    if not include_victim:
+        paths = [p for i, p in enumerate(paths) if i != VICTIM]
+    return paths
+
+
+def child_main(args) -> int:
+    """One streamed fit: phase ``baseline`` | ``faulted`` | ``resume``
+    (see module docstring).  Joins the parent's trace through
+    ``AGD_TRACE_CONTEXT``; writes ``result-<phase>.json`` on a clean
+    finish (the faulted phase never finishes — SIGKILL is the point)."""
+    import jax.numpy as jnp
+
+    from spark_agd_tpu.core import agd, smooth as smooth_lib
+    from spark_agd_tpu.data import streaming
+    from spark_agd_tpu.data.streaming import StreamingDataset, \
+        StreamCheckpoint
+    from spark_agd_tpu.obs import JSONLSink, Telemetry, trace as trace_lib
+    from spark_agd_tpu.ops.losses import LogisticGradient
+    from spark_agd_tpu.ops.prox import L2Prox
+    from spark_agd_tpu.resilience import (AutoCheckpointer,
+                                          ResiliencePolicy,
+                                          run_agd_supervised)
+    from spark_agd_tpu.resilience.chaos import (ChaosSchedule,
+                                                ScheduledFault)
+    from spark_agd_tpu.resilience.retry import RetryPolicy
+
+    phase = args.phase
+    jsonl = os.path.join(args.workdir, f"drill-{phase}.jsonl")
+    tel = Telemetry([JSONLSink(jsonl)])
+
+    chaos = None
+    if phase == "faulted":
+        # visit order on the first pass is shard order: slow the first
+        # read, hang the second (payload above the watchdog), corrupt
+        # the victim at ITS first visit — before it ever parses, so no
+        # pass ever holds its batches and the baseline stays comparable
+        chaos = ChaosSchedule([
+            ScheduledFault(kind="slow_reader", at_iter=0, payload=0.05),
+            ScheduledFault(kind="hang_reader", at_iter=1,
+                           payload=args.read_timeout * 1.5),
+            ScheduledFault(kind="corrupt_shard", at_iter=VICTIM),
+        ], telemetry=tel)
+
+    dataset = StreamingDataset.from_libsvm_parts(
+        _shard_paths(args.workdir, include_victim=(phase != "baseline")),
+        n_features=N_FEATURES, batch_rows=args.batch_rows,
+        nnz_pad=256,
+        retries=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                            backoff_max=0.05, jitter=0.0, seed=0),
+        read_timeout=args.read_timeout,
+        quarantine=(phase != "baseline"),
+        telemetry=tel, chaos=chaos)
+
+    ckpt = None
+    stream_ckpt = None
+    if phase != "baseline":
+        ckpt = AutoCheckpointer(
+            os.path.join(args.workdir, "stream_ckpt.npz"),
+            every_iters=args.segment, keep=3, telemetry=tel)
+        on_commit = None
+        if phase == "faulted":
+            def on_commit(count):
+                if count >= args.kill_at_commit:
+                    tel.flush()  # the kill must be on record
+                    os.kill(os.getpid(), signal.SIGKILL)
+        stream_ckpt = StreamCheckpoint(
+            ckpt, every_batches=args.every_batches, on_commit=on_commit)
+
+    sm, sl = streaming.make_streaming_smooth(
+        LogisticGradient(), dataset, prefetch=2,
+        stream_ckpt=stream_ckpt, telemetry=tel)
+    px, rv = smooth_lib.make_prox(L2Prox(), 0.1)
+    cfg = agd.AGDConfig(convergence_tol=0.0, num_iterations=args.iters)
+    policy = ResiliencePolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_max=0.05, jitter=0.0, seed=0,
+                              segment_iters=args.segment)
+
+    with trace_lib.activate(trace_lib.from_env()):
+        res = run_agd_supervised(
+            smooth=sm, smooth_loss=sl, prox=px, reg_value=rv,
+            w0=jnp.zeros(N_FEATURES, jnp.float32), config=cfg,
+            policy=policy, telemetry=tel, checkpointer=ckpt,
+            driver="host", stream_iterations=False)
+    tel.flush()
+    with open(os.path.join(args.workdir,
+                           f"result-{phase}.json"), "w") as f:
+        json.dump({"final_loss": float(res.loss_history[-1]),
+                   "num_iters": int(res.num_iters),
+                   "resumed_from": int(res.resumed_from),
+                   "quarantined": sorted(dataset.quarantined)}, f)
+    print(f"DRILL_CHILD_OK phase={phase} iters={res.num_iters} "
+          f"loss={float(res.loss_history[-1]):.12f}", flush=True)
+    return 0
+
+
+def _spawn_child(args, phase: str):
+    me = os.path.abspath(__file__)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(me))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    return subprocess.Popen(
+        [sys.executable, me, "--child", "--phase", phase,
+         "--workdir", args.workdir,
+         "--iters", str(args.iters), "--segment", str(args.segment),
+         "--batch-rows", str(args.batch_rows),
+         "--every-batches", str(args.every_batches),
+         "--kill-at-commit", str(args.kill_at_commit),
+         "--read-timeout", str(args.read_timeout)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        text=True)
+
+
+def parent_main(args) -> int:
+    import tempfile
+
+    import numpy as np
+
+    failures: list = []
+
+    def check(ok: bool, what: str):
+        tag = "ok" if ok else "FAIL"
+        if not ok:
+            failures.append(what)
+        if args.verbose or not ok:
+            print(f"{tag}: {what}")
+
+    args.workdir = args.out or tempfile.mkdtemp(prefix="stream_drill_")
+    os.makedirs(os.path.join(args.workdir, "parts"), exist_ok=True)
+    for stale in glob.glob(os.path.join(args.workdir, "*.json*")) \
+            + glob.glob(os.path.join(args.workdir, "stream_ckpt*")):
+        os.unlink(stale)
+
+    # the partition files (rewritten every run: a reused --out must
+    # not inherit last drill's corrupted victim)
+    from spark_agd_tpu.data import libsvm  # jax-free import
+
+    rng = np.random.default_rng(11)
+    w_true = np.linspace(-1.0, 1.0, N_FEATURES)
+    for k in range(N_SHARDS):
+        X = rng.standard_normal(
+            (ROWS_PER_SHARD, N_FEATURES)).astype(np.float32)
+        y = np.where(
+            X @ w_true + 0.3 * rng.standard_normal(ROWS_PER_SHARD) > 0,
+            1.0, -1.0)
+        libsvm.save_libsvm(
+            os.path.join(args.workdir, "parts", f"part-{k}.libsvm"),
+            X, y)
+
+    # the drill's ROOT trace span, published through AGD_TRACE_CONTEXT
+    # so all three children join one causal tree
+    from spark_agd_tpu.obs import (JSONLSink, Telemetry, perfgate,
+                                   schema, timeline, trace as trace_lib)
+
+    parent_jsonl = os.path.join(args.workdir, "drill-parent.jsonl")
+    tel = Telemetry([JSONLSink(parent_jsonl)])
+    root_span = tel.trace_span("stream_drill", tool="stream_drill")
+    root_ctx = root_span.__enter__()
+    os.environ[trace_lib.TRACE_ENV] = root_ctx.to_env_value()
+
+    def reap(proc, what):
+        out, err = proc.communicate(timeout=300)
+        if args.verbose and out:
+            print(out, end="")
+        return proc.returncode, out, err
+
+    # -- phase 1: the clean baseline (victim excluded) --------------------
+    rc, out, err = reap(_spawn_child(args, "baseline"), "baseline")
+    check(rc == 0 and "DRILL_CHILD_OK" in out,
+          f"baseline child completed (rc={rc})"
+          + ("" if rc == 0 else f"\n{err[-2000:]}"))
+    base_path = os.path.join(args.workdir, "result-baseline.json")
+    if not os.path.exists(base_path):
+        return _verdict(failures, root_span, tel)
+    with open(base_path) as f:
+        base_loss = float(json.load(f)["final_loss"])
+    if args.verbose:
+        print(f"baseline (victim excluded): final loss {base_loss:.12f}")
+
+    # -- phase 2: all faults + the mid-epoch SIGKILL ----------------------
+    rc, out, err = reap(_spawn_child(args, "faulted"), "faulted")
+    check(rc == -signal.SIGKILL,
+          f"faulted child died by SIGKILL inside cursor commit "
+          f"#{args.kill_at_commit} (rc={rc})"
+          + ("" if rc == -signal.SIGKILL else f"\n{err[-2000:]}"))
+
+    # -- phase 3: relaunch over the same checkpoint chain -----------------
+    rc, out, err = reap(_spawn_child(args, "resume"), "resume")
+    check(rc == 0 and "DRILL_CHILD_OK" in out,
+          f"resume child completed (rc={rc})"
+          + ("" if rc == 0 else f"\n{err[-2000:]}"))
+    res_path = os.path.join(args.workdir, "result-resume.json")
+    if not os.path.exists(res_path):
+        return _verdict(failures, root_span, tel)
+    with open(res_path) as f:
+        resumed = json.load(f)
+    check(resumed["resumed_from"] > 0,
+          f"resume warm-started from iteration "
+          f"{resumed['resumed_from']}, not from scratch")
+    victim_path = _shard_paths(args.workdir, True)[VICTIM]
+    check(resumed["quarantined"] == [victim_path],
+          f"resumed run re-quarantined the still-corrupt victim "
+          f"({resumed['quarantined']})")
+    diff = abs(float(resumed["final_loss"]) - base_loss)
+    check(diff <= args.tol,
+          f"resumed final loss {resumed['final_loss']:.12f} matches the "
+          f"victim-excluded baseline {base_loss:.12f} "
+          f"(|diff| = {diff:.2e} <= {args.tol:g})")
+
+    # -- the JSONL evidence ----------------------------------------------
+    root_span.__exit__(None, None, None)
+    tel.flush()
+    records = []
+    for phase in ("parent", "baseline", "faulted", "resume"):
+        records.extend(schema.read_jsonl(
+            os.path.join(args.workdir, f"drill-{phase}.jsonl")))
+    invalid = [(i, errs) for i, rec in enumerate(records, 1)
+               if (errs := schema.validate_record(
+                   json.loads(json.dumps(rec, default=str))))]
+    check(not invalid,
+          f"all {len(records)} drill records are schema-valid"
+          + (f" (first bad: {invalid[0]})" if invalid else ""))
+
+    quarantines = [r for r in records
+                   if r.get("kind") == "shard_quarantine"]
+    check(len(quarantines) >= 2 and all(
+        r.get("shard") == victim_path for r in quarantines),
+          f"typed shard_quarantine records in the faulted AND resumed "
+          f"runs, all naming the victim (x{len(quarantines)})")
+    retries = [r for r in records if r.get("kind") == "recovery"
+               and r.get("action") == "retry"
+               and r.get("source") == "stream_shard"]
+    check(len(retries) >= 1,
+          f"the hung read was retried by the data plane "
+          f"(x{len(retries)} stream_shard retries)")
+    resumes = [r for r in records if r.get("kind") == "recovery"
+               and r.get("action") == "stream_resume"]
+    check(len(resumes) >= 1 and any(
+        int(r.get("resumed_from_batch") or 0) > 0 for r in resumes),
+          "the mid-epoch cursor was consumed (stream_resume recovery "
+          f"with a non-zero batch offset; x{len(resumes)})")
+    epochs = [r for r in records if r.get("kind") == "stream_epoch"]
+    check(len(epochs) >= 4,
+          f"multi-epoch streamed evidence ({len(epochs)} stream_epoch "
+          "records)")
+
+    # one connected causal tree across parent + all three children
+    ids = timeline.trace_ids(records)
+    rep = timeline.analyze(records, ids[0]) if len(ids) == 1 else None
+    check(rep is not None and rep.connected and rep.spans >= 4,
+          f"one connected trace tree spanning the drill "
+          f"(ids={len(ids)}, "
+          + (f"spans={rep.spans}, connected={rep.connected})"
+             if rep is not None else "no analyzable tree)"))
+
+    # the stream gate must GRADE these epochs, not refuse them (the
+    # honest stall floor belongs to real runs: tiny CPU passes stall
+    # on purpose here, so the ceiling is held open)
+    gate = perfgate.gate_stream(records, stall_ceiling=1.0,
+                                min_pass_s=0.0, require_stream=True)
+    check(not gate.refused and gate.graded >= 1,
+          f"perfgate.gate_stream graded {gate.graded} prefetched "
+          f"epoch(s) without refusing "
+          f"(refusals={gate.refusals or 'none'})")
+
+    print(f"drill artifacts under {args.workdir} "
+          f"({len(records)} records)")
+    if failures:
+        print(f"STREAM DRILL FAILED ({len(failures)} checks):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("STREAM DRILL PASSED: slow/hung/corrupt shards absorbed, "
+          "mid-epoch SIGKILL resumed from the cursor to the baseline "
+          f"loss (diff {diff:.2e})")
+    return 0
+
+
+def _verdict(failures, root_span, tel) -> int:
+    root_span.__exit__(None, None, None)
+    tel.flush()
+    print(f"STREAM DRILL FAILED ({len(failures)} checks):")
+    for f in failures:
+        print(f"  - {f}")
+    return 1
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.child:
+        return child_main(args)
+    return parent_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
